@@ -1,0 +1,150 @@
+"""pathway_tpu — a TPU-native live-data framework.
+
+A from-scratch reimplementation of the capabilities of Pathway
+(reference: /root/reference, v0.16.2 — incremental streaming dataflow with a
+Python table API, connectors, persistence, and an LLM/RAG xpack), designed
+for JAX/XLA on TPU: columnar micro-batch deltas, batched jit ML UDFs, and a
+mesh-sharded live vector index (see SURVEY.md).
+
+Usage mirrors the reference's ``import pathway as pw`` surface::
+
+    import pathway_tpu as pw
+
+    t = pw.debug.table_from_markdown(...)
+    out = t.filter(pw.this.x > 0).groupby(pw.this.k).reduce(
+        k=pw.this.k, s=pw.reducers.sum(pw.this.x))
+    pw.debug.compute_and_print(out)
+"""
+
+from __future__ import annotations
+
+from .internals import dtype as dt
+from .internals import api_reducers as reducers
+from .internals.expression import (
+    ApplyExpression,
+    AsyncApplyExpression,
+    CoalesceExpression,
+    ColumnExpression,
+    ColumnReference,
+    IfElseExpression,
+    MakeTupleExpression,
+    RequireExpression,
+)
+from .internals.keys import Pointer, ref_scalar
+from .internals.parse_graph import G
+from .internals.run import run, run_all
+from .internals.schema import (
+    ColumnDefinition,
+    Schema,
+    column_definition,
+    schema_builder,
+    schema_from_dict,
+    schema_from_types,
+)
+from .internals.table import GroupedTable, JoinMode, JoinResult, Table
+from .internals.thisclass import left, right, this
+from .internals.universe import Universe
+
+# submodules
+from . import debug  # noqa: E402
+from . import demo  # noqa: E402
+from . import io  # noqa: E402
+from . import universes  # noqa: E402
+from .internals import udfs  # noqa: E402
+from .internals.udfs import UDF, udf, udf_async  # noqa: E402
+from .internals.yaml_loader import load_yaml  # noqa: E402
+from .internals.sql import sql  # noqa: E402
+from .internals.config import PathwayConfig, get_config, set_license_key  # noqa: E402
+from .internals.monitoring import MonitoringLevel  # noqa: E402
+from . import persistence  # noqa: E402
+from . import stdlib  # noqa: E402
+from .stdlib import indexing, ml, temporal, utils, stateful, graphs  # noqa: E402
+from .stdlib.temporal import asof_join, interval_join, window_join, windowby  # noqa: E402
+
+__version__ = "0.1.0"
+
+
+def reset() -> None:
+    """Clear the global computation graph (fresh build)."""
+    G.clear()
+
+
+# ---------------------------------------------------------------------------
+# free functions of the pw.* namespace
+# ---------------------------------------------------------------------------
+
+def apply(fun, *args, **kwargs) -> ApplyExpression:
+    """Row-wise python function application (reference pw.apply)."""
+    return ApplyExpression(fun, None, args=args, kwargs=kwargs)
+
+
+def apply_with_type(fun, ret_type, *args, **kwargs) -> ApplyExpression:
+    return ApplyExpression(fun, ret_type, args=args, kwargs=kwargs)
+
+
+def apply_async(fun, *args, **kwargs) -> AsyncApplyExpression:
+    return AsyncApplyExpression(fun, None, args=args, kwargs=kwargs)
+
+
+def if_else(if_clause, then_clause, else_clause) -> IfElseExpression:
+    return IfElseExpression(if_clause, then_clause, else_clause)
+
+
+def coalesce(*args) -> CoalesceExpression:
+    return CoalesceExpression(*args)
+
+
+def require(val, *args) -> RequireExpression:
+    return RequireExpression(val, *args)
+
+
+def make_tuple(*args) -> MakeTupleExpression:
+    return MakeTupleExpression(*args)
+
+
+def cast(target_type, expr):
+    from .internals.expression import CastExpression
+
+    return CastExpression(expr, target_type)
+
+
+def unwrap(expr):
+    from .internals.expression import smart_coerce
+
+    return smart_coerce(expr)
+
+
+def assert_table_has_schema(table, schema, *, allow_superset=False) -> None:
+    th = table.typehints()
+    for name in schema.column_names():
+        if name not in th:
+            raise AssertionError(f"column {name} missing from table")
+    if not allow_superset:
+        extra = set(th) - set(schema.column_names())
+        if extra:
+            raise AssertionError(f"unexpected columns: {extra}")
+
+
+def table_transformer(fn=None, **kwargs):
+    """Decorator marking a Table→Table transformer (typing sugar)."""
+
+    def wrap(f):
+        return f
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def iterate(func, iteration_limit: int = 128, **kwargs):
+    """Fixed-point iteration (reference pw.iterate, internals/common.py:39).
+
+    Round-1 semantics: applies ``func`` repeatedly on materialised static
+    data until convergence.  Streaming fixed-point scopes land with the
+    iterate operator in a later revision."""
+    raise NotImplementedError(
+        "pw.iterate is not yet available in pathway_tpu; see ROADMAP"
+    )
+
+
+# Type aliases exposed like reference pw.*
+Json = dt.JSON
+Pointer_ = Pointer

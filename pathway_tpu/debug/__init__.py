@@ -1,0 +1,187 @@
+"""Debug helpers: static tables and synchronous computation
+(reference: python/pathway/debug/__init__.py:207-496 — table_from_markdown /
+table_from_pandas / compute_and_print / compute_and_print_update_stream)."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..engine.graph import OutputCallbacks
+from ..engine.operators.io import SubscribeOperator
+from ..internals import dtype as dt
+from ..internals.keys import Pointer, ref_scalar, sequential_keys
+from ..internals.parse_graph import G
+from ..internals.run import run as _run
+from ..internals.schema import Schema, schema_from_types
+from ..internals.table import Table
+
+__all__ = [
+    "table_from_rows",
+    "table_from_markdown",
+    "table_from_pandas",
+    "table_to_pandas",
+    "table_to_dicts",
+    "compute_and_print",
+    "compute_and_print_update_stream",
+    "parse_to_table",
+]
+
+
+def table_from_rows(
+    schema: Type[Schema],
+    rows: Sequence[Tuple],
+    unsafe_trusted_ids: bool = False,
+    is_stream: bool = False,
+) -> Table:
+    names = list(schema.columns().keys())
+    dict_rows = [dict(zip(names, row)) for row in rows]
+    return Table.from_rows(dict_rows, schema, name="debug_rows")
+
+
+def _parse_value(text: str) -> Any:
+    text = text.strip()
+    if text in ("", "None"):
+        return None
+    if text in ("True", "true"):
+        return True
+    if text in ("False", "false"):
+        return False
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    return text
+
+
+def table_from_markdown(
+    txt: str,
+    *,
+    schema: Optional[Type[Schema]] = None,
+    unsafe_trusted_ids: bool = False,
+    **kwargs,
+) -> Table:
+    """Parse a markdown-ish table (reference: debug/__init__.py:429).
+
+    First unnamed column (before the first ``|``) is the row id if present."""
+    lines = [l for l in txt.strip().splitlines() if l.strip()]
+    header = lines[0]
+    has_id = header.lstrip().startswith("|")
+    col_names = [c.strip() for c in header.split("|") if c.strip()]
+    rows: List[Dict[str, Any]] = []
+    explicit_keys: List[int] = []
+    for line in lines[1:]:
+        if re.match(r"^[\s|:-]+$", line):
+            continue
+        parts = line.split("|")
+        if has_id:
+            id_part = parts[0].strip()
+            values = parts[1:]
+            if id_part:
+                explicit_keys.append(int(ref_scalar(int(id_part))))
+        else:
+            values = parts
+        vals = [_parse_value(v) for v in values[: len(col_names)]]
+        while len(vals) < len(col_names):
+            vals.append(None)
+        rows.append(dict(zip(col_names, vals)))
+    keys = explicit_keys if has_id and len(explicit_keys) == len(rows) else None
+    return Table.from_rows(rows, schema, keys=keys, name="markdown")
+
+
+# reference alias
+parse_to_table = table_from_markdown
+
+
+def table_from_pandas(
+    df,
+    *,
+    schema: Optional[Type[Schema]] = None,
+    unsafe_trusted_ids: bool = False,
+    **kwargs,
+) -> Table:
+    rows = df.to_dict("records")
+    keys = None
+    try:
+        if df.index.dtype.kind in "iu":
+            keys = [int(ref_scalar(int(i))) for i in df.index]
+    except Exception:
+        keys = None
+    return Table.from_rows(rows, schema, keys=keys, name="pandas")
+
+
+def _ensure_ran():
+    _run(monitoring_level=None)
+
+
+def table_to_dicts(table: Table):
+    _ensure_ran()
+    keys, columns = table._materialize()
+    return [Pointer(k) for k in keys], {
+        name: {Pointer(k): col[i] for i, k in enumerate(keys)}
+        for name, col in columns.items()
+    }
+
+
+def table_to_pandas(table: Table, include_id: bool = True):
+    import pandas as pd
+
+    _ensure_ran()
+    keys, columns = table._materialize()
+    df = pd.DataFrame({name: list(col) for name, col in columns.items()})
+    if include_id:
+        df.index = [Pointer(k) for k in keys]
+    return df
+
+
+def compute_and_print(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: Optional[int] = None,
+    **kwargs,
+) -> None:
+    _ensure_ran()
+    keys, columns = table._materialize()
+    names = list(columns.keys())
+    order = np.argsort(keys)
+    header = (["id"] if include_id else []) + names
+    rows = []
+    for i in order[: n_rows if n_rows is not None else len(order)]:
+        row = []
+        if include_id:
+            p = Pointer(int(keys[i]))
+            row.append(f"^{int(p) % 0xFFFFFF:X}" if short_pointers else repr(p))
+        row.extend(str(columns[c][i]) for c in names)
+        rows.append(row)
+    widths = [
+        max(len(header[c]), *(len(r[c]) for r in rows)) if rows else len(header[c])
+        for c in range(len(header))
+    ]
+    print(" | ".join(h.ljust(w) for h, w in zip(header, widths)))
+    for r in rows:
+        print(" | ".join(v.ljust(w) for v, w in zip(r, widths)))
+
+
+def compute_and_print_update_stream(table: Table, **kwargs) -> None:
+    events: List[Tuple[int, int, Tuple]] = []
+
+    def on_change(key, row, time, diff):
+        events.append((time, diff, row))
+
+    op = SubscribeOperator(
+        table._engine_table, OutputCallbacks(on_change=on_change), name="debug_stream"
+    )
+    G.engine_graph.add_operator(op)
+    _ensure_ran()
+    names = table.column_names
+    print("time | diff | " + " | ".join(names))
+    for time, diff, row in events:
+        print(f"{time} | {diff:+d} | " + " | ".join(str(v) for v in row))

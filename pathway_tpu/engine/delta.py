@@ -1,0 +1,246 @@
+"""Timestamped micro-batch deltas — the unit of incremental computation.
+
+The reference engine propagates per-record ``(data, time, diff)`` updates
+through differential-dataflow collections (external/differential-dataflow/,
+src/engine/dataflow.rs:757).  The TPU-native redesign batches updates: a
+``Delta`` is a *columnar* batch of keyed upserts/retractions produced at one
+commit tick.  Columnar batches are what vectorised host evaluation and XLA
+dispatch want — one device call per operator per tick, not per record.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..internals import dtype as dt
+from ..internals.keys import KEY_DTYPE
+
+__all__ = ["Delta", "RowStore", "empty_delta", "rows_equal", "values_equal"]
+
+
+def values_equal(a: Any, b: Any) -> bool:
+    """Value equality that is safe for np.ndarray cells."""
+    if a is b:
+        return True
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        if not isinstance(a, np.ndarray) or not isinstance(b, np.ndarray):
+            return False
+        return a.shape == b.shape and bool(np.array_equal(a, b))
+    if isinstance(a, tuple) and isinstance(b, tuple):
+        return rows_equal(a, b)
+    try:
+        return bool(a == b)
+    except (ValueError, TypeError):
+        return False
+
+
+def rows_equal(a: Optional[Tuple[Any, ...]], b: Optional[Tuple[Any, ...]]) -> bool:
+    if a is None or b is None:
+        return a is b
+    if len(a) != len(b):
+        return False
+    return all(values_equal(x, y) for x, y in zip(a, b))
+
+
+def _object_array(values: Sequence[Any]) -> np.ndarray:
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def as_column(values: Sequence[Any], dtype: Optional[dt.DType] = None) -> np.ndarray:
+    """Build a column array; dense numpy when the dtype allows it."""
+    if isinstance(values, np.ndarray) and values.dtype != object:
+        return values
+    npdt = dt.numpy_dtype_for(dtype) if dtype is not None else None
+    if npdt is not None:
+        try:
+            return np.asarray(values, dtype=npdt)
+        except (TypeError, ValueError):
+            pass
+    return _object_array(list(values))
+
+
+@dataclass
+class Delta:
+    """A batch of changes: row i means (keys[i], diff[i], {col: columns[col][i]}).
+
+    diffs are +1 (insert) / -1 (retract).  Within one Delta a key may appear
+    twice (retract old row + insert new row) — retractions sort first."""
+
+    keys: np.ndarray  # uint64[n]
+    diffs: np.ndarray  # int64[n]
+    columns: Dict[str, np.ndarray]  # each len n
+
+    def __post_init__(self):
+        self.keys = np.asarray(self.keys, dtype=KEY_DTYPE)
+        self.diffs = np.asarray(self.diffs, dtype=np.int64)
+
+    @property
+    def n(self) -> int:
+        return len(self.keys)
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def select_rows(self, mask_or_index: np.ndarray) -> "Delta":
+        return Delta(
+            keys=self.keys[mask_or_index],
+            diffs=self.diffs[mask_or_index],
+            columns={k: v[mask_or_index] for k, v in self.columns.items()},
+        )
+
+    def retractions(self) -> "Delta":
+        return self.select_rows(self.diffs < 0)
+
+    def insertions(self) -> "Delta":
+        return self.select_rows(self.diffs > 0)
+
+    def with_columns(self, columns: Dict[str, np.ndarray]) -> "Delta":
+        return Delta(keys=self.keys, diffs=self.diffs, columns=columns)
+
+    def with_keys(self, keys: np.ndarray) -> "Delta":
+        return Delta(keys=keys, diffs=self.diffs, columns=self.columns)
+
+    def rows(self) -> Iterable[Tuple[int, int, Tuple[Any, ...]]]:
+        names = self.column_names
+        for i in range(self.n):
+            yield (
+                int(self.keys[i]),
+                int(self.diffs[i]),
+                tuple(self.columns[c][i] for c in names),
+            )
+
+    @staticmethod
+    def from_rows(
+        column_names: Sequence[str],
+        rows: Sequence[Tuple[int, int, Tuple[Any, ...]]],
+        dtypes: Optional[Mapping[str, dt.DType]] = None,
+    ) -> "Delta":
+        keys = np.array([r[0] for r in rows], dtype=KEY_DTYPE)
+        diffs = np.array([r[1] for r in rows], dtype=np.int64)
+        columns = {}
+        for ci, name in enumerate(column_names):
+            vals = [r[2][ci] for r in rows]
+            columns[name] = as_column(vals, dtypes.get(name) if dtypes else None)
+        return Delta(keys=keys, diffs=diffs, columns=columns)
+
+    @staticmethod
+    def concat(deltas: Sequence["Delta"], column_names: Sequence[str]) -> "Delta":
+        deltas = [d for d in deltas if d.n > 0]
+        if not deltas:
+            return empty_delta(column_names)
+        if len(deltas) == 1:
+            return deltas[0]
+        keys = np.concatenate([d.keys for d in deltas])
+        diffs = np.concatenate([d.diffs for d in deltas])
+        columns = {}
+        for name in column_names:
+            cols = [d.columns[name] for d in deltas]
+            if any(c.dtype == object for c in cols):
+                cols = [c.astype(object) for c in cols]
+            columns[name] = np.concatenate(cols)
+        return Delta(keys=keys, diffs=diffs, columns=columns)
+
+    def consolidated(self) -> "Delta":
+        """Order retractions before insertions (stable), drop nothing."""
+        if self.n <= 1:
+            return self
+        order = np.argsort(self.diffs, kind="stable")
+        if np.all(order == np.arange(self.n)):
+            return self
+        return self.select_rows(order)
+
+
+def empty_delta(column_names: Sequence[str]) -> Delta:
+    return Delta(
+        keys=np.empty(0, dtype=KEY_DTYPE),
+        diffs=np.empty(0, dtype=np.int64),
+        columns={c: np.empty(0, dtype=object) for c in column_names},
+    )
+
+
+class RowStore:
+    """Materialised current state of a table: key → row tuple.
+
+    The engine keeps one RowStore per engine table so any operator can
+    retract previously-emitted rows and stateful operators can look rows up
+    (the analog of differential arrangements,
+    external/differential-dataflow/ — but as plain indexed state since each
+    delta application is a host-side batch)."""
+
+    def __init__(self, column_names: Sequence[str]):
+        self.column_names = list(column_names)
+        self._rows: Dict[int, Tuple[Any, ...]] = {}
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __contains__(self, key: int) -> bool:
+        return int(key) in self._rows
+
+    def get(self, key: int) -> Optional[Tuple[Any, ...]]:
+        return self._rows.get(int(key))
+
+    def items(self):
+        return self._rows.items()
+
+    def keys_array(self) -> np.ndarray:
+        return np.fromiter(self._rows.keys(), dtype=KEY_DTYPE, count=len(self._rows))
+
+    def apply(self, delta: Delta) -> None:
+        names = self.column_names
+        cols = [delta.columns[c] for c in names]
+        for i in range(delta.n):
+            key = int(delta.keys[i])
+            if delta.diffs[i] > 0:
+                self._rows[key] = tuple(c[i] for c in cols)
+            else:
+                self._rows.pop(key, None)
+
+    def lookup_delta(self, keys: np.ndarray, diff: int = -1) -> Delta:
+        """Build a delta of current rows for the given keys (used to retract)."""
+        found_keys: List[int] = []
+        found_rows: List[Tuple[Any, ...]] = []
+        for key in keys:
+            row = self._rows.get(int(key))
+            if row is not None:
+                found_keys.append(int(key))
+                found_rows.append(row)
+        columns = {}
+        for ci, name in enumerate(self.column_names):
+            columns[name] = _object_array([r[ci] for r in found_rows])
+        return Delta(
+            keys=np.array(found_keys, dtype=KEY_DTYPE),
+            diffs=np.full(len(found_keys), diff, dtype=np.int64),
+            columns=columns,
+        )
+
+    def to_delta(self, diff: int = 1) -> Delta:
+        """Snapshot the entire state as one insertion delta."""
+        keys = self.keys_array()
+        rows = [self._rows[int(k)] for k in keys]
+        columns = {}
+        for ci, name in enumerate(self.column_names):
+            columns[name] = _object_array([r[ci] for r in rows])
+        return Delta(
+            keys=keys,
+            diffs=np.full(len(keys), diff, dtype=np.int64),
+            columns=columns,
+        )
+
+    def to_columns(self) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        keys = self.keys_array()
+        rows = [self._rows[int(k)] for k in keys]
+        columns = {}
+        for ci, name in enumerate(self.column_names):
+            columns[name] = _object_array([r[ci] for r in rows])
+        return keys, columns

@@ -1,0 +1,201 @@
+"""Engine dataflow graph: tables, operators, scheduler.
+
+The reference's engine is a ~60-method ``Graph`` trait implemented over
+timely/differential scopes with one graph instance per worker thread
+(src/engine/graph.rs:664, src/engine/dataflow.rs:757).  The TPU-native
+engine is a single host-side operator DAG driven in topological order once
+per commit tick; each operator transforms columnar ``Delta`` batches, and
+device-heavy operators (batched ML UDFs, the KNN index) dispatch jitted XLA
+computations inside their ``process``.  Distribution happens *inside* the
+device ops via ``jax.sharding`` over the mesh — not by running N copies of
+the dataflow — which is the SPMD-native analog of the reference's
+worker-sharded dataflow (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..internals.keys import KEY_DTYPE
+from .delta import Delta, RowStore, empty_delta
+
+__all__ = ["EngineTable", "EngineOperator", "EngineGraph", "OutputCallbacks"]
+
+
+class EngineTable:
+    """A node carrying rows: column names + materialised RowStore."""
+
+    _ids = itertools.count()
+
+    def __init__(self, column_names: Sequence[str], name: str = ""):
+        self.id = next(EngineTable._ids)
+        self.name = name or f"t{self.id}"
+        self.column_names = list(column_names)
+        self.store = RowStore(self.column_names)
+        self.consumers: List[Tuple["EngineOperator", int]] = []
+        self.producer: Optional["EngineOperator"] = None
+
+    def empty_delta(self) -> Delta:
+        return empty_delta(self.column_names)
+
+    def __repr__(self):  # pragma: no cover
+        return f"<EngineTable {self.name}({', '.join(self.column_names)})>"
+
+
+class EngineOperator:
+    """Base operator: consumes deltas on input ports, emits one output delta.
+
+    Contract (incremental correctness): ``process`` is called sequentially in
+    topological order within a tick; input table stores are already updated
+    with the incoming delta, the operator's own output store is updated by
+    the scheduler *after* ``process`` returns (so retraction lookups against
+    ``self.output.store`` see the pre-update state).  Stateful operators keep
+    their *own* per-port state and update it inside ``process`` (the
+    bilinear-rule discipline: port-0 deltas join pre-update port-1 own state,
+    and vice versa)."""
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        inputs: Sequence[EngineTable],
+        output: Optional[EngineTable],
+        name: str = "",
+    ):
+        self.id = next(EngineOperator._ids)
+        self.name = name or type(self).__name__
+        self.inputs = list(inputs)
+        self.output = output
+        self.topo_index: int = -1
+        self.trace: Any = None  # user stack frame (internals/trace.py)
+        for port, table in enumerate(self.inputs):
+            table.consumers.append((self, port))
+        if output is not None:
+            output.producer = self
+
+    def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        raise NotImplementedError
+
+    def on_tick_end(self, ts: int) -> Optional[Delta]:
+        """Called once per tick after all deltas settle (for time-based ops
+        like buffers / forget)."""
+        return None
+
+    def on_end(self) -> Optional[Delta]:
+        """Called when all sources are exhausted (flush buffers)."""
+        return None
+
+    def __repr__(self):  # pragma: no cover
+        return f"<{self.name}#{self.id}>"
+
+
+class OutputCallbacks:
+    """Subscriber callbacks (reference SubscribeCallbacks, graph.rs:581)."""
+
+    def __init__(
+        self,
+        on_change: Optional[Callable[[int, Tuple[Any, ...], int, int], None]] = None,
+        on_time_end: Optional[Callable[[int], None]] = None,
+        on_end: Optional[Callable[[], None]] = None,
+    ):
+        self.on_change = on_change
+        self.on_time_end = on_time_end
+        self.on_end = on_end
+
+
+class EngineGraph:
+    """Container for the lowered dataflow; assigns topological order."""
+
+    def __init__(self):
+        self.tables: List[EngineTable] = []
+        self.operators: List[EngineOperator] = []
+        self.sources: List["SourceOperator"] = []
+        self.sinks: List[EngineOperator] = []
+
+    def add_table(self, column_names: Sequence[str], name: str = "") -> EngineTable:
+        t = EngineTable(column_names, name)
+        self.tables.append(t)
+        return t
+
+    def add_operator(self, op: EngineOperator) -> EngineOperator:
+        self.operators.append(op)
+        from .operators.io import SourceOperator  # local import to avoid cycle
+
+        if isinstance(op, SourceOperator):
+            self.sources.append(op)
+        return op
+
+    def finalize(self) -> None:
+        """Topologically order operators (graph is a DAG by construction)."""
+        indegree: Dict[int, int] = {}
+        ops_by_id = {op.id: op for op in self.operators}
+        dependents: Dict[int, List[int]] = {op.id: [] for op in self.operators}
+        for op in self.operators:
+            deg = 0
+            for t in op.inputs:
+                if t.producer is not None:
+                    deg += 1
+                    dependents[t.producer.id].append(op.id)
+            indegree[op.id] = deg
+        ready = [op.id for op in self.operators if indegree[op.id] == 0]
+        heapq.heapify(ready)
+        order = 0
+        while ready:
+            oid = heapq.heappop(ready)
+            ops_by_id[oid].topo_index = order
+            order += 1
+            for dep in dependents[oid]:
+                indegree[dep] -= 1
+                if indegree[dep] == 0:
+                    heapq.heappush(ready, dep)
+        if order != len(self.operators):
+            raise RuntimeError("cycle detected in dataflow graph")
+
+    def propagate(self, initial: List[Tuple[EngineOperator, int, Delta]], ts: int) -> None:
+        """Push deltas through the graph in topological order for one tick."""
+        # priority queue keyed by (topo_index, seq) so operators fire in order
+        seq = itertools.count()
+        heap: List[Tuple[int, int, EngineOperator, int, Delta]] = []
+        for op, port, delta in initial:
+            heapq.heappush(heap, (op.topo_index, next(seq), op, port, delta))
+        while heap:
+            _, _, op, port, delta = heapq.heappop(heap)
+            if delta.n == 0 and port >= 0:
+                continue
+            out = op.process(port, delta, ts)
+            if out is not None and out.n > 0 and op.output is not None:
+                out = out.consolidated()
+                op.output.store.apply(out)
+                for consumer, cport in op.output.consumers:
+                    heapq.heappush(
+                        heap, (consumer.topo_index, next(seq), consumer, cport, out)
+                    )
+
+    def tick_end(self, ts: int) -> None:
+        """Run on_tick_end hooks (time-based operators may release buffers)."""
+        pending: List[Tuple[EngineOperator, int, Delta]] = []
+        for op in sorted(self.operators, key=lambda o: o.topo_index):
+            out = op.on_tick_end(ts)
+            if out is not None and out.n > 0 and op.output is not None:
+                out = out.consolidated()
+                op.output.store.apply(out)
+                for consumer, cport in op.output.consumers:
+                    pending.append((consumer, cport, out))
+        if pending:
+            self.propagate(pending, ts)
+
+    def flush_end(self, ts: int) -> None:
+        pending: List[Tuple[EngineOperator, int, Delta]] = []
+        for op in sorted(self.operators, key=lambda o: o.topo_index):
+            out = op.on_end()
+            if out is not None and out.n > 0 and op.output is not None:
+                out = out.consolidated()
+                op.output.store.apply(out)
+                for consumer, cport in op.output.consumers:
+                    pending.append((consumer, cport, out))
+        if pending:
+            self.propagate(pending, ts)

@@ -1,0 +1,199 @@
+"""Source and sink operators.
+
+Sources mirror the reference's connector sessions: a *native* session is a
+stream of explicit insert/remove events, an *upsert* session keys rows and
+derives retractions from the previous row for the key (reference:
+src/connectors/adaptors.rs:23-80).  Connector threads push events into an
+``InputSession`` buffer; the scheduler drains it once per commit tick.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...internals import dtype as dt
+from ...internals.keys import KEY_DTYPE
+from ..delta import Delta, as_column, empty_delta
+from ..graph import EngineOperator, EngineTable, OutputCallbacks
+
+__all__ = ["InputSession", "SourceOperator", "SubscribeOperator", "StaticSourceOperator"]
+
+_INSERT = 0
+_REMOVE = 1
+_UPSERT = 2
+_DELETE_BY_KEY = 3
+
+
+class InputSession:
+    """Thread-safe buffer of input events pushed by connector threads."""
+
+    def __init__(self, upsert: bool = False):
+        self._lock = threading.Lock()
+        self._events: List[Tuple[int, int, Optional[Tuple[Any, ...]]]] = []
+        self.upsert = upsert
+        self.finished = False
+
+    def insert(self, key: int, row: Tuple[Any, ...]) -> None:
+        with self._lock:
+            self._events.append((_UPSERT if self.upsert else _INSERT, key, row))
+
+    def remove(self, key: int, row: Optional[Tuple[Any, ...]] = None) -> None:
+        with self._lock:
+            self._events.append(
+                (_DELETE_BY_KEY if row is None else _REMOVE, key, row)
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            self.finished = True
+
+    def drain(self) -> List[Tuple[int, int, Optional[Tuple[Any, ...]]]]:
+        with self._lock:
+            events, self._events = self._events, []
+            return events
+
+    @property
+    def has_pending(self) -> bool:
+        with self._lock:
+            return bool(self._events)
+
+
+class SourceOperator(EngineOperator):
+    """Drains an InputSession into deltas once per tick."""
+
+    def __init__(
+        self,
+        output: EngineTable,
+        session: InputSession,
+        dtypes: Optional[Dict[str, dt.DType]] = None,
+        name: str = "source",
+    ):
+        super().__init__([], output, name)
+        self.session = session
+        self.dtypes = dtypes or {}
+
+    @property
+    def finished(self) -> bool:
+        return self.session.finished and not self.session.has_pending
+
+    def poll(self, ts: int) -> Optional[Delta]:
+        events = self.session.drain()
+        if not events:
+            return None
+        names = self.output.column_names
+        store = self.output.store
+        keys: List[int] = []
+        diffs: List[int] = []
+        rows: List[Tuple[Any, ...]] = []
+        # pending view of this batch so same-tick upsert chains resolve
+        pending: Dict[int, Optional[Tuple[Any, ...]]] = {}
+
+        def current(key: int) -> Optional[Tuple[Any, ...]]:
+            if key in pending:
+                return pending[key]
+            return store.get(key)
+
+        for kind, key, row in events:
+            if kind == _INSERT:
+                keys.append(key)
+                diffs.append(1)
+                rows.append(row)
+                pending[key] = row
+            elif kind == _REMOVE:
+                keys.append(key)
+                diffs.append(-1)
+                rows.append(row)
+                pending[key] = None
+            elif kind == _UPSERT:
+                old = current(key)
+                if old is not None:
+                    keys.append(key)
+                    diffs.append(-1)
+                    rows.append(old)
+                keys.append(key)
+                diffs.append(1)
+                rows.append(row)
+                pending[key] = row
+            elif kind == _DELETE_BY_KEY:
+                old = current(key)
+                if old is not None:
+                    keys.append(key)
+                    diffs.append(-1)
+                    rows.append(old)
+                    pending[key] = None
+        if not keys:
+            return None
+        columns = {}
+        for ci, name in enumerate(names):
+            columns[name] = as_column([r[ci] for r in rows], self.dtypes.get(name))
+        return Delta(
+            keys=np.array(keys, dtype=KEY_DTYPE),
+            diffs=np.array(diffs, dtype=np.int64),
+            columns=columns,
+        )
+
+    def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        # sources are driven by poll(), not by upstream deltas
+        return delta
+
+
+class StaticSourceOperator(SourceOperator):
+    """A source pre-loaded with static rows, emitted once at the first tick
+    (reference static_table, graph.rs:688)."""
+
+    def __init__(
+        self,
+        output: EngineTable,
+        keys: np.ndarray,
+        columns: Dict[str, np.ndarray],
+        dtypes: Optional[Dict[str, dt.DType]] = None,
+        name: str = "static",
+    ):
+        session = InputSession()
+        super().__init__(output, session, dtypes, name)
+        names = output.column_names
+        for i in range(len(keys)):
+            session.insert(int(keys[i]), tuple(columns[c][i] for c in names))
+        session.close()
+
+
+class SubscribeOperator(EngineOperator):
+    """Sink delivering per-row change callbacks (pw.io.subscribe;
+    reference Graph::subscribe_table, graph.rs:700)."""
+
+    def __init__(
+        self,
+        input_table: EngineTable,
+        callbacks: OutputCallbacks,
+        name: str = "subscribe",
+    ):
+        super().__init__([input_table], None, name)
+        self.callbacks = callbacks
+        self._seen_any = False
+
+    def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        if self.callbacks.on_change is not None:
+            names = self.inputs[0].column_names
+            cols = [delta.columns[c] for c in names]
+            for i in range(delta.n):
+                self.callbacks.on_change(
+                    int(delta.keys[i]),
+                    tuple(c[i] for c in cols),
+                    ts,
+                    int(delta.diffs[i]),
+                )
+        self._seen_any = self._seen_any or delta.n > 0
+        return None
+
+    def on_tick_end(self, ts: int) -> Optional[Delta]:
+        if self.callbacks.on_time_end is not None:
+            self.callbacks.on_time_end(ts)
+        return None
+
+    def on_end(self) -> Optional[Delta]:
+        if self.callbacks.on_end is not None:
+            self.callbacks.on_end()
+        return None

@@ -1,0 +1,254 @@
+"""Incremental joins.
+
+Reference: Graph::join_tables (src/engine/graph.rs:873) over differential
+arrangements; JoinType inner/left/right/outer plus the non-retracting
+"asof-now" flavors used by live retrieval serving
+(stdlib/indexing/data_index.py:364-441).
+
+Bilinear-rule discipline: a delta on one side joins the *other side's own
+state as of before this delta* and then updates its own side, so
+dA⋈B_old + dB⋈A_new sums to exactly A_new⋈B_new − A_old⋈B_old.
+Outer padding uses per-join-key match counts derived from state sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...internals.expression import ColumnExpression
+from ...internals.keys import KEY_DTYPE, ref_scalars_batch
+from ..delta import Delta
+from ..graph import EngineOperator, EngineTable
+from .rowwise import build_eval_context
+
+__all__ = ["JoinOperator", "AsofNowJoinOperator", "JoinKind"]
+
+
+class JoinKind:
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    OUTER = "outer"
+
+
+_LPAD = 0x9D39247E33776D41  # sentinels mixed into padded-row keys
+_RPAD = 0x8A305F5359C24D78
+
+
+def _out_key(lkey: Optional[int], rkey: Optional[int], assign_id_from: Optional[str]) -> int:
+    if assign_id_from == "left" and lkey is not None:
+        return lkey
+    if assign_id_from == "right" and rkey is not None:
+        return rkey
+    a = lkey if lkey is not None else _LPAD
+    b = rkey if rkey is not None else _RPAD
+    return int(ref_scalars_batch([[a], [b]])[0])
+
+
+class JoinOperator(EngineOperator):
+    """Output columns: ``_l_<name>`` for left columns, ``_r_<name>`` for right
+    columns; unmatched sides padded with None for outer kinds."""
+
+    def __init__(
+        self,
+        left: EngineTable,
+        right: EngineTable,
+        output: EngineTable,
+        left_key_exprs: Sequence[ColumnExpression],
+        right_key_exprs: Sequence[ColumnExpression],
+        left_ctx_cols: Mapping[Tuple[int, str], str],
+        right_ctx_cols: Mapping[Tuple[int, str], str],
+        kind: str = JoinKind.INNER,
+        assign_id_from: Optional[str] = None,
+        exact_match: bool = False,
+        name: str = "join",
+    ):
+        super().__init__([left, right], output, name)
+        self.left_key_exprs = list(left_key_exprs)
+        self.right_key_exprs = list(right_key_exprs)
+        self.left_ctx_cols = dict(left_ctx_cols)
+        self.right_ctx_cols = dict(right_ctx_cols)
+        self.kind = kind
+        self.assign_id_from = assign_id_from
+        self.left_names = list(left.column_names)
+        self.right_names = list(right.column_names)
+        # own per-side state: join_key -> {row_key: row_tuple}
+        self._left: Dict[int, Dict[int, Tuple[Any, ...]]] = {}
+        self._right: Dict[int, Dict[int, Tuple[Any, ...]]] = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _join_keys(self, delta: Delta, side: int) -> np.ndarray:
+        exprs = self.left_key_exprs if side == 0 else self.right_key_exprs
+        ctx_cols = self.left_ctx_cols if side == 0 else self.right_ctx_cols
+        ctx = build_eval_context(delta, ctx_cols)
+        vals = [np.asarray(e._eval(ctx)) for e in exprs]
+        if len(vals) == 1 and vals[0].dtype == np.uint64:
+            # joining directly on key values (id joins / ix)
+            return vals[0].astype(KEY_DTYPE)
+        return ref_scalars_batch(vals)
+
+    def _row(self, lrow: Optional[Tuple], rrow: Optional[Tuple]) -> Tuple[Any, ...]:
+        l = lrow if lrow is not None else (None,) * len(self.left_names)
+        r = rrow if rrow is not None else (None,) * len(self.right_names)
+        return tuple(l) + tuple(r)
+
+    # -- processing --------------------------------------------------------
+    def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        if delta.n == 0:
+            return None
+        delta = delta.consolidated()
+        jks = self._join_keys(delta, port)
+        in_names = self.left_names if port == 0 else self.right_names
+        cols = [delta.columns[c] for c in in_names]
+        out: List[Tuple[int, int, Tuple[Any, ...]]] = []
+        own = self._left if port == 0 else self._right
+        other = self._right if port == 0 else self._left
+        pad_own = self.kind in (
+            (JoinKind.LEFT, JoinKind.OUTER) if port == 0 else (JoinKind.RIGHT, JoinKind.OUTER)
+        )
+        pad_other = self.kind in (
+            (JoinKind.RIGHT, JoinKind.OUTER) if port == 0 else (JoinKind.LEFT, JoinKind.OUTER)
+        )
+
+        for i in range(delta.n):
+            jk = int(jks[i])
+            key = int(delta.keys[i])
+            row = tuple(c[i] for c in cols)
+            diff = int(delta.diffs[i])
+            own_bucket = own.setdefault(jk, {})
+            other_bucket = other.get(jk) or {}
+            own_before = len(own_bucket)
+
+            if diff > 0:
+                for okey, orow in other_bucket.items():
+                    if port == 0:
+                        out.append(
+                            (_out_key(key, okey, self.assign_id_from), 1, self._row(row, orow))
+                        )
+                    else:
+                        out.append(
+                            (_out_key(okey, key, self.assign_id_from), 1, self._row(orow, row))
+                        )
+                if pad_other and own_before == 0 and other_bucket:
+                    # other side's rows were padded; retract their padded forms
+                    for okey, orow in other_bucket.items():
+                        if port == 0:
+                            out.append(
+                                (_out_key(None, okey, self.assign_id_from), -1, self._row(None, orow))
+                            )
+                        else:
+                            out.append(
+                                (_out_key(okey, None, self.assign_id_from), -1, self._row(orow, None))
+                            )
+                if pad_own and not other_bucket:
+                    if port == 0:
+                        out.append(
+                            (_out_key(key, None, self.assign_id_from), 1, self._row(row, None))
+                        )
+                    else:
+                        out.append(
+                            (_out_key(None, key, self.assign_id_from), 1, self._row(None, row))
+                        )
+                own_bucket[key] = row
+            else:
+                own_bucket.pop(key, None)
+                own_after = len(own_bucket)
+                for okey, orow in other_bucket.items():
+                    if port == 0:
+                        out.append(
+                            (_out_key(key, okey, self.assign_id_from), -1, self._row(row, orow))
+                        )
+                    else:
+                        out.append(
+                            (_out_key(okey, key, self.assign_id_from), -1, self._row(orow, row))
+                        )
+                if pad_own and not other_bucket:
+                    if port == 0:
+                        out.append(
+                            (_out_key(key, None, self.assign_id_from), -1, self._row(row, None))
+                        )
+                    else:
+                        out.append(
+                            (_out_key(None, key, self.assign_id_from), -1, self._row(None, row))
+                        )
+                if pad_other and own_after == 0 and own_before > 0 and other_bucket:
+                    for okey, orow in other_bucket.items():
+                        if port == 0:
+                            out.append(
+                                (_out_key(None, okey, self.assign_id_from), 1, self._row(None, orow))
+                            )
+                        else:
+                            out.append(
+                                (_out_key(okey, None, self.assign_id_from), 1, self._row(orow, None))
+                            )
+                if not own_bucket:
+                    own.pop(jk, None)
+        if not out:
+            return None
+        return Delta.from_rows(self.output.column_names, out)
+
+
+class AsofNowJoinOperator(JoinOperator):
+    """``join_asof_now``: each left (query) row joins the right state *as of
+    arrival* and the result never retracts when the right side later changes
+    (reference: the asof-now contract of query_as_of_now,
+    stdlib/indexing/data_index.py:364-441; use_external_index_as_of_now,
+    graph.rs:915).  Left retractions do retract previously emitted rows."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # lkey -> list of (out_key, out_row) previously emitted
+        self._emitted: Dict[int, List[Tuple[int, Tuple[Any, ...]]]] = {}
+
+    def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        if delta.n == 0:
+            return None
+        if port == 1:
+            # maintain right state only; no re-emission (asof-now contract)
+            jks = self._join_keys(delta, 1)
+            cols = [delta.columns[c] for c in self.right_names]
+            for i in range(delta.n):
+                jk = int(jks[i])
+                key = int(delta.keys[i])
+                if delta.diffs[i] > 0:
+                    self._right.setdefault(jk, {})[key] = tuple(c[i] for c in cols)
+                else:
+                    bucket = self._right.get(jk)
+                    if bucket is not None:
+                        bucket.pop(key, None)
+                        if not bucket:
+                            self._right.pop(jk, None)
+            return None
+        delta = delta.consolidated()
+        jks = self._join_keys(delta, 0)
+        cols = [delta.columns[c] for c in self.left_names]
+        out: List[Tuple[int, int, Tuple[Any, ...]]] = []
+        pad_left = self.kind in (JoinKind.LEFT, JoinKind.OUTER)
+        for i in range(delta.n):
+            jk = int(jks[i])
+            key = int(delta.keys[i])
+            diff = int(delta.diffs[i])
+            if diff < 0:
+                for out_key, out_row in self._emitted.pop(key, []):
+                    out.append((out_key, -1, out_row))
+                continue
+            row = tuple(c[i] for c in cols)
+            emitted: List[Tuple[int, Tuple[Any, ...]]] = []
+            bucket = self._right.get(jk) or {}
+            if bucket:
+                for rkey, rrow in bucket.items():
+                    ok = _out_key(key, rkey, self.assign_id_from)
+                    orow = self._row(row, rrow)
+                    out.append((ok, 1, orow))
+                    emitted.append((ok, orow))
+            elif pad_left:
+                ok = _out_key(key, None, self.assign_id_from)
+                orow = self._row(row, None)
+                out.append((ok, 1, orow))
+                emitted.append((ok, orow))
+            self._emitted[key] = emitted
+        if not out:
+            return None
+        return Delta.from_rows(self.output.column_names, out)

@@ -1,0 +1,79 @@
+"""Environment-first configuration
+(reference: python/pathway/internals/config.py:58-80 — PathwayConfig env
+fields; src/engine/dataflow/config.rs — topology env vars)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["PathwayConfig", "get_config", "set_license_key", "local_config"]
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class PathwayConfig:
+    # mesh/topology (the TPU analog of PATHWAY_THREADS/PROCESSES)
+    mesh_data_axis: int = int(os.environ.get("PATHWAY_TPU_DATA_SHARDS", "0") or 0)
+    mesh_model_axis: int = int(os.environ.get("PATHWAY_TPU_MODEL_SHARDS", "0") or 0)
+    # engine
+    commit_duration_ms: int = int(os.environ.get("PATHWAY_COMMIT_DURATION_MS", "100"))
+    terminate_on_error: bool = _env_bool("PATHWAY_TERMINATE_ON_ERROR", True)
+    runtime_typechecking: bool = _env_bool("PATHWAY_RUNTIME_TYPECHECKING", False)
+    # persistence
+    persistence_mode: str = os.environ.get("PATHWAY_PERSISTENCE_MODE", "")
+    replay_storage: Optional[str] = os.environ.get("PATHWAY_REPLAY_STORAGE")
+    persistent_storage: Optional[str] = os.environ.get("PATHWAY_PERSISTENT_STORAGE")
+    snapshot_interval_ms: int = int(
+        os.environ.get("PATHWAY_SNAPSHOT_INTERVAL_MS", "60000")
+    )
+    # observability
+    monitoring_server: Optional[str] = os.environ.get("PATHWAY_MONITORING_SERVER")
+    metrics_port: int = int(os.environ.get("PATHWAY_METRICS_PORT", "20000"))
+    # licensing: this framework is fully open — accepted and ignored
+    license_key: Optional[str] = os.environ.get("PATHWAY_LICENSE_KEY")
+
+    @property
+    def process_id(self) -> int:
+        return int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    @property
+    def processes(self) -> int:
+        return int(os.environ.get("PATHWAY_PROCESSES", "1"))
+
+
+_config = PathwayConfig()
+
+
+def get_config() -> PathwayConfig:
+    return _config
+
+
+def set_license_key(key: Optional[str]) -> None:
+    """Reference-compat no-op: pathway_tpu has no license gating
+    (reference: license.rs:31 gates >8 workers; here the mesh is the limit)."""
+    _config.license_key = key
+
+
+class local_config:
+    def __init__(self, **overrides):
+        self.overrides = overrides
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in self.overrides.items():
+            self._saved[k] = getattr(_config, k)
+            setattr(_config, k, v)
+        return _config
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            setattr(_config, k, v)
+        return False

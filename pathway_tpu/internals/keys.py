@@ -1,0 +1,125 @@
+"""Row keys ("pointers").
+
+The reference derives a 128-bit key per row by hashing its id-column values
+with xxh3-128, using the low 16 bits as the worker shard
+(reference: src/engine/value.rs:30-41).  Here keys are 64-bit xxh3 hashes
+(the reference ships the same width behind its ``yolo-id64`` feature,
+Cargo.toml:96-107) stored as ``np.uint64`` — a width that vectorises well on
+host and maps directly onto device integer columns.  The low ``SHARD_BITS``
+bits select the mesh shard.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+import xxhash
+
+__all__ = [
+    "Pointer",
+    "SHARD_BITS",
+    "SHARD_MASK",
+    "ref_scalar",
+    "ref_scalars_batch",
+    "sequential_keys",
+    "shard_of",
+    "shards_of",
+    "KEY_DTYPE",
+]
+
+KEY_DTYPE = np.uint64
+SHARD_BITS = 16
+SHARD_MASK = (1 << SHARD_BITS) - 1
+
+# Salt distinguishing "no id columns → sequential row number" keys from hashed keys.
+_SEQ_SALT = 0x9E3779B97F4A7C15
+
+
+class Pointer(int):
+    """A row key.  Subclass of int so it round-trips through numpy uint64."""
+
+    def __repr__(self) -> str:
+        return f"^{int(self):016X}"
+
+
+def _serialize_value(value: Any, out: bytearray) -> None:
+    """Canonical byte serialization of a value for hashing (order/type tagged)."""
+    if value is None:
+        out += b"\x00"
+    elif isinstance(value, (bool, np.bool_)):
+        out += b"\x01" + (b"\x01" if value else b"\x00")
+    elif isinstance(value, Pointer):
+        out += b"\x06" + struct.pack("<Q", int(value))
+    elif isinstance(value, (int, np.integer)):
+        v = int(value)
+        if -(1 << 63) <= v < (1 << 63):
+            out += b"\x02" + struct.pack("<q", v)
+        else:
+            out += b"\x0A" + struct.pack("<Q", v & 0xFFFFFFFFFFFFFFFF)
+    elif isinstance(value, (float, np.floating)):
+        out += b"\x03" + struct.pack("<d", float(value))
+    elif isinstance(value, str):
+        b = value.encode()
+        out += b"\x04" + struct.pack("<I", len(b)) + b
+    elif isinstance(value, bytes):
+        out += b"\x05" + struct.pack("<I", len(value)) + value
+    elif isinstance(value, (tuple, list)):
+        out += b"\x07" + struct.pack("<I", len(value))
+        for v in value:
+            _serialize_value(v, out)
+    elif isinstance(value, np.ndarray):
+        out += b"\x08" + str(value.dtype).encode() + struct.pack(
+            "<I", value.ndim
+        ) + struct.pack(f"<{value.ndim}I", *value.shape) + value.tobytes()
+    else:
+        b = repr(value).encode()
+        out += b"\x09" + struct.pack("<I", len(b)) + b
+
+
+def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
+    """Derive a deterministic key from id-column values
+    (reference ``ref_scalar``, python/pathway/engine.pyi:30)."""
+    if optional and any(v is None for v in values):
+        raise ValueError("ref_scalar received None for a non-optional id")
+    buf = bytearray()
+    for v in values:
+        _serialize_value(v, buf)
+    return Pointer(xxhash.xxh3_64_intdigest(bytes(buf)))
+
+
+def ref_scalars_batch(columns: Sequence[Sequence[Any]]) -> np.ndarray:
+    """Vector of keys for rows given as parallel columns of id values."""
+    n = len(columns[0])
+    out = np.empty(n, dtype=KEY_DTYPE)
+    for i in range(n):
+        buf = bytearray()
+        for col in columns:
+            _serialize_value(col[i], buf)
+        out[i] = xxhash.xxh3_64_intdigest(bytes(buf))
+    return out
+
+
+def sequential_keys(start: int, count: int, salt: int = 0) -> np.ndarray:
+    """Keys for rows with no explicit primary key: hash of (salt, row number).
+
+    Hashing (vs. raw counters) keeps the shard distribution uniform, which is
+    what the sharded index/groupby paths on the mesh rely on."""
+    idx = np.arange(start, start + count, dtype=np.uint64)
+    # splitmix64 finalizer - cheap, vectorized, well distributed
+    z = idx + np.uint64(_SEQ_SALT) + (np.uint64(salt) * np.uint64(0xBF58476D1CE4E5B9))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return z.astype(KEY_DTYPE)
+
+
+def shard_of(key: int, n_shards: int) -> int:
+    """Shard index of a key (reference: low 16 bits of the key,
+    src/engine/value.rs:38, src/engine/dataflow/shard.rs:6)."""
+    return (int(key) & SHARD_MASK) % n_shards
+
+
+def shards_of(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    return (keys.astype(np.uint64) & np.uint64(SHARD_MASK)) % np.uint64(n_shards)

@@ -1,0 +1,95 @@
+"""``pw.run()`` — execute the built dataflow
+(reference: python/pathway/internals/run.py:12 → GraphRunner →
+run_with_new_graph; here the graph is already lowered, so run = drive the
+Executor until sources finish or termination is requested)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..engine.executor import Executor
+from .parse_graph import G
+
+__all__ = ["run", "run_all"]
+
+_current_executor: Optional[Executor] = None
+_executor_lock = threading.Lock()
+
+
+def current_executor() -> Optional[Executor]:
+    return _current_executor
+
+
+def terminate() -> None:
+    """Request termination of the currently running graph (used by servers /
+    signal handlers)."""
+    with _executor_lock:
+        if _current_executor is not None:
+            _current_executor.terminate()
+
+
+def run(
+    *,
+    commit_duration_ms: int = 100,
+    monitoring_level=None,
+    with_http_server: bool = False,
+    debug: bool = False,
+    **kwargs,
+) -> None:
+    global _current_executor
+    # Incremental-run support: operators added after a previous run() are
+    # bootstrapped with snapshot deltas of their already-populated inputs
+    # (the eager-building analog of the reference's tree-shaken re-runs,
+    # graph_runner/__init__.py:129-150).
+    bootstrap = []
+    if G.ran:
+        new_ops = [
+            op for op in G.engine_graph.operators if op.id not in G.ran_ops
+        ]
+        if not new_ops and G.hooks_started >= len(G.pre_run_hooks):
+            return
+        for op in new_ops:
+            for port, t in enumerate(op.inputs):
+                if (
+                    t.producer is None or t.producer.id in G.ran_ops
+                ) and len(t.store):
+                    bootstrap.append((op, port, t.store.to_delta()))
+    G.ran = True
+    executor = Executor(G.engine_graph, commit_duration_ms)
+    with _executor_lock:
+        _current_executor = executor
+    monitor = None
+    if monitoring_level is not None and str(monitoring_level) not in ("MonitoringLevel.NONE", "none"):
+        try:
+            from .monitoring import StatsMonitor
+
+            monitor = StatsMonitor(G.engine_graph)
+            executor.on_tick = monitor.on_tick
+        except Exception:
+            monitor = None
+    if with_http_server:
+        try:
+            from .metrics import start_metrics_server
+
+            start_metrics_server(G.engine_graph)
+        except Exception:
+            pass
+    for hook in G.pre_run_hooks[G.hooks_started :]:
+        hook()
+    G.hooks_started = len(G.pre_run_hooks)
+    try:
+        executor.run(bootstrap=bootstrap)
+        G.ran_ops.update(op.id for op in G.engine_graph.operators)
+    finally:
+        for hook in G.post_run_hooks:
+            try:
+                hook()
+            except Exception:
+                pass
+        with _executor_lock:
+            _current_executor = None
+
+
+def run_all(**kwargs) -> None:
+    run(**kwargs)

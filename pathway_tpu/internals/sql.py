@@ -1,0 +1,411 @@
+"""``pw.sql`` — SQL queries over tables
+(reference: python/pathway/internals/sql.py:726, built on sqlglot; sqlglot is
+not available here, so this is a self-contained recursive-descent parser for
+the SELECT subset the reference documents: projections, WHERE, GROUP BY,
+HAVING, JOIN … ON, aliases, arithmetic/boolean expressions and the
+SUM/COUNT/MIN/MAX/AVG aggregates)."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import api_reducers as reducers
+from .expression import ColumnExpression, ColumnReference, IfElseExpression, smart_coerce
+from .table import JoinMode, Table
+
+__all__ = ["sql"]
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:"
+    r"(?P<num>\d+\.\d+|\d+)"
+    r"|(?P<str>'(?:[^']|'')*')"
+    r"|(?P<id>[A-Za-z_][A-Za-z_0-9]*)"
+    r"|(?P<op><>|!=|<=|>=|=|<|>|\*|/|\+|-|\(|\)|,|\.)"
+    r")"
+)
+
+_KEYWORDS = {
+    "select",
+    "from",
+    "where",
+    "group",
+    "by",
+    "having",
+    "as",
+    "and",
+    "or",
+    "not",
+    "join",
+    "inner",
+    "left",
+    "right",
+    "outer",
+    "full",
+    "on",
+    "null",
+    "true",
+    "false",
+    "case",
+    "when",
+    "then",
+    "else",
+    "end",
+    "union",
+    "all",
+}
+
+_AGGREGATES = {
+    "sum": reducers.sum,
+    "count": reducers.count,
+    "min": reducers.min,
+    "max": reducers.max,
+    "avg": reducers.avg,
+}
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise ValueError(f"SQL syntax error near {text[pos:pos+20]!r}")
+        pos = m.end()
+        if m.lastgroup == "num":
+            tokens.append(("num", m.group("num")))
+        elif m.lastgroup == "str":
+            tokens.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "id":
+            word = m.group("id")
+            tokens.append(
+                ("kw", word.lower()) if word.lower() in _KEYWORDS else ("id", word)
+            )
+        else:
+            tokens.append(("op", m.group("op")))
+    tokens.append(("eof", ""))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]], tables: Dict[str, Table]):
+        self.tokens = tokens
+        self.pos = 0
+        self.tables = {k.lower(): v for k, v in tables.items()}
+        self.scope: Dict[str, Table] = {}
+        self.aggregates: List[Tuple[str, Any]] = []
+
+    # token helpers
+    def peek(self) -> Tuple[str, str]:
+        return self.tokens[self.pos]
+
+    def next(self) -> Tuple[str, str]:
+        t = self.tokens[self.pos]
+        self.pos += 1
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> Optional[str]:
+        k, v = self.peek()
+        if k == kind and (value is None or v == value):
+            self.pos += 1
+            return v
+        return None
+
+    def expect(self, kind: str, value: Optional[str] = None) -> str:
+        got = self.accept(kind, value)
+        if got is None:
+            raise ValueError(f"SQL: expected {value or kind}, got {self.peek()}")
+        return got
+
+    # grammar
+    def parse_select(self) -> Table:
+        self.expect("kw", "select")
+        projections: List[Tuple[Optional[str], Any, bool]] = []  # (alias, expr_fn, is_star)
+        while True:
+            if self.accept("op", "*"):
+                projections.append((None, None, True))
+            else:
+                expr_fn = self.parse_expr_lazy()
+                alias = None
+                if self.accept("kw", "as"):
+                    alias = self.expect("id")
+                elif self.peek()[0] == "id" and self.tokens[self.pos + 1][1] in (",",) + ("",):
+                    pass
+                projections.append((alias, expr_fn, False))
+            if not self.accept("op", ","):
+                break
+        self.expect("kw", "from")
+        table = self.parse_table_source()
+
+        if self.accept("kw", "where"):
+            cond_fn = self.parse_expr_lazy()
+            table = table.filter(cond_fn(table))
+
+        group_exprs: List[Any] = []
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            while True:
+                group_exprs.append(self.parse_expr_lazy())
+                if not self.accept("op", ","):
+                    break
+
+        having_fn = None
+        if self.accept("kw", "having"):
+            having_fn = self.parse_expr_lazy()
+
+        if group_exprs or self._has_aggregates(projections):
+            grefs = [g(table) for g in group_exprs]
+            grouped = table.groupby(*grefs) if grefs else table.groupby()
+            out_kwargs: Dict[str, Any] = {}
+            for i, (alias, expr_fn, is_star) in enumerate(projections):
+                if is_star:
+                    raise ValueError("SELECT * with GROUP BY is not supported")
+                expr = expr_fn(table)
+                name = alias or self._infer_name(expr, f"col_{i}")
+                out_kwargs[name] = expr
+            result = grouped.reduce(**out_kwargs)
+            if having_fn is not None:
+                result = result.filter(having_fn(result))
+            return result
+
+        # plain projection
+        if len(projections) == 1 and projections[0][2]:
+            return table
+        out_kwargs = {}
+        for i, (alias, expr_fn, is_star) in enumerate(projections):
+            if is_star:
+                for n in table.column_names:
+                    out_kwargs[n] = table[n]
+                continue
+            expr = expr_fn(table)
+            name = alias or self._infer_name(expr, f"col_{i}")
+            out_kwargs[name] = expr
+        return table.select(**out_kwargs)
+
+    def _has_aggregates(self, projections) -> bool:
+        return bool(self.aggregates)
+
+    def _infer_name(self, expr, default: str) -> str:
+        if isinstance(expr, ColumnReference):
+            return expr.name
+        return default
+
+    def parse_table_source(self) -> Table:
+        name = self.expect("id").lower()
+        if name not in self.tables:
+            raise ValueError(f"SQL: unknown table {name!r}")
+        table = self.tables[name]
+        self.scope[name] = table
+        # joins
+        while True:
+            how = None
+            if self.accept("kw", "join") or (
+                self.accept("kw", "inner") and self.expect("kw", "join")
+            ):
+                how = JoinMode.INNER
+            elif self.accept("kw", "left"):
+                self.accept("kw", "outer")
+                self.expect("kw", "join")
+                how = JoinMode.LEFT
+            elif self.accept("kw", "right"):
+                self.accept("kw", "outer")
+                self.expect("kw", "join")
+                how = JoinMode.RIGHT
+            elif self.accept("kw", "full"):
+                self.accept("kw", "outer")
+                self.expect("kw", "join")
+                how = JoinMode.OUTER
+            else:
+                break
+            other_name = self.expect("id").lower()
+            if other_name not in self.tables:
+                raise ValueError(f"SQL: unknown table {other_name!r}")
+            other = self.tables[other_name]
+            self.scope[other_name] = other
+            self.expect("kw", "on")
+            cond_fn = self.parse_expr_lazy()
+
+            # build condition referencing both tables explicitly
+            def resolver(col, tbl=table, oth=other):
+                return col
+
+            cond = cond_fn(table, other)
+            jr = table.join(other, cond, how=how)
+            cols = {}
+            for n in table.column_names:
+                cols[n] = ColumnReference(table, n)
+            for n in other.column_names:
+                if n not in cols:
+                    cols[n] = ColumnReference(other, n)
+            table = jr.select(**cols)
+        return table
+
+    # expressions --------------------------------------------------------
+    def parse_expr_lazy(self):
+        """Parse one expression into a closure (table, [other]) -> ColumnExpression."""
+        node = self.parse_or()
+        return node
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.accept("kw", "or"):
+            right = self.parse_and()
+            left = _lift2(left, right, lambda a, b: a | b)
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.accept("kw", "and"):
+            right = self.parse_not()
+            left = _lift2(left, right, lambda a, b: a & b)
+        return left
+
+    def parse_not(self):
+        if self.accept("kw", "not"):
+            inner = self.parse_not()
+            return _lift1(inner, lambda a: ~a)
+        return self.parse_comparison()
+
+    def parse_comparison(self):
+        left = self.parse_additive()
+        k, v = self.peek()
+        if k == "op" and v in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            right = self.parse_additive()
+            ops = {
+                "=": lambda a, b: a == b,
+                "<>": lambda a, b: a != b,
+                "!=": lambda a, b: a != b,
+                "<": lambda a, b: a < b,
+                "<=": lambda a, b: a <= b,
+                ">": lambda a, b: a > b,
+                ">=": lambda a, b: a >= b,
+            }
+            return _lift2(left, right, ops[v])
+        return left
+
+    def parse_additive(self):
+        left = self.parse_multiplicative()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("+", "-"):
+                self.next()
+                right = self.parse_multiplicative()
+                if v == "+":
+                    left = _lift2(left, right, lambda a, b: a + b)
+                else:
+                    left = _lift2(left, right, lambda a, b: a - b)
+            else:
+                return left
+
+    def parse_multiplicative(self):
+        left = self.parse_primary()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("*", "/"):
+                self.next()
+                right = self.parse_primary()
+                if v == "*":
+                    left = _lift2(left, right, lambda a, b: a * b)
+                else:
+                    left = _lift2(left, right, lambda a, b: a / b)
+            else:
+                return left
+
+    def parse_primary(self):
+        k, v = self.peek()
+        if k == "num":
+            self.next()
+            value = float(v) if "." in v else int(v)
+            return lambda *tables: smart_coerce(value)
+        if k == "str":
+            self.next()
+            return lambda *tables: smart_coerce(v)
+        if k == "kw" and v in ("null", "true", "false"):
+            self.next()
+            value = {"null": None, "true": True, "false": False}[v]
+            return lambda *tables: smart_coerce(value)
+        if k == "kw" and v == "case":
+            return self.parse_case()
+        if self.accept("op", "("):
+            inner = self.parse_or()
+            self.expect("op", ")")
+            return inner
+        if k == "id":
+            name = self.next()[1]
+            # aggregate?
+            if name.lower() in _AGGREGATES and self.peek() == ("op", "("):
+                self.next()
+                agg = _AGGREGATES[name.lower()]
+                if self.accept("op", "*"):
+                    self.expect("op", ")")
+                    self.aggregates.append((name, None))
+                    return lambda *tables: agg()
+                arg = self.parse_or()
+                self.expect("op", ")")
+                self.aggregates.append((name, arg))
+                return lambda *tables, _arg=arg: agg(_arg(*tables))
+            # qualified name?
+            if self.accept("op", "."):
+                col = self.expect("id")
+                tname = name.lower()
+
+                def qualified(*tables, _t=tname, _c=col):
+                    t = self.scope.get(_t)
+                    if t is None:
+                        raise ValueError(f"SQL: unknown table alias {_t}")
+                    return ColumnReference(t, _c)
+
+                return qualified
+
+            def unqualified(*tables, _c=name):
+                for t in tables:
+                    if _c in t.column_names:
+                        return ColumnReference(t, _c)
+                return ColumnReference(tables[0], _c)
+
+            return unqualified
+        raise ValueError(f"SQL: unexpected token {self.peek()}")
+
+    def parse_case(self):
+        self.expect("kw", "case")
+        whens = []
+        else_fn = lambda *tables: smart_coerce(None)
+        while self.accept("kw", "when"):
+            cond = self.parse_or()
+            self.expect("kw", "then")
+            val = self.parse_or()
+            whens.append((cond, val))
+        if self.accept("kw", "else"):
+            else_fn = self.parse_or()
+        self.expect("kw", "end")
+
+        def build(*tables):
+            expr = else_fn(*tables)
+            for cond, val in reversed(whens):
+                expr = IfElseExpression(cond(*tables), val(*tables), expr)
+            return expr
+
+        return build
+
+
+def _lift2(a, b, fn):
+    return lambda *tables: fn(a(*tables), b(*tables))
+
+
+def _lift1(a, fn):
+    return lambda *tables: fn(a(*tables))
+
+
+def sql(query: str, **tables: Table) -> Table:
+    """Run a SQL SELECT over the given tables::
+
+        result = pw.sql("SELECT k, SUM(v) AS s FROM t GROUP BY k", t=my_table)
+    """
+    tokens = _tokenize(query)
+    parser = _Parser(tokens, tables)
+    result = parser.parse_select()
+    parser.expect("eof")
+    return result

@@ -1,0 +1,46 @@
+"""Universes — key-set identity of tables
+(reference: python/pathway/internals/universe.py + universe_solver.py).
+
+Tables sharing a universe have identical key sets; operations check
+universe compatibility before zipping columns.  The reference proves
+subset/equality relations with a SAT solver; here we track parentage
+(filter ⊂ parent) and explicit promises, which covers the API surface
+without the solver dependency."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Set
+
+__all__ = ["Universe"]
+
+
+class Universe:
+    _ids = itertools.count()
+
+    def __init__(self, parent: Optional["Universe"] = None):
+        self.id = next(Universe._ids)
+        self.parent = parent
+        self._equal: Set[int] = {self.id}
+
+    def subuniverse(self) -> "Universe":
+        return Universe(parent=self)
+
+    def is_subset_of(self, other: "Universe") -> bool:
+        u: Optional[Universe] = self
+        while u is not None:
+            if u.is_equal_to(other):
+                return True
+            u = u.parent
+        return False
+
+    def is_equal_to(self, other: "Universe") -> bool:
+        return bool(self._equal & other._equal)
+
+    def promise_equal(self, other: "Universe") -> None:
+        merged = self._equal | other._equal
+        self._equal = merged
+        other._equal = merged
+
+    def __repr__(self):  # pragma: no cover
+        return f"<Universe {self.id}>"

@@ -1,0 +1,77 @@
+"""YAML template config loader
+(reference: python/pathway/internals/yaml_loader.py:74-218 — ``$variables``
+and ``!pw.<path>`` tags instantiating python objects, used by RAG app
+templates)."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, IO, Union
+
+import yaml
+
+__all__ = ["load_yaml", "PathwayYamlLoader"]
+
+
+class PathwayYamlLoader(yaml.SafeLoader):
+    pass
+
+
+def _resolve_callable(path: str) -> Any:
+    """Resolve a dotted path like ``pw.xpacks.llm.embedders.SentenceTransformerEmbedder``."""
+    parts = path.split(".")
+    if parts[0] in ("pw", "pathway", "pathway_tpu"):
+        module_name = "pathway_tpu"
+        parts = parts[1:]
+    else:
+        module_name = parts[0]
+        parts = parts[1:]
+    obj = importlib.import_module(module_name)
+    for i, part in enumerate(parts):
+        if hasattr(obj, part):
+            obj = getattr(obj, part)
+        else:
+            module_name = module_name + "." + part
+            obj = importlib.import_module(module_name)
+    return obj
+
+
+def _construct_pw_object(loader: PathwayYamlLoader, tag_suffix: str, node: yaml.Node):
+    target = _resolve_callable(tag_suffix)
+    if isinstance(node, yaml.MappingNode):
+        kwargs = loader.construct_mapping(node, deep=True)
+        return target(**kwargs)
+    if isinstance(node, yaml.SequenceNode):
+        args = loader.construct_sequence(node, deep=True)
+        return target(*args)
+    value = loader.construct_scalar(node)
+    if value in (None, ""):
+        return target() if callable(target) else target
+    return target(value)
+
+
+yaml.add_multi_constructor("!pw.", _construct_pw_object, Loader=PathwayYamlLoader)
+yaml.add_multi_constructor("!pw:", _construct_pw_object, Loader=PathwayYamlLoader)
+
+
+def _resolve_variables(obj: Any, variables: Dict[str, Any]) -> Any:
+    if isinstance(obj, dict):
+        return {k: _resolve_variables(v, variables) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_resolve_variables(v, variables) for v in obj]
+    if isinstance(obj, str) and obj.startswith("$"):
+        name = obj[1:]
+        if name in variables:
+            return variables[name]
+    return obj
+
+
+def load_yaml(stream: Union[str, IO]) -> Any:
+    """Load a template config; top-level ``$name: value`` entries define
+    variables referenced as ``$name`` elsewhere."""
+    data = yaml.load(stream, Loader=PathwayYamlLoader)
+    if not isinstance(data, dict):
+        return data
+    variables = {k[1:]: v for k, v in data.items() if isinstance(k, str) and k.startswith("$")}
+    data = {k: v for k, v in data.items() if not (isinstance(k, str) and k.startswith("$"))}
+    return _resolve_variables(data, variables)

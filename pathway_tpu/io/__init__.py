@@ -1,0 +1,26 @@
+"""pw.io — connectors
+(reference inventory: python/pathway/io/ — fs, csv, jsonlines, plaintext,
+kafka, s3, http, python, debezium, postgres, elasticsearch, … — SURVEY.md
+§2.8).  Implemented natively here: fs/csv/jsonlines/plaintext/binary, python
+subjects, http (REST server), subscribe, null; service-backed connectors
+(kafka, s3, postgres, …) arrive as optional backends behind the same
+Reader/Writer split."""
+
+from __future__ import annotations
+
+from . import csv, fs, jsonlines, null, plaintext, python
+from ._subscribe import subscribe
+
+# http imported lazily (aiohttp); kept importable as pw.io.http
+from . import http  # noqa: E402
+
+__all__ = [
+    "csv",
+    "fs",
+    "jsonlines",
+    "null",
+    "plaintext",
+    "python",
+    "http",
+    "subscribe",
+]

@@ -1,0 +1,147 @@
+"""Shared connector runtime.
+
+Reference: src/connectors/mod.rs:91-427 — one reader thread per connector
+feeding parsed entries into an input session, committed on autocommit ticks.
+Here the thread pushes rows into an ``InputSession``; the executor drains it
+once per tick.  Static mode reads everything during the pre-run hook and
+closes the session (batch semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..engine.operators.io import InputSession, SourceOperator
+from ..internals import dtype as dt
+from ..internals.keys import ref_scalar, sequential_keys
+from ..internals.parse_graph import G
+from ..internals.schema import Schema
+from ..internals.table import Table
+from ..internals.universe import Universe
+
+__all__ = ["SessionWriter", "register_source", "coerce_row_types"]
+
+
+class SessionWriter:
+    """Pushes keyed rows into an InputSession, deriving keys from primary-key
+    columns (or a sequence counter) — the host analog of the reference's
+    parser→session path (src/connectors/adaptors.rs)."""
+
+    def __init__(
+        self,
+        session: InputSession,
+        column_names: Sequence[str],
+        primary_key: Optional[Sequence[str]],
+        dtypes: Mapping[str, dt.DType],
+        salt: int = 0,
+    ):
+        self.session = session
+        self.column_names = list(column_names)
+        self.primary_key = list(primary_key) if primary_key else None
+        self.dtypes = dict(dtypes)
+        self._counter = 0
+        self._salt = salt
+        self._lock = threading.Lock()
+
+    def key_of(self, values: Mapping[str, Any]) -> int:
+        if self.primary_key:
+            return int(ref_scalar(*(values[c] for c in self.primary_key)))
+        with self._lock:
+            i = self._counter
+            self._counter += 1
+        return int(sequential_keys(i, 1, salt=self._salt)[0])
+
+    def insert(self, values: Mapping[str, Any], key: Optional[int] = None) -> None:
+        values = coerce_row_types(values, self.dtypes)
+        if key is None:
+            key = self.key_of(values)
+        row = tuple(values.get(c) for c in self.column_names)
+        self.session.insert(key, row)
+
+    def remove(self, values: Mapping[str, Any], key: Optional[int] = None) -> None:
+        values = coerce_row_types(values, self.dtypes)
+        if key is None:
+            key = self.key_of(values)
+        self.session.remove(key)
+
+    def close(self) -> None:
+        self.session.close()
+
+
+def coerce_row_types(
+    values: Mapping[str, Any], dtypes: Mapping[str, dt.DType]
+) -> Dict[str, Any]:
+    out = dict(values)
+    for c, t in dtypes.items():
+        v = out.get(c)
+        if v is None:
+            continue
+        t = dt.unoptionalize(t)
+        try:
+            if t is dt.INT and not isinstance(v, (int, np.integer)):
+                out[c] = int(v)
+            elif t is dt.FLOAT and not isinstance(v, (float, np.floating)):
+                out[c] = float(v)
+            elif t is dt.BOOL and not isinstance(v, (bool, np.bool_)):
+                out[c] = str(v).lower() in ("1", "true", "yes", "on")
+            elif t is dt.STR and not isinstance(v, str):
+                out[c] = str(v)
+        except (ValueError, TypeError):
+            pass
+    return out
+
+
+_source_counter = [0]
+
+
+def register_source(
+    schema: Type[Schema],
+    runner: Callable[[SessionWriter], None],
+    *,
+    mode: str = "streaming",
+    upsert: bool = False,
+    name: str = "source",
+) -> Table:
+    """Create the engine source + api table and schedule ``runner`` to feed it.
+
+    ``mode="static"``: runner executes synchronously at run start, session
+    closes after (batch).  ``mode="streaming"``: runner executes on a daemon
+    thread; session closes when it returns."""
+    column_names = list(schema.columns().keys())
+    dtypes = schema.typehints()
+    _source_counter[0] += 1
+    salt = _source_counter[0]
+    session = InputSession(upsert=upsert or schema.primary_key_columns() is not None)
+    writer = SessionWriter(
+        session, column_names, schema.primary_key_columns(), dtypes, salt=salt
+    )
+    et = G.engine_graph.add_table(column_names, name)
+    G.engine_graph.add_operator(
+        SourceOperator(et, session, dtypes, name=name)
+    )
+
+    if mode == "static":
+
+        def hook():
+            try:
+                runner(writer)
+            finally:
+                writer.close()
+
+    else:
+
+        def hook():
+            def target():
+                try:
+                    runner(writer)
+                finally:
+                    writer.close()
+
+            thread = threading.Thread(target=target, daemon=True, name=f"connector-{name}")
+            thread.start()
+
+    G.pre_run_hooks.append(hook)
+    return Table(et, dtypes, Universe(), short_name=name)

@@ -1,0 +1,186 @@
+"""pw.io.fs — filesystem connector: csv / json(lines) / plaintext / binary,
+static or streaming (directory watching)
+(reference: python/pathway/io/fs/__init__.py:31-275, scanner
+src/connectors/scanner/filesystem.rs)."""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import json as _json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+from ...internals import dtype as dt
+from ...internals.schema import Schema, schema_from_types
+from ...internals.table import Table
+from .._connector import SessionWriter, register_source
+
+__all__ = ["read", "write"]
+
+
+def _expand(path: str) -> List[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return out
+    return sorted(_glob.glob(path)) or ([path] if os.path.exists(path) else [])
+
+
+def _plaintext_schema():
+    return schema_from_types(data=str)
+
+
+def _binary_schema():
+    return schema_from_types(data=bytes)
+
+
+def read(
+    path: str,
+    *,
+    format: str = "csv",
+    schema: Optional[Type[Schema]] = None,
+    mode: str = "streaming",
+    csv_settings=None,
+    json_field_paths=None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int = 100,
+    name: str = "fs",
+    poll_interval_s: float = 1.0,
+    **kwargs,
+) -> Table:
+    """Read files under ``path``.  ``mode="static"`` reads once;
+    ``mode="streaming"`` keeps watching for new/modified files."""
+    if format in ("plaintext", "plaintext_by_file"):
+        schema = schema or _plaintext_schema()
+    elif format == "binary":
+        schema = schema or _binary_schema()
+    elif schema is None:
+        raise ValueError(f"schema is required for format={format!r}")
+    if with_metadata:
+        cols = dict(schema.columns())
+        from ...internals.schema import ColumnSchema, _make_schema
+
+        cols["_metadata"] = ColumnSchema(name="_metadata", dtype=dt.JSON)
+        schema = _make_schema(schema.__name__ + "Meta", cols)
+
+    columns = [c for c in schema.columns().keys() if c != "_metadata"]
+    dtypes = schema.typehints()
+
+    def parse_file(fpath: str, writer: SessionWriter):
+        meta = None
+        if with_metadata:
+            st = os.stat(fpath)
+            meta = {
+                "path": fpath,
+                "size": st.st_size,
+                "modified_at": int(st.st_mtime),
+                "created_at": int(st.st_ctime),
+                "seen_at": int(time.time()),
+            }
+
+        def emit(values: Dict[str, Any]):
+            if with_metadata:
+                values = {**values, "_metadata": meta}
+            writer.insert(values)
+
+        if format == "csv":
+            with open(fpath, newline="") as f:
+                for row in _csv.DictReader(f):
+                    emit({c: row.get(c) for c in columns})
+        elif format in ("json", "jsonlines"):
+            with open(fpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = _json.loads(line)
+                    emit({c: obj.get(c) for c in columns})
+        elif format in ("plaintext",):
+            with open(fpath) as f:
+                for line in f:
+                    emit({"data": line.rstrip("\n")})
+        elif format == "plaintext_by_file":
+            with open(fpath) as f:
+                emit({"data": f.read()})
+        elif format == "binary":
+            with open(fpath, "rb") as f:
+                emit({"data": f.read()})
+        else:
+            raise ValueError(f"unknown format {format!r}")
+
+    if mode == "static":
+
+        def runner(writer: SessionWriter):
+            for fpath in _expand(path):
+                parse_file(fpath, writer)
+
+        return register_source(schema, runner, mode="static", name=name)
+
+    def runner(writer: SessionWriter):
+        seen: Dict[str, float] = {}
+        while True:
+            for fpath in _expand(path):
+                try:
+                    mtime = os.path.getmtime(fpath)
+                except OSError:
+                    continue
+                if seen.get(fpath) == mtime:
+                    continue
+                seen[fpath] = mtime
+                parse_file(fpath, writer)
+            time.sleep(poll_interval_s)
+
+    return register_source(schema, runner, mode="streaming", name=name)
+
+
+def write(table: Table, filename: str, *, format: str = "csv", **kwargs) -> None:
+    """Write the table's update stream to a file; csv/jsonlines rows carry
+    ``time`` and ``diff`` columns (reference output format,
+    src/connectors/data_format.rs DsvFormatter/JsonLinesFormatter)."""
+    from .._subscribe import subscribe
+
+    names = table.column_names
+    f = open(filename, "w", newline="")
+    state = {"writer": None}
+
+    if format == "csv":
+        w = _csv.writer(f)
+        w.writerow(names + ["time", "diff"])
+
+        def on_change(key, row, time, is_addition):
+            w.writerow([row[n] for n in names] + [time, 1 if is_addition else -1])
+
+    elif format in ("json", "jsonlines"):
+
+        def on_change(key, row, time, is_addition):
+            obj = {n: _jsonable(row[n]) for n in names}
+            obj["time"] = time
+            obj["diff"] = 1 if is_addition else -1
+            f.write(_json.dumps(obj) + "\n")
+
+    else:
+        raise ValueError(f"unknown format {format!r}")
+
+    def on_end():
+        f.flush()
+        f.close()
+
+    subscribe(table, on_change=on_change, on_end=on_end)
+
+
+def _jsonable(v):
+    import numpy as np
+
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    return v

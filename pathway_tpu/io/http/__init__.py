@@ -1,0 +1,320 @@
+"""pw.io.http — REST server connector + HTTP client writers
+(reference: python/pathway/io/http/_server.py:126-624 — PathwayWebserver,
+rest_connector, EndpointDocumentation; the serving path of every RAG/QA
+template)."""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ...internals import dtype as dt
+from ...internals.keys import Pointer
+from ...internals.parse_graph import G
+from ...internals.schema import Schema, schema_from_types
+from ...internals.table import Table
+from .._connector import SessionWriter, register_source
+from .._subscribe import subscribe
+
+__all__ = [
+    "PathwayWebserver",
+    "rest_connector",
+    "EndpointDocumentation",
+    "RestServerSubject",
+]
+
+
+@dataclass
+class EndpointDocumentation:
+    """OpenAPI metadata for a route (reference: _server.py:126)."""
+
+    summary: Optional[str] = None
+    description: Optional[str] = None
+    tags: Optional[Sequence[str]] = None
+    method_types: Optional[Sequence[str]] = None
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    if isinstance(v, Pointer):
+        return str(v)
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+class PathwayWebserver:
+    """aiohttp server running on its own thread + event loop
+    (reference: _server.py:329).  Routes are added by rest_connector before
+    ``pw.run``; the server starts in a pre-run hook."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._routes: List[Tuple[str, Sequence[str], Any, EndpointDocumentation]] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._runner = None
+        self._registered_hook = False
+
+    def _register_start_hook(self):
+        if not self._registered_hook:
+            self._registered_hook = True
+            G.pre_run_hooks.append(self.start)
+            G.post_run_hooks.append(self.stop)
+
+    def add_route(self, route: str, methods: Sequence[str], handler, documentation=None):
+        self._routes.append(
+            (route, methods, handler, documentation or EndpointDocumentation())
+        )
+        self._register_start_hook()
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        assert self._loop is not None, "webserver not started"
+        return self._loop
+
+    def openapi_description_json(self) -> Dict[str, Any]:
+        paths: Dict[str, Any] = {}
+        for route, methods, _handler, doc in self._routes:
+            entry = {}
+            for m in methods:
+                entry[m.lower()] = {
+                    "summary": doc.summary or route,
+                    "description": doc.description or "",
+                    "tags": list(doc.tags or []),
+                    "responses": {"200": {"description": "success"}},
+                }
+            paths[route] = entry
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "pathway_tpu app", "version": "1.0"},
+            "paths": paths,
+        }
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        from aiohttp import web
+
+        def run_loop():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            app = web.Application()
+            for route, methods, handler, _doc in self._routes:
+                for m in methods:
+                    app.router.add_route(m, route, handler)
+
+            async def openapi_handler(request):
+                return web.json_response(self.openapi_description_json())
+
+            app.router.add_route("GET", "/_schema", openapi_handler)
+
+            if self.with_cors:
+
+                @web.middleware
+                async def cors_middleware(request, handler):
+                    if request.method == "OPTIONS":
+                        resp = web.Response()
+                    else:
+                        resp = await handler(request)
+                    resp.headers["Access-Control-Allow-Origin"] = "*"
+                    resp.headers["Access-Control-Allow-Headers"] = "*"
+                    return resp
+
+                app.middlewares.append(cors_middleware)
+
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._runner = runner
+            self._started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run_loop, daemon=True, name="webserver")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class RestServerSubject:
+    """Bridges HTTP requests into the queries table and resolves responses
+    (reference: _server.py:490)."""
+
+    def __init__(
+        self,
+        webserver: PathwayWebserver,
+        route: str,
+        methods: Sequence[str],
+        schema: Type[Schema],
+        delete_completed_queries: bool,
+        request_validator=None,
+        documentation: Optional[EndpointDocumentation] = None,
+    ):
+        self.webserver = webserver
+        self.route = route
+        self.methods = methods
+        self.schema = schema
+        self.delete_completed_queries = delete_completed_queries
+        self.request_validator = request_validator
+        self._writer: Optional[SessionWriter] = None
+        self._futures: Dict[int, asyncio.Future] = {}
+        self._lock = threading.Lock()
+        webserver.add_route(route, methods, self._handle, documentation)
+
+    def attach_writer(self, writer: SessionWriter) -> None:
+        self._writer = writer
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        if request.method in ("POST", "PUT", "PATCH"):
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = {}
+        else:
+            payload = dict(request.query)
+        if self.request_validator is not None:
+            try:
+                self.request_validator(payload)
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+        columns = list(self.schema.columns().keys())
+        defaults = self.schema.default_values()
+        values = {}
+        for c in columns:
+            if c in payload:
+                values[c] = payload[c]
+            elif c in defaults:
+                values[c] = defaults[c]
+            else:
+                values[c] = None
+        assert self._writer is not None
+        key = self._writer.key_of({**values, "_request_seq": id(request)})
+        future = asyncio.get_event_loop().create_future()
+        with self._lock:
+            self._futures[int(key)] = future
+        self._writer.insert(values, key=key)
+        try:
+            result = await asyncio.wait_for(future, timeout=120)
+        except asyncio.TimeoutError:
+            return web.json_response({"error": "timeout"}, status=504)
+        finally:
+            with self._lock:
+                self._futures.pop(int(key), None)
+            if self.delete_completed_queries:
+                self._writer.session.remove(int(key))
+        from ...internals.error_value import is_error
+
+        if is_error(result):
+            return web.json_response(
+                {"error": getattr(result, "message", "") or "computation failed"},
+                status=500,
+            )
+        return web.json_response(_jsonable(result))
+
+    def resolve(self, key: int, value: Any) -> None:
+        with self._lock:
+            future = self._futures.get(int(key))
+        if future is not None and not future.done():
+            self.webserver.loop.call_soon_threadsafe(
+                lambda: future.set_result(value) if not future.done() else None
+            )
+
+
+class _ResponseWriter:
+    def __init__(self, subject: RestServerSubject):
+        self.subject = subject
+
+    def __call__(self, response_table: Table) -> None:
+        names = response_table.column_names
+
+        def on_change(key, row, time, is_addition):
+            if not is_addition:
+                return
+            if "result" in row:
+                value = row["result"]
+            elif len(names) == 1:
+                value = row[names[0]]
+            else:
+                value = row
+            self.subject.resolve(int(key), value)
+
+        subscribe(response_table, on_change=on_change)
+
+
+def rest_connector(
+    host: Optional[str] = None,
+    port: Optional[int] = None,
+    *,
+    webserver: Optional[PathwayWebserver] = None,
+    route: str = "/",
+    schema: Optional[Type[Schema]] = None,
+    methods: Sequence[str] = ("POST",),
+    autocommit_duration_ms: int = 50,
+    keep_queries: Optional[bool] = None,
+    delete_completed_queries: bool = True,
+    request_validator=None,
+    documentation: Optional[EndpointDocumentation] = None,
+) -> Tuple[Table, Any]:
+    """Expose a REST endpoint as a (queries_table, response_writer) pair
+    (reference: io/http/_server.py:624)."""
+    if webserver is None:
+        webserver = PathwayWebserver(host=host or "0.0.0.0", port=port or 8080)
+    if schema is None:
+        schema = schema_from_types(query=str)
+    if keep_queries is not None:
+        delete_completed_queries = not keep_queries
+
+    # sequential keys: each request row is unique
+    import types
+
+    plain_schema_cols = {
+        name: col for name, col in schema.columns().items()
+    }
+    subject = RestServerSubject(
+        webserver,
+        route,
+        methods,
+        schema,
+        delete_completed_queries,
+        request_validator,
+        documentation,
+    )
+
+    stop_event = threading.Event()
+
+    def runner(writer: SessionWriter):
+        subject.attach_writer(writer)
+        # keep the session open for the lifetime of the run
+        stop_event.wait()
+
+    G.post_run_hooks.append(stop_event.set)
+    table = register_source(schema, runner, mode="streaming", name=f"rest{route.replace('/', '_')}")
+    return table, _ResponseWriter(subject)

@@ -1,0 +1,83 @@
+"""Persistence configuration (checkpoint/resume).
+
+Reference surface: python/pathway/persistence/__init__.py:13-88 (Backend /
+Config classes) over src/persistence/ (input snapshots + operator snapshots
+through pluggable backends).  The engine-side snapshot/restore implementation
+lives in pathway_tpu/persistence/engine_state.py.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["Backend", "Config", "PersistenceMode", "SnapshotAccess"]
+
+
+class PersistenceMode(enum.Enum):
+    """(reference: engine.pyi:777-787)"""
+
+    BATCH = "batch"
+    PERSISTING = "persisting"
+    OPERATOR_PERSISTING = "operator_persisting"
+    SPEEDRUN_REPLAY = "speedrun_replay"
+    REALTIME_REPLAY = "realtime_replay"
+
+
+class SnapshotAccess(enum.Enum):
+    RECORD = "record"
+    REPLAY = "replay"
+    FULL = "full"
+    OFFSETS_ONLY = "offsets_only"
+
+
+@dataclass
+class Backend:
+    """Storage backend for snapshots (reference: persistence/__init__.py:13)."""
+
+    kind: str
+    path: Optional[str] = None
+    bucket: Optional[str] = None
+
+    @classmethod
+    def filesystem(cls, path: str) -> "Backend":
+        return cls(kind="filesystem", path=path)
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings=None) -> "Backend":
+        return cls(kind="s3", path=root_path)
+
+    @classmethod
+    def mock(cls) -> "Backend":
+        return cls(kind="mock")
+
+    def make_store(self):
+        from .backends import FileBackend, MemoryBackend
+
+        if self.kind == "filesystem":
+            return FileBackend(self.path)
+        if self.kind == "mock":
+            return MemoryBackend()
+        if self.kind == "s3":
+            raise NotImplementedError(
+                "S3 persistence backend requires an S3 client; mount the bucket "
+                "and use Backend.filesystem instead"
+            )
+        raise ValueError(self.kind)
+
+
+@dataclass
+class Config:
+    """(reference: persistence/__init__.py:88 Config.simple_config)"""
+
+    backend: Optional[Backend] = None
+    snapshot_interval_ms: int = 60000
+    persistence_mode: PersistenceMode = PersistenceMode.PERSISTING
+    snapshot_access: SnapshotAccess = SnapshotAccess.FULL
+    continue_after_replay: bool = True
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs) -> "Config":
+        return cls(backend=backend, **kwargs)

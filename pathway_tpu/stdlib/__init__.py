@@ -1,0 +1,14 @@
+"""pw.stdlib (reference: python/pathway/stdlib/ — SURVEY.md §2.9)."""
+
+from . import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils
+
+__all__ = [
+    "graphs",
+    "indexing",
+    "ml",
+    "ordered",
+    "stateful",
+    "statistical",
+    "temporal",
+    "utils",
+]

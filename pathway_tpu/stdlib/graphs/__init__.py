@@ -1,0 +1,34 @@
+"""pw.graphs (reference: python/pathway/stdlib/graphs/ — louvain communities,
+bellman-ford, pagerank).  Graph algorithms over edge tables; iterative
+algorithms land together with pw.iterate."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...internals import api_reducers as reducers
+from ...internals.table import Table
+from ...internals.thisclass import this
+
+__all__ = ["Graph", "degrees", "in_degrees", "out_degrees"]
+
+
+@dataclass
+class Graph:
+    """A graph as vertex + edge tables (edges: u, v columns of pointers)."""
+
+    V: Table
+    E: Table
+
+
+def out_degrees(edges: Table) -> Table:
+    return edges.groupby(edges.u).reduce(u=this.u, degree=reducers.count())
+
+
+def in_degrees(edges: Table) -> Table:
+    return edges.groupby(edges.v).reduce(v=this.v, degree=reducers.count())
+
+
+def degrees(edges: Table) -> Table:
+    sym = edges.select(a=this.u).concat_reindex(edges.select(a=this.v))
+    return sym.groupby(sym.a).reduce(a=this.a, degree=reducers.count())

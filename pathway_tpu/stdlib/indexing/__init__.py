@@ -1,0 +1,52 @@
+"""pw.indexing — DataIndex over device-resident retrieval indexes
+(reference: python/pathway/stdlib/indexing/ — data_index.py:278,
+nearest_neighbors.py, bm25.py, hybrid_index.py).
+
+Populated by the index milestone: see data_index.py / nearest_neighbors.py /
+bm25.py / hybrid_index.py in this package."""
+
+from __future__ import annotations
+
+try:
+    from .data_index import DataIndex, InnerIndex
+    from .nearest_neighbors import BruteForceKnn, BruteForceKnnFactory, TpuKnn, TpuKnnFactory, USearchKnn, UsearchKnnFactory, LshKnn, LshKnnFactory
+    from .bm25 import TantivyBM25, TantivyBM25Factory, BM25Index
+    from .hybrid_index import HybridIndex, HybridIndexFactory
+    from .vector_document_index import (
+        default_brute_force_knn_document_index,
+        default_lsh_knn_document_index,
+        default_usearch_knn_document_index,
+        default_vector_document_index,
+    )
+    from .retrievers import (
+        AbstractRetrieverFactory,
+        BruteForceKnnMetricKind,
+        USearchMetricKind,
+    )
+except ImportError:  # pragma: no cover - during incremental build
+    pass
+
+from . import sorting
+
+__all__ = [
+    "DataIndex",
+    "InnerIndex",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "TpuKnn",
+    "TpuKnnFactory",
+    "USearchKnn",
+    "UsearchKnnFactory",
+    "LshKnn",
+    "LshKnnFactory",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "BM25Index",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "default_vector_document_index",
+    "default_brute_force_knn_document_index",
+    "default_usearch_knn_document_index",
+    "default_lsh_knn_document_index",
+    "sorting",
+]

@@ -1,0 +1,24 @@
+"""Sorted-structure helpers (reference: stdlib/indexing/sorting.py:230 —
+binsearch trees over tables).  Host-side sorted lookup utilities used by the
+asof machinery; full tree API lands with pw.iterate."""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, List, Tuple
+
+__all__ = ["binsearch_lower", "binsearch_upper"]
+
+
+def binsearch_lower(sorted_pairs: List[Tuple[Any, Any]], key: Any):
+    """Largest entry with k <= key (None if none)."""
+    keys = [k for k, _ in sorted_pairs]
+    i = bisect.bisect_right(keys, key) - 1
+    return sorted_pairs[i][1] if i >= 0 else None
+
+
+def binsearch_upper(sorted_pairs: List[Tuple[Any, Any]], key: Any):
+    """Smallest entry with k >= key (None if none)."""
+    keys = [k for k, _ in sorted_pairs]
+    i = bisect.bisect_left(keys, key)
+    return sorted_pairs[i][1] if i < len(sorted_pairs) else None

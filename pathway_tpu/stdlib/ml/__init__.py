@@ -1,0 +1,18 @@
+"""pw.ml (reference: python/pathway/stdlib/ml/ — KNNIndex, LSH, classifiers,
+smart_table_ops).  Populated by the index milestone (index.py, _knn_lsh.py,
+classifiers.py)."""
+
+from __future__ import annotations
+
+try:
+    from . import index
+    from .index import KNNIndex
+except ImportError:  # pragma: no cover - during incremental build
+    pass
+
+try:
+    from . import classifiers
+except ImportError:  # pragma: no cover
+    pass
+
+__all__ = ["index", "KNNIndex", "classifiers"]

@@ -1,0 +1,18 @@
+"""pw.utils (reference: python/pathway/stdlib/utils/)."""
+
+from . import col
+from .col import apply_all_rows, flatten_column, multiapply_all_rows, unpack_col
+
+try:  # AsyncTransformer depends only on stdlib pieces but import defensively
+    from .async_transformer import AsyncTransformer
+except ImportError:  # pragma: no cover
+    AsyncTransformer = None
+
+__all__ = [
+    "col",
+    "unpack_col",
+    "apply_all_rows",
+    "multiapply_all_rows",
+    "flatten_column",
+    "AsyncTransformer",
+]

@@ -1,0 +1,22 @@
+"""pw.universes — universe promises
+(reference: python/pathway/internals/universes.py)."""
+
+from __future__ import annotations
+
+from .internals.table import Table
+
+__all__ = ["promise_are_equal", "promise_are_pairwise_disjoint", "promise_is_subset_of"]
+
+
+def promise_are_equal(*tables: Table) -> None:
+    for t in tables[1:]:
+        tables[0]._universe.promise_equal(t._universe)
+
+
+def promise_is_subset_of(subset: Table, superset: Table) -> None:
+    subset._universe = superset._universe.subuniverse()
+
+
+def promise_are_pairwise_disjoint(*tables: Table) -> None:
+    # bookkeeping only; concat validates at runtime
+    return None

@@ -1,0 +1,21 @@
+import os
+
+# Virtual 8-device CPU mesh for sharding tests (tests never need the real TPU;
+# the driver benchmarks separately on hardware).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    import pathway_tpu as pw
+
+    pw.reset()
+    yield
+    pw.reset()

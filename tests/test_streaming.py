@@ -1,0 +1,217 @@
+"""Incremental correctness: drive the executor tick by tick and check that
+streaming results (with updates/retractions) converge to the batch answer —
+the reference's own core test property (SURVEY.md §4.2, tests/utils.py:246)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.executor import Executor
+from pathway_tpu.engine.operators.io import InputSession, SourceOperator
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.keys import ref_scalar
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+
+def make_stream_table(**types):
+    """A table fed by a manual session; returns (table, session, columns)."""
+    names = list(types.keys())
+    dtypes = {k: dt.wrap(v) for k, v in types.items()}
+    session = InputSession(upsert=True)
+    et = pw.G.engine_graph.add_table(names, "stream")
+    pw.G.engine_graph.add_operator(SourceOperator(et, session, dtypes, name="stream"))
+    return Table(et, dtypes, Universe(), short_name="stream"), session
+
+
+def make_executor():
+    ex = Executor(pw.G.engine_graph)
+    pw.G.engine_graph.finalize()
+    return ex
+
+
+def rows_of(table):
+    keys, cols = table._materialize()
+    names = sorted(cols.keys())
+    return sorted(
+        tuple(cols[n][i] for n in names) for i in range(len(keys))
+    )
+
+
+def test_streaming_filter_updates():
+    t, session = make_stream_table(v=int)
+    out = t.filter(pw.this.v > 10)
+    ex = make_executor()
+
+    session.insert(int(ref_scalar(1)), (5,))
+    session.insert(int(ref_scalar(2)), (20,))
+    ex.step()
+    assert rows_of(out) == [(20,)]
+
+    # update row 1 to pass the filter, row 2 to fail it
+    session.insert(int(ref_scalar(1)), (15,))
+    session.insert(int(ref_scalar(2)), (3,))
+    ex.step()
+    assert rows_of(out) == [(15,)]
+
+    # delete row 1
+    session.remove(int(ref_scalar(1)))
+    ex.step()
+    assert rows_of(out) == []
+
+
+def test_streaming_groupby_updates():
+    t, session = make_stream_table(k=str, v=int)
+    out = t.groupby(pw.this.k).reduce(
+        k=pw.this.k, s=pw.reducers.sum(pw.this.v), c=pw.reducers.count()
+    )
+    ex = make_executor()
+
+    session.insert(int(ref_scalar(1)), ("a", 1))
+    session.insert(int(ref_scalar(2)), ("a", 2))
+    session.insert(int(ref_scalar(3)), ("b", 10))
+    ex.step()
+    # rows_of orders columns alphabetically: (c, k, s)
+    assert rows_of(out) == [(1, "b", 10), (2, "a", 3)]
+
+    # move row 2 from group a to group b
+    session.insert(int(ref_scalar(2)), ("b", 2))
+    ex.step()
+    assert rows_of(out) == [(1, "a", 1), (2, "b", 12)]
+
+    # delete last row of group a -> group disappears
+    session.remove(int(ref_scalar(1)))
+    ex.step()
+    assert rows_of(out) == [(2, "b", 12)]
+
+
+def test_streaming_min_max_retraction():
+    t, session = make_stream_table(v=int)
+    out = t.reduce(mn=pw.reducers.min(pw.this.v), mx=pw.reducers.max(pw.this.v))
+    ex = make_executor()
+
+    for i, v in enumerate([5, 1, 9]):
+        session.insert(int(ref_scalar(i)), (v,))
+    ex.step()
+    assert rows_of(out) == [(1, 9)]
+
+    session.remove(int(ref_scalar(1)))  # remove v=1
+    ex.step()
+    assert rows_of(out) == [(5, 9)]
+
+    session.remove(int(ref_scalar(2)))  # remove v=9
+    ex.step()
+    assert rows_of(out) == [(5, 5)]
+
+
+def test_streaming_join_updates():
+    l, lsession = make_stream_table(a=int, b=str)
+    r, rsession = make_stream_table(a=int, c=str)
+    out = l.join(r, l.a == r.a).select(l.b, r.c)
+    ex = make_executor()
+
+    lsession.insert(int(ref_scalar(1)), (1, "x"))
+    ex.step()
+    assert rows_of(out) == []
+
+    rsession.insert(int(ref_scalar(10)), (1, "foo"))
+    ex.step()
+    assert rows_of(out) == [("x", "foo")]
+
+    # second right match
+    rsession.insert(int(ref_scalar(11)), (1, "bar"))
+    ex.step()
+    assert rows_of(out) == [("x", "bar"), ("x", "foo")]
+
+    # retract left row -> all matches disappear
+    lsession.remove(int(ref_scalar(1)))
+    ex.step()
+    assert rows_of(out) == []
+
+
+def test_streaming_left_join_padding_transitions():
+    l, lsession = make_stream_table(a=int, b=str)
+    r, rsession = make_stream_table(a=int, c=str)
+    out = l.join_left(r, l.a == r.a).select(l.b, r.c)
+    ex = make_executor()
+
+    lsession.insert(int(ref_scalar(1)), (1, "x"))
+    ex.step()
+    assert rows_of(out) == [("x", None)]
+
+    rsession.insert(int(ref_scalar(10)), (1, "foo"))
+    ex.step()
+    assert rows_of(out) == [("x", "foo")]
+
+    rsession.remove(int(ref_scalar(10)))
+    ex.step()
+    assert rows_of(out) == [("x", None)]
+
+
+def test_streaming_asof_now_join_does_not_update():
+    q, qsession = make_stream_table(a=int)
+    d, dsession = make_stream_table(a=int, v=str)
+    out = q.asof_now_join(d, q.a == d.a, how=pw.JoinMode.LEFT).select(q.a, d.v)
+    ex = make_executor()
+
+    dsession.insert(int(ref_scalar(100)), (1, "old"))
+    ex.step()
+
+    qsession.insert(int(ref_scalar(1)), (1,))
+    ex.step()
+    assert rows_of(out) == [(1, "old")]
+
+    # data changes AFTER the query: asof_now result must NOT update
+    dsession.insert(int(ref_scalar(100)), (1, "new"))
+    ex.step()
+    assert rows_of(out) == [(1, "old")]
+
+    # but a new query sees the new state
+    qsession.insert(int(ref_scalar(2)), (1,))
+    ex.step()
+    assert sorted(rows_of(out)) == [(1, "new"), (1, "old")]
+
+
+def test_streaming_equals_batch_randomized():
+    """Random upsert/delete workload: final streaming state == batch rebuild."""
+    import random
+
+    rng = random.Random(7)
+    t, session = make_stream_table(k=str, v=int)
+    out = t.groupby(pw.this.k).reduce(
+        k=pw.this.k,
+        s=pw.reducers.sum(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        vs=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    ex = make_executor()
+
+    state = {}
+    for step in range(30):
+        for _ in range(rng.randint(1, 5)):
+            rid = rng.randint(0, 9)
+            if rng.random() < 0.25 and state:
+                victim = rng.choice(list(state))
+                session.remove(int(ref_scalar(victim)))
+                state.pop(victim, None)
+            else:
+                k = rng.choice("abc")
+                v = rng.randint(0, 100)
+                session.insert(int(ref_scalar(rid)), (k, v))
+                state[rid] = (k, v)
+        ex.step()
+
+    # batch recomputation
+    expected = {}
+    for k, v in state.values():
+        e = expected.setdefault(k, [0, None, []])
+        e[0] += v
+        e[1] = v if e[1] is None else max(e[1], v)
+        e[2].append(v)
+    exp_rows = sorted(
+        (k, e[0], e[1], tuple(sorted(e[2]))) for k, e in expected.items()
+    )
+    got = rows_of(out)
+    # column order is alphabetical: k, mx, s, vs
+    got_norm = sorted((r[0], r[2], r[1], r[3]) for r in got)
+    assert got_norm == exp_rows

@@ -1,0 +1,206 @@
+"""Temporal stdlib tests (windows, interval/asof/window joins)
+(reference suites: python/pathway/tests/temporal/)."""
+
+import pytest
+
+import pathway_tpu as pw
+from .utils import T, assert_rows
+
+
+def test_tumbling_window():
+    t = T("""
+      | t  | v
+    1 | 1  | 10
+    2 | 3  | 20
+    3 | 11 | 5
+    4 | 12 | 7
+    """)
+    out = pw.temporal.windowby(
+        t, t.t, window=pw.temporal.tumbling(duration=10)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+        c=pw.reducers.count(),
+    )
+    assert_rows(out, [
+        {"start": 0.0, "s": 30, "c": 2},
+        {"start": 10.0, "s": 12, "c": 2},
+    ])
+
+
+def test_sliding_window():
+    t = T("""
+      | t | v
+    1 | 5 | 1
+    """)
+    out = pw.temporal.windowby(
+        t, t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    # t=5 is in windows starting at 2 and 4
+    assert_rows(out, [{"start": 2.0, "c": 1}, {"start": 4.0, "c": 1}])
+
+
+def test_session_window():
+    t = T("""
+      | t  | v
+    1 | 1  | 1
+    2 | 2  | 2
+    3 | 10 | 3
+    4 | 11 | 4
+    """)
+    out = pw.temporal.windowby(
+        t, t.t, window=pw.temporal.session(max_gap=3)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert_rows(out, [
+        {"start": 1.0, "end": 2.0, "s": 3},
+        {"start": 10.0, "end": 11.0, "s": 7},
+    ])
+
+
+def test_interval_join_inner():
+    l = T("""
+      | t | a
+    1 | 10 | x
+    2 | 20 | y
+    """)
+    r = T("""
+      | t | b
+    1 | 9  | p
+    2 | 12 | q
+    3 | 25 | s
+    """)
+    out = pw.temporal.interval_join(
+        l, r, l.t, r.t, pw.temporal.interval(-2, 2)
+    ).select(l.a, r.b)
+    assert_rows(out, [
+        {"a": "x", "b": "p"},  # 9 in [8,12]
+        {"a": "x", "b": "q"},  # 12 in [8,12]
+    ])
+
+
+def test_interval_join_left_pads():
+    l = T("""
+      | t | a
+    1 | 10 | x
+    2 | 50 | y
+    """)
+    r = T("""
+      | t | b
+    1 | 9 | p
+    """)
+    out = pw.temporal.interval_join_left(
+        l, r, l.t, r.t, pw.temporal.interval(-2, 2)
+    ).select(l.a, r.b)
+    assert_rows(out, [
+        {"a": "x", "b": "p"},
+        {"a": "y", "b": None},
+    ])
+
+
+def test_asof_join():
+    trades = T("""
+      | t  | px
+    1 | 10 | 100
+    2 | 20 | 105
+    """)
+    quotes = T("""
+      | t  | bid
+    1 | 5  | 99
+    2 | 15 | 103
+    3 | 30 | 110
+    """)
+    out = pw.temporal.asof_join(
+        trades, quotes, trades.t, quotes.t
+    ).select(trades.px, quotes.bid)
+    # trade@10 -> quote@5 (99); trade@20 -> quote@15 (103)
+    assert_rows(out, [
+        {"px": 100, "bid": 99},
+        {"px": 105, "bid": 103},
+    ])
+
+
+def test_asof_join_with_key_different_names():
+    trades = T("""
+      | sym | t  | px
+    1 | A   | 10 | 1
+    2 | B   | 10 | 2
+    """)
+    quotes = T("""
+      | ticker | t | bid
+    1 | A      | 5 | 50
+    2 | B      | 6 | 60
+    """)
+    out = pw.temporal.asof_join(
+        trades, quotes, trades.t, quotes.t, trades.sym == quotes.ticker
+    ).select(trades.px, quotes.bid)
+    assert_rows(out, [{"px": 1, "bid": 50}, {"px": 2, "bid": 60}])
+
+
+def test_window_join():
+    l = T("""
+      | t | a
+    1 | 1 | x
+    2 | 11 | y
+    """)
+    r = T("""
+      | t | b
+    1 | 2 | p
+    2 | 19 | q
+    """)
+    out = pw.temporal.window_join(
+        l, r, l.t, r.t, pw.temporal.tumbling(10)
+    ).select(l.a, r.b)
+    assert_rows(out, [
+        {"a": "x", "b": "p"},   # both in [0,10)
+        {"a": "y", "b": "q"},   # both in [10,20)
+    ])
+
+
+def test_intervals_over():
+    data = T("""
+      | t | v
+    1 | 1 | 10
+    2 | 2 | 20
+    3 | 9 | 30
+    """)
+    probes = T("""
+      | at
+    1 | 2
+    2 | 100
+    """)
+    out = pw.temporal.windowby(
+        data,
+        data.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-2, upper_bound=2, is_outer=True
+        ),
+    ).reduce(
+        loc=pw.this._pw_window_location,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert_rows(out, [
+        {"loc": 2, "s": 30},      # t=1,2 in [0,4]
+        {"loc": 100, "s": None},  # empty outer window
+    ])
+
+
+def test_diff_and_interpolate():
+    t = T("""
+      | t | v
+    1 | 1 | 10
+    2 | 2 | 13
+    3 | 3 | 20
+    """)
+    out = pw.stdlib.ordered.diff(t, t.t, t.v)
+    assert_rows(out, [
+        {"timestamp": 1, "diff_v": None},
+        {"timestamp": 2, "diff_v": 3},
+        {"timestamp": 3, "diff_v": 7},
+    ])

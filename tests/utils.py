@@ -1,0 +1,75 @@
+"""Test helpers (reference: python/pathway/tests/utils.py — T(),
+assert_table_equality[_wo_index], stream assertion helpers)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import pathway_tpu as pw
+
+
+def T(txt: str, **kwargs) -> pw.Table:
+    return pw.debug.table_from_markdown(txt, **kwargs)
+
+
+def _norm_value(v: Any):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return ("__arr__",) + tuple(np.asarray(v).ravel().tolist())
+    if isinstance(v, tuple):
+        return tuple(_norm_value(x) for x in v)
+    if isinstance(v, float) and v == int(v):
+        return v
+    return v
+
+
+def _materialize(table: pw.Table) -> Dict[int, Tuple]:
+    keys, columns = table._materialize()
+    names = sorted(columns.keys())
+    return {
+        int(k): tuple(_norm_value(columns[n][i]) for n in names)
+        for i, k in enumerate(keys)
+    }, names
+
+
+def run_all():
+    pw.run(monitoring_level=None)
+
+
+def assert_table_equality(a: pw.Table, b: pw.Table) -> None:
+    run_all()
+    rows_a, names_a = _materialize(a)
+    rows_b, names_b = _materialize(b)
+    assert names_a == names_b, f"columns differ: {names_a} vs {names_b}"
+    assert rows_a == rows_b, f"tables differ:\n{rows_a}\nvs\n{rows_b}"
+
+
+def assert_table_equality_wo_index(a: pw.Table, b: pw.Table) -> None:
+    run_all()
+    rows_a, names_a = _materialize(a)
+    rows_b, names_b = _materialize(b)
+    assert names_a == names_b, f"columns differ: {names_a} vs {names_b}"
+    sa = sorted(rows_a.values(), key=repr)
+    sb = sorted(rows_b.values(), key=repr)
+    assert sa == sb, f"tables differ (wo index):\n{sa}\nvs\n{sb}"
+
+
+def assert_rows(table: pw.Table, expected: List[Dict[str, Any]]) -> None:
+    """Compare table contents to expected row dicts, ignoring keys/order."""
+    run_all()
+    keys, columns = table._materialize()
+    names = list(columns.keys())
+    actual = sorted(
+        (
+            tuple(_norm_value(columns[n][i]) for n in sorted(names))
+            for i in range(len(keys))
+        ),
+        key=repr,
+    )
+    exp = sorted(
+        (tuple(_norm_value(r[n]) for n in sorted(names)) for r in expected), key=repr
+    )
+    assert actual == exp, f"rows differ:\n{actual}\nvs expected\n{exp}"
