@@ -57,6 +57,7 @@ from .internals.sql import sql  # noqa: E402
 from .internals.config import PathwayConfig, get_config, set_license_key  # noqa: E402
 from .internals.monitoring import MonitoringLevel  # noqa: E402
 from . import persistence  # noqa: E402
+from . import parallel  # noqa: E402
 from . import stdlib  # noqa: E402
 from .stdlib import indexing, ml, temporal, utils, stateful, graphs  # noqa: E402
 from .stdlib.temporal import asof_join, interval_join, window_join, windowby  # noqa: E402
@@ -143,6 +144,21 @@ def iterate(func, iteration_limit: int = 128, **kwargs):
     raise NotImplementedError(
         "pw.iterate is not yet available in pathway_tpu; see ROADMAP"
     )
+
+
+# Heavy subpackages (flax model zoo, LLM xpack, device kernels) load lazily
+# so plain ETL pipelines don't pay the model-stack import cost (PEP 562).
+_LAZY_SUBMODULES = ("xpacks", "models", "ops")
+
+
+def __getattr__(name: str):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        module = importlib.import_module(f".{name}", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 # Type aliases exposed like reference pw.*

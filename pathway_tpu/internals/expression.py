@@ -397,7 +397,22 @@ class ApplyExpression(ColumnExpression):
         kwarg_arrays = {k: v._eval(ctx) for k, v in self._kwargs.items()}
         if self._batched:
             result = self._fun(*arg_arrays, **kwarg_arrays)
-            result = np.asarray(result) if not isinstance(result, np.ndarray) else result
+            if not isinstance(result, np.ndarray):
+                try:
+                    import jax
+
+                    if isinstance(result, jax.Array):
+                        result = np.asarray(result)
+                except ImportError:  # pragma: no cover
+                    pass
+            if not isinstance(result, np.ndarray):
+                result = np.asarray(result)
+            if result.ndim > 1:
+                # batched fn returned [B, ...]: column cells are row slices
+                out = np.empty(result.shape[0], dtype=object)
+                for i in range(result.shape[0]):
+                    out[i] = result[i]
+                return out
             return result
         from .error_value import ERROR, Error, is_error
 

@@ -1,0 +1,26 @@
+"""pw.models — TPU-native model zoo backing the LLM xpack.
+
+The reference delegates local inference to torch libraries
+(sentence_transformers SentenceTransformer/CrossEncoder, transformers
+pipeline — xpacks/llm/embedders.py:270, rerankers.py:186, llms.py:441).
+Here the equivalents are flax modules compiled by XLA and batched by
+construction; weights load from a local checkpoint directory when given and
+fall back to deterministic random init (useful for benchmarks and tests —
+this environment has zero egress, so nothing downloads)."""
+
+from .tokenizer import HashTokenizer
+from .transformer import TransformerConfig, TransformerEncoder
+from .encoder import SentenceEncoder
+from .cross_encoder import CrossEncoderModel
+from .generator import TextGenerator
+from .clip import ClipModel
+
+__all__ = [
+    "HashTokenizer",
+    "TransformerConfig",
+    "TransformerEncoder",
+    "SentenceEncoder",
+    "CrossEncoderModel",
+    "TextGenerator",
+    "ClipModel",
+]

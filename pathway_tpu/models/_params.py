@@ -1,0 +1,13 @@
+"""Parameter pytree helpers (unboxing flax logical-partitioning metadata)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+__all__ = ["unbox"]
+
+
+def unbox(params):
+    """Strip flax Partitioned/LogicallyPartitioned boxes so params are plain
+    arrays (sharding is applied via jit shardings / device_put instead)."""
+    return nn.meta.unbox(params)

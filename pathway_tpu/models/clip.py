@@ -1,0 +1,174 @@
+"""ClipModel — dual text/image encoder for multimodal retrieval
+(BASELINE.json config 3: multimodal CLIP streaming index; the reference uses
+API/torch CLIP via its embedder UDFs).  Patchified image transformer + text
+transformer projected into one space; both batched jit forwards."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._params import unbox as _unbox
+
+from .tokenizer import HashTokenizer
+from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
+
+__all__ = ["ClipModel"]
+
+
+class _ImageEncoder(nn.Module):
+    config: TransformerConfig
+    patch: int = 16
+    image_size: int = 64
+
+    @nn.compact
+    def __call__(self, images):  # [B, H, W, C] float32 in [0,1]
+        cfg = self.config
+        B = images.shape[0]
+        x = nn.Conv(
+            cfg.d_model,
+            kernel_size=(self.patch, self.patch),
+            strides=(self.patch, self.patch),
+            dtype=cfg.dtype,
+            name="patchify",
+        )(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.d_model)
+        L = x.shape[1]
+        pos = nn.Embed(L, cfg.d_model, dtype=cfg.dtype, name="pos")(
+            jnp.arange(L)[None, :]
+        )
+        x = x + pos
+        mask = jnp.ones((B, L), jnp.int32)
+        from .transformer import EncoderBlock
+
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, mask)
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        return jnp.mean(x, axis=1).astype(jnp.float32)
+
+
+class _ClipModule(nn.Module):
+    config: TransformerConfig
+    image_size: int
+    patch: int
+    proj_dim: int
+
+    @nn.compact
+    def __call__(self, ids, mask, images):
+        text = TransformerEncoder(self.config, name="text")(ids, mask)
+        image = _ImageEncoder(
+            self.config, patch=self.patch, image_size=self.image_size, name="image"
+        )(images)
+        tproj = nn.Dense(self.proj_dim, name="text_proj")(text)
+        iproj = nn.Dense(self.proj_dim, name="image_proj")(image)
+        return tproj, iproj
+
+
+class ClipModel:
+    def __init__(
+        self,
+        model: str = "pathway-mini-clip",
+        dimension: int = 256,
+        proj_dim: int = 256,
+        n_layers: int = 4,
+        n_heads: int = 4,
+        image_size: int = 64,
+        patch: int = 16,
+        max_length: int = 64,
+        vocab_size: int = 32768,
+        seed: int = 3,
+        dtype=jnp.bfloat16,
+    ):
+        self.config = TransformerConfig(
+            vocab_size=vocab_size,
+            d_model=dimension,
+            n_heads=resolve_heads(dimension, n_heads),
+            n_layers=n_layers,
+            d_ff=dimension * 4,
+            max_len=max_length,
+            dtype=dtype,
+            pool="mean",
+        )
+        self.image_size = image_size
+        self.proj_dim = proj_dim
+        self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
+        self.module = _ClipModule(self.config, image_size, patch, proj_dim)
+        self._lock = threading.Lock()
+        self._text_fns: Dict[tuple, Any] = {}
+        self._image_fns: Dict[tuple, Any] = {}
+        ids = jnp.zeros((1, 16), jnp.int32)
+        mask = jnp.ones((1, 16), jnp.int32)
+        imgs = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+        self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask, imgs)[
+            "params"
+        ]
+        self.params = _unbox(self.params)
+
+    def get_embedding_dimension(self) -> int:
+        return self.proj_dim
+
+    def encode_text(self, texts: Sequence[str]) -> np.ndarray:
+        with self._lock:
+            n = len(texts)
+            if n == 0:
+                return np.zeros((0, self.proj_dim), np.float32)
+            from .encoder import _bucket
+
+            b = _bucket(n)
+            padded = [str(t) for t in texts] + [""] * (b - n)
+            ids, mask = self.tokenizer.encode_batch(padded)
+            key = ids.shape
+            fn = self._text_fns.get(key)
+            if fn is None:
+                module = self.module
+                image_size = self.image_size
+
+                @jax.jit
+                def fn(params, ids, mask):
+                    dummy = jnp.zeros((ids.shape[0], image_size, image_size, 3), jnp.float32)
+                    t, _ = module.apply({"params": params}, ids, mask, dummy)
+                    return t / jnp.maximum(jnp.linalg.norm(t, axis=-1, keepdims=True), 1e-9)
+
+                self._text_fns[key] = fn
+            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            return np.asarray(out)[:n]
+
+    def encode_image(self, images: Sequence[np.ndarray]) -> np.ndarray:
+        with self._lock:
+            n = len(images)
+            if n == 0:
+                return np.zeros((0, self.proj_dim), np.float32)
+            from .encoder import _bucket
+
+            b = _bucket(n)
+            S = self.image_size
+            batch = np.zeros((b, S, S, 3), np.float32)
+            for i, img in enumerate(images):
+                img = np.asarray(img, dtype=np.float32)
+                if img.ndim == 2:
+                    img = np.stack([img] * 3, axis=-1)
+                h, w = img.shape[:2]
+                hh, ww = min(h, S), min(w, S)
+                batch[i, :hh, :ww, :] = img[:hh, :ww, :3]
+            key = (b,)
+            fn = self._image_fns.get(key)
+            if fn is None:
+                module = self.module
+
+                @jax.jit
+                def fn(params, imgs):
+                    ids = jnp.zeros((imgs.shape[0], 16), jnp.int32)
+                    mask = jnp.ones((imgs.shape[0], 16), jnp.int32)
+                    _, im = module.apply({"params": params}, ids, mask, imgs)
+                    return im / jnp.maximum(
+                        jnp.linalg.norm(im, axis=-1, keepdims=True), 1e-9
+                    )
+
+                self._image_fns[key] = fn
+            out = fn(self.params, jnp.asarray(batch))
+            return np.asarray(out)[:n]

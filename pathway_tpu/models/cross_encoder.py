@@ -1,0 +1,94 @@
+"""Cross-encoder pair scorer — batched (query, doc) -> relevance score.
+
+TPU-native replacement for sentence_transformers CrossEncoder
+(reference: xpacks/llm/rerankers.py:186 CrossEncoderReranker): both texts in
+one sequence separated by [SEP], encoder trunk + regression head, one jitted
+forward per padded batch."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._params import unbox as _unbox
+
+from .tokenizer import HashTokenizer
+from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
+
+__all__ = ["CrossEncoderModel"]
+
+
+class _CrossEncoderModule(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        pooled = TransformerEncoder(self.config, name="trunk")(ids, mask)
+        h = nn.Dense(self.config.d_model, name="head_dense")(pooled)
+        h = nn.tanh(h)
+        return nn.Dense(1, name="head_out")(h)[:, 0]
+
+
+class CrossEncoderModel:
+    def __init__(
+        self,
+        model: str = "pathway-mini-cross",
+        dimension: int = 256,
+        n_layers: int = 4,
+        n_heads: int = 4,
+        max_length: int = 256,
+        vocab_size: int = 32768,
+        seed: int = 1,
+        checkpoint_path: Optional[str] = None,
+        dtype=jnp.bfloat16,
+    ):
+        self.config = TransformerConfig(
+            vocab_size=vocab_size,
+            d_model=dimension,
+            n_heads=resolve_heads(dimension, n_heads),
+            n_layers=n_layers,
+            d_ff=dimension * 4,
+            max_len=max_length,
+            dtype=dtype,
+            pool="mean",
+        )
+        self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
+        self.module = _CrossEncoderModule(self.config)
+        self._lock = threading.Lock()
+        self._fns: Dict[tuple, Any] = {}
+        ids = jnp.zeros((1, 16), jnp.int32)
+        mask = jnp.ones((1, 16), jnp.int32)
+        self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask)["params"]
+        self.params = _unbox(self.params)
+
+    def _forward_fn(self, shape):
+        fn = self._fns.get(shape)
+        if fn is None:
+            fn = jax.jit(
+                lambda params, ids, mask: self.module.apply(
+                    {"params": params}, ids, mask
+                )
+            )
+            self._fns[shape] = fn
+        return fn
+
+    def predict(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
+        """[(query, doc)] -> scores [B] float32."""
+        with self._lock:
+            n = len(pairs)
+            if n == 0:
+                return np.zeros((0,), np.float32)
+            from .encoder import _bucket
+
+            b = _bucket(n)
+            qs = [str(p[0]) for p in pairs] + [""] * (b - n)
+            ds = [str(p[1]) for p in pairs] + [""] * (b - n)
+            ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
+            fn = self._forward_fn(ids.shape)
+            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            return np.asarray(out, dtype=np.float32)[:n]

@@ -1,0 +1,151 @@
+"""SentenceEncoder — batched text -> embedding on TPU.
+
+The TPU-native replacement for the reference's SentenceTransformerEmbedder
+hot path (xpacks/llm/embedders.py:270-330, which calls ``model.encode`` one
+string at a time): batches are tokenized once, padded to bucketed shapes,
+and run through one jitted flax forward per micro-batch.  Params can shard
+over the mesh "model" axis; batches shard over "data".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._params import unbox as _unbox
+
+from .tokenizer import HashTokenizer
+from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
+
+__all__ = ["SentenceEncoder"]
+
+_BATCH_BUCKETS = (1, 4, 16, 64, 256)
+
+
+def _bucket(n: int, buckets=_BATCH_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return ((n + 255) // 256) * 256
+
+
+class SentenceEncoder:
+    def __init__(
+        self,
+        model: str = "pathway-mini",
+        dimension: int = 384,
+        n_layers: int = 6,
+        n_heads: int = 6,
+        max_length: int = 128,
+        vocab_size: int = 32768,
+        seed: int = 0,
+        checkpoint_path: Optional[str] = None,
+        mesh=None,
+        dtype=jnp.bfloat16,
+        normalize: bool = True,
+    ):
+        self.model_name = model
+        self.config = TransformerConfig(
+            vocab_size=vocab_size,
+            d_model=dimension,
+            n_heads=resolve_heads(dimension, n_heads),
+            n_layers=n_layers,
+            d_ff=dimension * 4,
+            max_len=max_length,
+            dtype=dtype,
+            pool="mean",
+        )
+        self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
+        self.module = TransformerEncoder(self.config)
+        self.normalize = normalize
+        self.mesh = mesh
+        self._lock = threading.Lock()
+        self._fns: Dict[tuple, Any] = {}
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            self.params = self._load_checkpoint(checkpoint_path)
+        else:
+            ids = jnp.zeros((1, 16), jnp.int32)
+            mask = jnp.ones((1, 16), jnp.int32)
+            self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask)[
+                "params"
+            ]
+        self.params = _unbox(self.params)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.params = jax.device_put(
+                self.params, NamedSharding(mesh, P())
+            )
+
+    def _load_checkpoint(self, path: str):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(os.path.abspath(path))
+
+    def save_checkpoint(self, path: str) -> None:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path), self.params)
+        ckptr.wait_until_finished()
+
+    def get_embedding_dimension(self) -> int:
+        return self.config.d_model
+
+    def _forward_fn(self, batch: int, length: int):
+        key = (batch, length)
+        fn = self._fns.get(key)
+        if fn is None:
+            module = self.module
+            normalize = self.normalize
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                data_sharding = NamedSharding(self.mesh, P("data", None))
+
+                @jax.jit
+                def fn(params, ids, mask):
+                    ids = jax.lax.with_sharding_constraint(ids, data_sharding)
+                    out = module.apply({"params": params}, ids, mask)
+                    if normalize:
+                        out = out / jnp.maximum(
+                            jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-9
+                        )
+                    return out
+
+            else:
+
+                @jax.jit
+                def fn(params, ids, mask):
+                    out = module.apply({"params": params}, ids, mask)
+                    if normalize:
+                        out = out / jnp.maximum(
+                            jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-9
+                        )
+                    return out
+
+            self._fns[key] = fn
+        return self._fns[key]
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        """Batch encode: [B] strings -> [B, d] float32."""
+        with self._lock:
+            texts = ["" if t is None else str(t) for t in texts]
+            n = len(texts)
+            if n == 0:
+                return np.zeros((0, self.config.d_model), np.float32)
+            b = _bucket(n)
+            padded = list(texts) + [""] * (b - n)
+            ids, mask = self.tokenizer.encode_batch(padded)
+            fn = self._forward_fn(ids.shape[0], ids.shape[1])
+            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            return np.asarray(out)[:n]
+
+    def __call__(self, texts: Sequence[str]) -> np.ndarray:
+        return self.encode(texts)

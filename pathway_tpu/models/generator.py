@@ -1,0 +1,139 @@
+"""TextGenerator — local causal LM for chat-style generation.
+
+TPU-native analog of the reference's HFPipelineChat local generator
+(xpacks/llm/llms.py:441).  Greedy/temperature decoding runs as a
+``lax.scan`` over a fixed-size token buffer inside one jit — no per-token
+python round trips.  With random-init weights the output is noise; with a
+trained checkpoint it generates — either way the serving path, batching and
+compile behavior are the product."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._params import unbox as _unbox
+
+from .tokenizer import HashTokenizer
+from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
+
+__all__ = ["TextGenerator"]
+
+
+class TextGenerator:
+    def __init__(
+        self,
+        model: str = "pathway-mini-lm",
+        dimension: int = 256,
+        n_layers: int = 4,
+        n_heads: int = 4,
+        max_length: int = 256,
+        vocab_size: int = 32768,
+        seed: int = 2,
+        checkpoint_path: Optional[str] = None,
+        dtype=jnp.bfloat16,
+    ):
+        self.config = TransformerConfig(
+            vocab_size=vocab_size,
+            d_model=dimension,
+            n_heads=resolve_heads(dimension, n_heads),
+            n_layers=n_layers,
+            d_ff=dimension * 4,
+            max_len=max_length,
+            dtype=dtype,
+            pool="none",
+            causal=True,
+        )
+        self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
+        self.module = TransformerEncoder(self.config)
+        self._lock = threading.Lock()
+        self._fns: Dict[tuple, Any] = {}
+        ids = jnp.zeros((1, 16), jnp.int32)
+        mask = jnp.ones((1, 16), jnp.int32)
+        self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask)["params"]
+        self.params = _unbox(self.params)
+        # weight-tied readout: logits = h @ tok_embed.T
+        self._vocab_table = None
+
+    def _decode_fn(self, B: int, L: int, steps: int):
+        key = (B, L, steps)
+        fn = self._fns.get(key)
+        if fn is None:
+            module = self.module
+
+            def decode(params, ids, mask, temperature, rng):
+                emb = params["tok_embed"]["embedding"]
+
+                def step(carry, _):
+                    ids_c, mask_c, pos, rng_c = carry
+                    hidden = module.apply({"params": params}, ids_c, mask_c)
+                    logits = jnp.einsum(
+                        "bld,vd->blv", hidden.astype(jnp.float32), emb.astype(jnp.float32)
+                    )
+                    # logits at last real position of each row
+                    last = jnp.take_along_axis(
+                        logits, (pos - 1)[:, None, None], axis=1
+                    )[:, 0, :]
+                    rng_c, sub = jax.random.split(rng_c)
+                    greedy = jnp.argmax(last, axis=-1)
+                    sampled = jax.random.categorical(sub, last / jnp.maximum(temperature, 1e-4))
+                    nxt = jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+                    ids_c = jnp.take_along_axis(
+                        ids_c, jnp.arange(ids_c.shape[1])[None, :], axis=1
+                    )
+                    ids_c = jax.vmap(lambda row, p, t: row.at[p].set(t))(
+                        ids_c, pos, nxt
+                    )
+                    mask_c = jax.vmap(lambda row, p: row.at[p].set(1))(mask_c, pos)
+                    return (ids_c, mask_c, pos + 1, rng_c), nxt
+
+                (ids_f, _, _, _), toks = jax.lax.scan(
+                    step, (ids, mask, jnp.sum(mask, axis=1), rng), None, length=steps
+                )
+                return toks.T  # [B, steps]
+
+            fn = jax.jit(decode)
+            self._fns[key] = fn
+        return fn
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> List[str]:
+        with self._lock:
+            n = len(prompts)
+            if n == 0:
+                return []
+            from .encoder import _bucket
+
+            b = _bucket(n)
+            texts = [str(p) for p in prompts] + [""] * (b - n)
+            L_budget = self.config.max_len - max_new_tokens
+            ids, mask = self.tokenizer.encode_batch(texts, max_length=L_budget)
+            pad = np.zeros((ids.shape[0], max_new_tokens), np.int32)
+            ids = np.concatenate([ids, pad], axis=1)
+            mask_full = np.concatenate([mask, pad], axis=1)
+            fn = self._decode_fn(ids.shape[0], ids.shape[1], max_new_tokens)
+            toks = fn(
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(mask_full),
+                jnp.float32(temperature),
+                jax.random.PRNGKey(seed),
+            )
+            toks = np.asarray(toks)[:n]
+            # hashing tokenizer is not invertible; render token ids
+            return [
+                " ".join(f"<{int(t)}>" for t in row if t != self.tokenizer.PAD)
+                for row in toks
+            ]
+
+    def __call__(self, prompts: Sequence[str], **kwargs) -> List[str]:
+        return self.generate(prompts, **kwargs)

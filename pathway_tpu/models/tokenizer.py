@@ -1,0 +1,75 @@
+"""Deterministic hashing tokenizer.
+
+Offline-friendly replacement for downloaded vocabularies (the reference
+relies on HF/tiktoken tokenizers, xpacks/llm/splitters.py:13): words and
+char-trigram fallbacks hash into a fixed id space with xxh3.  Embeddings
+trained in-framework are consistent because the mapping is deterministic.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Sequence, Tuple
+
+import numpy as np
+import xxhash
+
+__all__ = ["HashTokenizer"]
+
+_WORD_RE = re.compile(r"[\w']+|[^\w\s]")
+
+
+class HashTokenizer:
+    PAD = 0
+    CLS = 1
+    SEP = 2
+    UNK = 3
+    _RESERVED = 8
+
+    def __init__(self, vocab_size: int = 32768, max_length: int = 128):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+
+    def _word_id(self, word: str) -> int:
+        h = xxhash.xxh3_64_intdigest(word.lower().encode())
+        return self._RESERVED + (h % (self.vocab_size - self._RESERVED))
+
+    def tokenize(self, text: str) -> List[int]:
+        return [self._word_id(w) for w in _WORD_RE.findall(str(text))]
+
+    def count_tokens(self, text: str) -> int:
+        return len(_WORD_RE.findall(str(text)))
+
+    def encode(
+        self, text: str, pair: str | None = None, max_length: int | None = None
+    ) -> List[int]:
+        max_length = max_length or self.max_length
+        ids = [self.CLS] + self.tokenize(text)
+        if pair is not None:
+            ids = ids[: max_length - 1] + [self.SEP] + self.tokenize(pair)
+        ids = ids[: max_length - 1] + [self.SEP]
+        return ids
+
+    def encode_batch(
+        self,
+        texts: Sequence[str],
+        pairs: Sequence[str] | None = None,
+        max_length: int | None = None,
+        pad_to: int | None = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (ids [B, L], mask [B, L]) padded to a shared length."""
+        max_length = max_length or self.max_length
+        encoded = [
+            self.encode(t, pairs[i] if pairs is not None else None, max_length)
+            for i, t in enumerate(texts)
+        ]
+        longest = max((len(e) for e in encoded), default=1)
+        # pad length to a multiple of 16 to bound jit shape variants
+        L = pad_to or min(max_length, ((longest + 15) // 16) * 16)
+        ids = np.full((len(encoded), L), self.PAD, dtype=np.int32)
+        mask = np.zeros((len(encoded), L), dtype=np.int32)
+        for i, e in enumerate(encoded):
+            e = e[:L]
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = 1
+        return ids, mask

@@ -1,0 +1,155 @@
+"""Flax transformer encoder — the shared trunk for embedders/rerankers.
+
+Designed for the MXU: all matmuls batched, static shapes, bf16 activations,
+and flax logical-axis annotations so large configs shard over the mesh
+"model" axis via tensor parallelism (SURVEY.md §7.6; the parallel module
+turns logical axes into NamedSharding)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TransformerConfig", "TransformerEncoder", "resolve_heads"]
+
+
+def resolve_heads(d_model: int, requested: int) -> int:
+    """Largest head count <= requested that divides d_model (so arbitrary
+    embedder dimensions work without manual head tuning)."""
+    for h in range(min(requested, d_model), 0, -1):
+        if d_model % h == 0:
+            return h
+    return 1
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 384
+    n_heads: int = 6
+    n_layers: int = 6
+    d_ff: int = 1536
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    pool: str = "mean"  # mean | cls | none
+    causal: bool = False
+
+
+class MlpBlock(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.Dense(
+            cfg.d_ff,
+            dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("embed", "mlp")
+            ),
+        )(x)
+        h = nn.gelu(h)
+        return nn.Dense(
+            cfg.d_model,
+            dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("mlp", "embed")
+            ),
+        )(h)
+
+
+class SelfAttention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        B, L, D = x.shape
+        head_dim = cfg.d_model // cfg.n_heads
+
+        def proj(name, logical):
+            return nn.Dense(
+                cfg.d_model,
+                dtype=cfg.dtype,
+                name=name,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.xavier_uniform(), logical
+                ),
+            )
+
+        q = proj("query", ("embed", "heads"))(x)
+        k = proj("key", ("embed", "heads"))(x)
+        v = proj("value", ("embed", "heads"))(x)
+        q = q.reshape(B, L, cfg.n_heads, head_dim)
+        k = k.reshape(B, L, cfg.n_heads, head_dim)
+        v = v.reshape(B, L, cfg.n_heads, head_dim)
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(head_dim)
+        big_neg = jnp.finfo(jnp.float32).min
+        attn_mask = mask[:, None, None, :]  # [B,1,1,L] key mask
+        if cfg.causal:
+            causal = jnp.tril(jnp.ones((L, L), dtype=bool))
+            attn_mask = attn_mask * causal[None, None, :, :]
+        scores = jnp.where(attn_mask > 0, scores, big_neg)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhlm,bmhd->blhd", probs, v).reshape(B, L, cfg.d_model)
+        return proj("out", ("heads", "embed"))(out)
+
+
+class EncoderBlock(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        x = x + SelfAttention(cfg)(h, mask)
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        x = x + MlpBlock(cfg)(h)
+        return x
+
+
+class TransformerEncoder(nn.Module):
+    """Token ids + mask -> pooled embedding (or full hidden states)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        cfg = self.config
+        B, L = ids.shape
+        tok = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="tok_embed",
+        )(ids)
+        pos = nn.Embed(
+            cfg.max_len,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("pos", "embed")
+            ),
+            name="pos_embed",
+        )(jnp.arange(L)[None, :])
+        x = tok + pos
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, mask)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_ln")(x)
+        if cfg.pool == "none":
+            return x
+        if cfg.pool == "cls":
+            return x[:, 0, :].astype(jnp.float32)
+        # masked mean pool
+        m = mask[:, :, None].astype(x.dtype)
+        summed = jnp.sum(x * m, axis=1)
+        counts = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        return (summed / counts).astype(jnp.float32)

@@ -1,0 +1,8 @@
+"""pw.ops — jitted device compute primitives (the TPU analog of the
+reference's native hot paths: ndarray expressions in src/mat_mul.rs, external
+index scoring in src/external_integration/)."""
+
+from .knn import DeviceKnnIndex
+from .topk import merge_topk, sharded_topk
+
+__all__ = ["DeviceKnnIndex", "sharded_topk", "merge_topk"]

@@ -1,0 +1,311 @@
+"""Device-resident incremental KNN index — the framework's retrieval hot path.
+
+TPU-first redesign of the reference's brute-force index
+(src/external_integration/brute_force_knn_integration.rs:22-182: growable
+Array2<f64> row store with 2x grow / 4x shrink and dot-product scoring):
+
+- the embedding matrix lives in HBM as ``[capacity, d]``, row-sharded over
+  the mesh "data" axis (multi-chip) or on the single device;
+- add/remove are slot-allocator updates (free-list + capacity doubling) done
+  as batched scatters — no host round-trip of the matrix;
+- queries are padded to bucket sizes so XLA compiles a handful of shapes,
+  scored as one [B,d]x[d,N] matmul (MXU) + ``lax.top_k``; multi-chip search
+  does per-shard top-k then an ICI all-gather of k candidates per shard
+  (ops/topk.py) — never the full score row.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..internals.keys import KEY_DTYPE
+from .topk import local_score_topk, sharded_topk
+
+__all__ = ["DeviceKnnIndex", "normalize_metric"]
+
+
+def normalize_metric(metric) -> str:
+    """Accepts "cos"/"l2sq"/"dot", the reference metric-kind enums, or any
+    casing; anything unrecognised raises instead of silently mis-scoring."""
+    value = getattr(metric, "value", metric)
+    value = str(value).lower().replace("cosine", "cos")
+    if value in ("ip", "inner_product"):
+        value = "dot"
+    if value not in ("cos", "l2sq", "dot"):
+        raise ValueError(f"unknown KNN metric {metric!r}")
+    return value
+
+_QUERY_BUCKETS = (1, 4, 16, 64, 256, 1024)
+
+
+def _bucket(n: int) -> int:
+    for b in _QUERY_BUCKETS:
+        if n <= b:
+            return b
+    return ((n + 1023) // 1024) * 1024
+
+
+@jax.jit
+def _scatter_rows(matrix: jnp.ndarray, slots: jnp.ndarray, rows: jnp.ndarray):
+    return matrix.at[slots].set(rows.astype(matrix.dtype))
+
+
+@partial(jax.jit, static_argnums=2)
+def _scatter_flags(valid: jnp.ndarray, slots: jnp.ndarray, flag: bool):
+    return valid.at[slots].set(flag)
+
+
+class DeviceKnnIndex:
+    """Incrementally maintained dense KNN index on TPU.
+
+    metric: "cos" (vectors L2-normalised at insert; score = cosine sim) or
+    "l2sq" (score = -squared distance) or "dot".
+    """
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: str = "cos",
+        initial_capacity: int = 1024,
+        mesh: Optional[Mesh] = None,
+        dtype=jnp.float32,
+    ):
+        self.dimension = dimension
+        self.metric = normalize_metric(metric)
+        self.dtype = dtype
+        self.mesh = mesh
+        self._lock = threading.RLock()
+        self.n_shards = mesh.shape["data"] if mesh is not None else 1
+        cap = max(initial_capacity, self.n_shards * 8)
+        cap = self._round_capacity(cap)
+        self.capacity = cap
+        self._matrix = self._device_zeros((cap, dimension))
+        self._valid = self._device_zeros((cap,), dtype=jnp.bool_)
+        self._norms = np.zeros(cap, dtype=np.float32)  # host copy for l2sq
+        self.key_to_slot: Dict[int, int] = {}
+        self.slot_to_key = np.zeros(cap, dtype=KEY_DTYPE)
+        self._free: List[int] = list(range(cap - 1, -1, -1))
+        self._search_fns: Dict[Tuple[int, int, int], object] = {}
+
+    # -- storage helpers ---------------------------------------------------
+    def _round_capacity(self, cap: int) -> int:
+        """Capacity multiple of shards*8 so row-sharding divides evenly and
+        tiles align with the (8,128) f32 layout."""
+        unit = self.n_shards * 8
+        return ((cap + unit - 1) // unit) * unit
+
+    def _sharding(self, row_sharded: bool = True):
+        if self.mesh is None:
+            return None
+        return NamedSharding(
+            self.mesh, P("data", None) if row_sharded else P("data")
+        )
+
+    def _device_zeros(self, shape, dtype=None):
+        dtype = dtype or self.dtype
+        arr = jnp.zeros(shape, dtype=dtype)
+        if self.mesh is not None:
+            spec = P("data", None) if len(shape) == 2 else P("data")
+            arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
+        return arr
+
+    def __len__(self) -> int:
+        return len(self.key_to_slot)
+
+    # -- growth ------------------------------------------------------------
+    def _grow(self, needed: int) -> None:
+        new_cap = self._round_capacity(max(self.capacity * 2, self.capacity + needed))
+        old_cap = self.capacity
+        new_matrix = self._device_zeros((new_cap, self.dimension))
+        new_valid = self._device_zeros((new_cap,), dtype=jnp.bool_)
+        # copy rows (device-side concat keeps data in HBM)
+        new_matrix = jax.lax.dynamic_update_slice(new_matrix, self._matrix, (0, 0))
+        new_valid = jax.lax.dynamic_update_slice(new_valid, self._valid, (0,))
+        if self.mesh is not None:
+            new_matrix = jax.device_put(new_matrix, self._sharding(True))
+            new_valid = jax.device_put(new_valid, self._sharding(False))
+        self._matrix = new_matrix
+        self._valid = new_valid
+        self.slot_to_key = np.concatenate(
+            [self.slot_to_key, np.zeros(new_cap - old_cap, dtype=KEY_DTYPE)]
+        )
+        self._norms = np.concatenate(
+            [self._norms, np.zeros(new_cap - old_cap, dtype=np.float32)]
+        )
+        self._free.extend(range(new_cap - 1, old_cap - 1, -1))
+        self.capacity = new_cap
+        self._search_fns.clear()  # capacity is baked into compiled shapes
+
+    # -- mutation ----------------------------------------------------------
+    def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+        with self._lock:
+            if len(keys) == 0:
+                return
+            vectors = np.asarray(vectors, dtype=np.float32).reshape(
+                len(keys), self.dimension
+            )
+            # upsert: remove keys that already exist
+            existing = [k for k in keys if int(k) in self.key_to_slot]
+            if existing:
+                self.remove(existing)
+            if len(self._free) < len(keys):
+                self._grow(len(keys) - len(self._free))
+            slots = np.array(
+                [self._free.pop() for _ in keys], dtype=np.int32
+            )
+            norms = np.linalg.norm(vectors, axis=1)
+            self._norms[slots] = norms
+            if self.metric == "cos":
+                safe = np.where(norms == 0, 1.0, norms)
+                vectors = vectors / safe[:, None]
+            for key, slot in zip(keys, slots):
+                self.key_to_slot[int(key)] = int(slot)
+                self.slot_to_key[slot] = int(key)
+            self._scatter(slots, vectors, True)
+
+    def remove(self, keys: Sequence[int]) -> None:
+        with self._lock:
+            slots = []
+            for key in keys:
+                slot = self.key_to_slot.pop(int(key), None)
+                if slot is not None:
+                    slots.append(slot)
+                    self._free.append(slot)
+            if not slots:
+                return
+            slots = np.array(slots, dtype=np.int32)
+            self._scatter(slots, np.zeros((len(slots), self.dimension), np.float32), False)
+
+    def _scatter(self, slots: np.ndarray, vectors: np.ndarray, valid: bool) -> None:
+        """Batched scatter, padded to a bucket to bound recompiles (pad rows
+        repeat the first row — idempotent writes)."""
+        n = len(slots)
+        b = _bucket(n)
+        if b > n:
+            slots = np.concatenate([slots, np.full(b - n, slots[0], np.int32)])
+            vectors = np.concatenate([vectors, np.repeat(vectors[:1], b - n, 0)])
+        self._matrix = _scatter_rows(self._matrix, jnp.asarray(slots), jnp.asarray(vectors, dtype=self.dtype))
+        self._valid = _scatter_flags(self._valid, jnp.asarray(slots), valid)
+        if self.mesh is not None:
+            self._matrix = jax.device_put(self._matrix, self._sharding(True))
+            self._valid = jax.device_put(self._valid, self._sharding(False))
+
+    # -- search ------------------------------------------------------------
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        candidate_keys: Optional[Sequence[Sequence[int]]] = None,
+    ) -> List[List[Tuple[int, float]]]:
+        """Top-k per query; returns [(key, score), ...] per query row.
+
+        ``candidate_keys``: optional per-query allow-list (metadata filtering
+        path) — scoring stays on device, the allow-mask is built host-side."""
+        with self._lock:
+            queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dimension)
+            nq = queries.shape[0]
+            if nq == 0 or not self.key_to_slot:
+                return [[] for _ in range(nq)]
+            if self.metric == "cos":
+                norms = np.linalg.norm(queries, axis=1)
+                queries = queries / np.where(norms == 0, 1.0, norms)[:, None]
+            k_eff = min(k, len(self.key_to_slot))
+            b = _bucket(nq)
+            if b > nq:
+                queries = np.concatenate(
+                    [queries, np.zeros((b - nq, self.dimension), np.float32)]
+                )
+            q = jnp.asarray(queries, dtype=self.dtype)
+            scores, idx = self._run_search(q, k_eff)
+            scores = np.asarray(scores)[:nq]
+            idx = np.asarray(idx)[:nq]
+            out: List[List[Tuple[int, float]]] = []
+            for qi in range(nq):
+                allow = None
+                if candidate_keys is not None and candidate_keys[qi] is not None:
+                    allow = {int(c) for c in candidate_keys[qi]}
+                row: List[Tuple[int, float]] = []
+                for j in range(k_eff):
+                    s = float(scores[qi, j])
+                    if not np.isfinite(s):
+                        continue
+                    key = int(self.slot_to_key[int(idx[qi, j])])
+                    if key not in self.key_to_slot:
+                        continue
+                    if allow is not None and key not in allow:
+                        continue
+                    row.append((key, s))
+                out.append(row[:k])
+            return out
+
+    def search_oversampled(
+        self,
+        queries: np.ndarray,
+        k: int,
+        accept,  # callable(key) -> bool
+        oversample: int = 4,
+        max_rounds: int = 3,
+    ) -> List[List[Tuple[int, float]]]:
+        """Filtered search by over-sampling: fetch oversample*k, drop rejected,
+        widen until satisfied or the index is exhausted."""
+        nq = np.asarray(queries).reshape(-1, self.dimension).shape[0]
+        results = [[] for _ in range(nq)]
+        kk = k * oversample
+        for _ in range(max_rounds):
+            rows = self.search(queries, kk)
+            done = True
+            for qi, row in enumerate(rows):
+                accepted = [(key, s) for key, s in row if accept(key)]
+                results[qi] = accepted[:k]
+                if len(accepted) < k and len(row) >= len(self.key_to_slot):
+                    pass  # exhausted
+                elif len(accepted) < k and len(row) == kk:
+                    done = False
+            if done or kk >= max(len(self.key_to_slot), 1):
+                break
+            kk *= 4
+        return results
+
+    def _run_search(self, q: jnp.ndarray, k: int):
+        key = (q.shape[0], k, self.capacity)
+        fn = self._search_fns.get(key)
+        if fn is None:
+            if self.mesh is not None:
+                mesh = self.mesh
+                metric = self.metric
+
+                def fn(qq, m, v):
+                    return sharded_topk(mesh, qq, m, v, k, metric=metric)
+
+                fn = jax.jit(fn)
+            else:
+                metric = self.metric
+
+                def fn(qq, m, v):
+                    if metric == "l2sq":
+                        # -||q - x||^2 = 2 q.x - ||x||^2 - ||q||^2; rank by 2qx - x2
+                        scores = 2 * jnp.dot(
+                            qq, m.T, preferred_element_type=jnp.float32
+                        ) - jnp.sum(m * m, axis=1)[None, :]
+                        scores = jnp.where(v[None, :], scores, -jnp.inf)
+                        return jax.lax.top_k(scores, k)
+                    return local_score_topk(qq, m, v, k)
+
+                fn = jax.jit(fn)
+            self._search_fns[key] = fn
+        return fn(q, self._matrix, self._valid)
+
+    # l2sq exact distances post-hoc (scores returned are ranking scores)
+    def scores_to_distances(self, scores: np.ndarray, query_norms: np.ndarray):
+        if self.metric == "cos":
+            return 1.0 - scores
+        if self.metric == "l2sq":
+            return -(scores - query_norms[:, None] ** 2)
+        return -scores

@@ -1,0 +1,95 @@
+"""Sharded top-k retrieval kernels.
+
+The retrieval recipe for a row-sharded score matrix (SURVEY.md §2.6 TPU
+notes): compute per-shard scores [B, N/s] on each device, take a *local*
+``lax.top_k``, all-gather only the (k, index) pairs over ICI, and merge —
+moving s·B·k elements over the interconnect instead of B·N.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["sharded_topk", "merge_topk", "local_score_topk"]
+
+
+def local_score_topk(
+    queries: jnp.ndarray,  # [B, d]
+    matrix: jnp.ndarray,  # [N, d] (local shard rows)
+    valid: jnp.ndarray,  # [N] bool
+    k: int,
+    metric: str = "dot",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense scores + local top-k.  MXU-shaped: one [B,d]x[d,N] matmul.
+
+    metric "dot"/"cos" ranks by inner product (cos assumes normalised rows);
+    "l2sq" ranks by 2*q.x - ||x||^2 (equivalent to -||q-x||^2 ordering)."""
+    scores = jnp.dot(
+        queries, matrix.T, preferred_element_type=jnp.float32
+    )  # [B, N]
+    if metric == "l2sq":
+        scores = 2 * scores - jnp.sum(
+            matrix.astype(jnp.float32) * matrix.astype(jnp.float32), axis=1
+        )[None, :]
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    k_eff = min(k, matrix.shape[0])
+    top_scores, top_idx = jax.lax.top_k(scores, k_eff)  # [B, k]
+    if k_eff < k:
+        pad = k - k_eff
+        top_scores = jnp.pad(top_scores, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+        top_idx = jnp.pad(top_idx, ((0, 0), (0, pad)), constant_values=0)
+    return top_scores, top_idx
+
+
+def merge_topk(
+    all_scores: jnp.ndarray,  # [S, B, k] per-shard candidates
+    all_idx: jnp.ndarray,  # [S, B, k] local row indices
+    shard_offsets: jnp.ndarray,  # [S] global row offset of each shard
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge per-shard candidate lists into global top-k (global row ids)."""
+    S, B, kk = all_scores.shape
+    global_idx = all_idx + shard_offsets[:, None, None]
+    flat_scores = jnp.transpose(all_scores, (1, 0, 2)).reshape(B, S * kk)
+    flat_idx = jnp.transpose(global_idx, (1, 0, 2)).reshape(B, S * kk)
+    top_scores, positions = jax.lax.top_k(flat_scores, k)
+    top_global = jnp.take_along_axis(flat_idx, positions, axis=1)
+    return top_scores, top_global
+
+
+def sharded_topk(
+    mesh: Mesh,
+    queries: jnp.ndarray,  # [B, d] replicated
+    matrix: jnp.ndarray,  # [N, d] sharded on rows over "data"
+    valid: jnp.ndarray,  # [N] sharded over "data"
+    k: int,
+    metric: str = "dot",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """shard_map: per-device score+topk, all-gather candidates, merge.
+
+    Returns replicated ([B, k] scores, [B, k] global row indices)."""
+    n_shards = mesh.shape["data"]
+    rows_per_shard = matrix.shape[0] // n_shards
+
+    def per_shard(q, m, v):
+        local_scores, local_idx = local_score_topk(q, m, v, k, metric=metric)
+        # [1, B, k] on each shard -> all_gather over "data" -> [S, B, k]
+        gathered_scores = jax.lax.all_gather(local_scores, "data")  # [S, B, k]
+        gathered_idx = jax.lax.all_gather(local_idx, "data")
+        my_index = jax.lax.axis_index("data")
+        offsets = jnp.arange(n_shards) * rows_per_shard
+        return merge_topk(gathered_scores, gathered_idx, offsets, k)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P("data", None), P("data")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(queries, matrix, valid)

@@ -1,0 +1,31 @@
+"""pw.parallel — device mesh + sharding utilities.
+
+The TPU-native replacement for the reference's worker topology
+(PATHWAY_THREADS/PROCESSES over timely workers, src/engine/dataflow/
+config.rs:88-121; exchange over shared-mem/TCP, external/timely-dataflow/
+communication/): here parallelism is a ``jax.sharding.Mesh`` over TPU chips,
+data placement is ``NamedSharding``, and the exchange is XLA collectives over
+ICI (SURVEY.md §5.8).
+"""
+
+from .mesh import (
+    current_mesh,
+    data_axis_size,
+    device_count,
+    make_mesh,
+    replicated,
+    set_mesh,
+    shard_cols,
+    shard_rows,
+)
+
+__all__ = [
+    "make_mesh",
+    "current_mesh",
+    "set_mesh",
+    "device_count",
+    "data_axis_size",
+    "shard_rows",
+    "shard_cols",
+    "replicated",
+]

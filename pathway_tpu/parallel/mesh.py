@@ -1,0 +1,96 @@
+"""Mesh construction and sharding helpers.
+
+Axes convention: ``("data", "model")`` — "data" shards index rows / batch
+(the analog of the reference's per-worker key shard, src/engine/value.rs:38);
+"model" shards large model weights (tensor parallelism).  Multi-host wires in
+through ``jax.distributed.initialize`` + the same mesh spanning all hosts'
+devices (DCN between hosts, ICI within a slice).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "current_mesh",
+    "set_mesh",
+    "device_count",
+    "data_axis_size",
+    "shard_rows",
+    "shard_cols",
+    "replicated",
+]
+
+_lock = threading.Lock()
+_current_mesh: Optional[Mesh] = None
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ("data", "model") mesh over the available devices.
+
+    Defaults: all devices on the data axis (index sharding), model axis 1.
+    Env overrides: PATHWAY_TPU_DATA_SHARDS / PATHWAY_TPU_MODEL_SHARDS."""
+    devices = list(devices if devices is not None else jax.devices())
+    n_model = int(os.environ.get("PATHWAY_TPU_MODEL_SHARDS", "0") or 0) or n_model
+    if n_data is None:
+        n_data = int(os.environ.get("PATHWAY_TPU_DATA_SHARDS", "0") or 0) or (
+            len(devices) // n_model
+        )
+    needed = n_data * n_model
+    if needed > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {needed} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:needed]).reshape(n_data, n_model)
+    return Mesh(grid, axis_names=("data", "model"))
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _current_mesh
+    with _lock:
+        _current_mesh = mesh
+
+
+def current_mesh(create: bool = True) -> Optional[Mesh]:
+    """The process-wide mesh (created lazily over all devices)."""
+    global _current_mesh
+    with _lock:
+        if _current_mesh is None and create:
+            _current_mesh = make_mesh()
+        return _current_mesh
+
+
+def data_axis_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or current_mesh()
+    return mesh.shape["data"]
+
+
+def shard_rows(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Rows split across the data axis (index/embedding matrices)."""
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, P("data", None))
+
+
+def shard_cols(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, P(None, "data"))
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, P())

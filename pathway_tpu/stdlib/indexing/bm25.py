@@ -1,0 +1,111 @@
+"""Full-text BM25 index
+(reference: stdlib/indexing/bm25.py:41 TantivyBM25 over the native tantivy
+index, src/external_integration/tantivy_integration.rs:16).
+
+Host-side incremental inverted index with Okapi BM25 scoring; retrieval is
+candidate-set-bounded (union of query-term postings), so live updates stay
+cheap.  The Tantivy* names are kept for config compatibility."""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .filters import compile_filter
+from .nearest_neighbors import InnerIndexImpl
+
+__all__ = ["BM25Index", "TantivyBM25", "TantivyBM25Factory"]
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def _tokenize(text: str) -> List[str]:
+    return _TOKEN_RE.findall(str(text).lower())
+
+
+class BM25Index(InnerIndexImpl):
+    def __init__(self, k1: float = 1.2, b: float = 0.75, ram_budget: Optional[int] = None):
+        self.k1 = k1
+        self.b = b
+        self.postings: Dict[str, Dict[int, int]] = {}
+        self.doc_tokens: Dict[int, Counter] = {}
+        self.doc_len: Dict[int, int] = {}
+        self.metadata: Dict[int, Any] = {}
+        self.total_len = 0
+
+    def add(self, keys, values, metadatas) -> None:
+        for key, text, md in zip(keys, values, metadatas):
+            key = int(key)
+            if key in self.doc_tokens:
+                self.remove([key])
+            counts = Counter(_tokenize(text))
+            self.doc_tokens[key] = counts
+            n = sum(counts.values())
+            self.doc_len[key] = n
+            self.total_len += n
+            for tok, tf in counts.items():
+                self.postings.setdefault(tok, {})[key] = tf
+            if md is not None:
+                self.metadata[key] = md
+
+    def remove(self, keys) -> None:
+        for key in keys:
+            key = int(key)
+            counts = self.doc_tokens.pop(key, None)
+            if counts is None:
+                continue
+            self.total_len -= self.doc_len.pop(key, 0)
+            for tok in counts:
+                plist = self.postings.get(tok)
+                if plist is not None:
+                    plist.pop(key, None)
+                    if not plist:
+                        del self.postings[tok]
+            self.metadata.pop(key, None)
+
+    def _score_query(self, text: str, k: int, accept=None) -> Tuple[Tuple[int, float], ...]:
+        n_docs = len(self.doc_tokens)
+        if n_docs == 0:
+            return ()
+        avg_len = self.total_len / n_docs
+        scores: Dict[int, float] = {}
+        for tok in set(_tokenize(text)):
+            plist = self.postings.get(tok)
+            if not plist:
+                continue
+            idf = math.log(1 + (n_docs - len(plist) + 0.5) / (len(plist) + 0.5))
+            for doc, tf in plist.items():
+                dl = self.doc_len[doc]
+                denom = tf + self.k1 * (1 - self.b + self.b * dl / avg_len)
+                scores[doc] = scores.get(doc, 0.0) + idf * tf * (self.k1 + 1) / denom
+        ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+        out = []
+        for doc, score in ranked:
+            if accept is not None and not accept(self.metadata.get(doc, {})):
+                continue
+            out.append((doc, score))
+            if len(out) >= k:
+                break
+        return tuple(out)
+
+    def search(self, values, k, filters):
+        out = []
+        for text, fexpr in zip(values, filters):
+            accept = compile_filter(str(fexpr)) if fexpr is not None else None
+            out.append(self._score_query(text, k, accept))
+        return out
+
+
+class TantivyBM25Factory:
+    """(reference: TantivyBM25 factory, bm25.py:41)"""
+
+    def __init__(self, ram_budget: Optional[int] = None, in_memory_index: bool = True, **kwargs):
+        self.ram_budget = ram_budget
+
+    def build_inner_index(self, dimension: Optional[int] = None) -> BM25Index:
+        return BM25Index(ram_budget=self.ram_budget)
+
+
+TantivyBM25 = TantivyBM25Factory

@@ -1,0 +1,54 @@
+"""Adapter indexing *text* through an embedder + vector index pair.
+
+Handles every embedder flavor: batched sync (one device call per
+micro-batch — the TPU fast path), plain sync per-item, and async API
+embedders (gathered on a private event loop)."""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any, List, Sequence
+
+import numpy as np
+
+__all__ = ["EmbeddingIndexAdapter"]
+
+
+class EmbeddingIndexAdapter:
+    def __init__(self, inner, embedder):
+        self.inner = inner
+        self.embedder = embedder
+        fn = embedder.func
+        self._is_async = inspect.iscoroutinefunction(fn)
+        self._is_batched = bool(getattr(embedder, "batched", False))
+
+    def _embed(self, values: Sequence[Any]) -> List[np.ndarray]:
+        texts = ["" if v is None else str(v) for v in values]
+        fn = self.embedder.func
+        if self._is_async:
+
+            async def run():
+                return await asyncio.gather(*(fn(t) for t in texts))
+
+            out = asyncio.run(run())
+        elif self._is_batched:
+            arr = np.empty(len(texts), dtype=object)
+            arr[:] = texts
+            out = fn(arr)
+        else:
+            out = [fn(t) for t in texts]
+        return [np.asarray(v, dtype=np.float32) for v in out]
+
+    def add(self, keys, values, metadatas):
+        if len(keys) == 0:
+            return
+        self.inner.add(keys, self._embed(values), metadatas)
+
+    def remove(self, keys):
+        self.inner.remove(keys)
+
+    def search(self, values, k, filters):
+        if len(values) == 0:
+            return []
+        return self.inner.search(self._embed(values), k, filters)

@@ -1,0 +1,27 @@
+"""pw.xpacks.llm — the LLM/RAG extension pack
+(reference inventory: python/pathway/xpacks/llm/ — SURVEY.md §2.10)."""
+
+from . import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    rerankers,
+    servers,
+    splitters,
+)
+from .document_store import DocumentStore
+from .vector_store import VectorStoreClient, VectorStoreServer
+
+__all__ = [
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "servers",
+    "splitters",
+    "DocumentStore",
+    "VectorStoreServer",
+    "VectorStoreClient",
+]

@@ -1,0 +1,256 @@
+"""Embedder UDFs (reference: xpacks/llm/embedders.py:85-330 — OpenAIEmbedder,
+LiteLLMEmbedder, SentenceTransformerEmbedder, GeminiEmbedder; dimension
+probed by embedding ".", vector_store.py:86).
+
+TPU-first change: local embedders are *batched by construction* — one jitted
+flax forward per engine micro-batch (the reference encodes one string at a
+time, embedders.py:315-327)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...internals import udfs
+from ...internals.udfs import UDF
+
+__all__ = [
+    "BaseEmbedder",
+    "SentenceTransformerEmbedder",
+    "TpuEmbedder",
+    "OpenAIEmbedder",
+    "LiteLLMEmbedder",
+    "GeminiEmbedder",
+    "ClipTextEmbedder",
+    "ClipImageEmbedder",
+]
+
+
+class BaseEmbedder(UDF):
+    def get_embedding_dimension(self, **kwargs) -> int:
+        """Probe output dimension by embedding "." (reference vector_store.py:86)."""
+        result = self.func(np.array(["."], dtype=object), **kwargs)
+        return int(np.asarray(result).shape[-1])
+
+
+class TpuEmbedder(BaseEmbedder):
+    """Batched on-device embedder over the flax SentenceEncoder."""
+
+    def __init__(
+        self,
+        model: str = "pathway-mini",
+        dimension: int = 384,
+        n_layers: int = 6,
+        max_length: int = 128,
+        checkpoint_path: Optional[str] = None,
+        mesh=None,
+        call_kwargs: dict | None = None,
+        **kwargs,
+    ):
+        from ...models.encoder import SentenceEncoder
+
+        self._encoder = SentenceEncoder(
+            model=model,
+            dimension=dimension,
+            n_layers=n_layers,
+            max_length=max_length,
+            checkpoint_path=checkpoint_path,
+            mesh=mesh,
+        )
+        encoder = self._encoder
+
+        def embed(texts) -> np.ndarray:
+            return encoder.encode(list(texts))
+
+        super().__init__(embed, batched=True, **kwargs)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._encoder.get_embedding_dimension()
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """Local sentence embedder (reference: embedders.py:270).
+
+    If ``model`` is a local sentence_transformers checkpoint directory it is
+    used (batched ``model.encode`` on the whole micro-batch — already an
+    upgrade over the reference's per-row call); otherwise falls back to the
+    TPU-native flax encoder with the given output dimension."""
+
+    def __init__(
+        self,
+        model: str = "pathway-mini",
+        call_kwargs: dict | None = None,
+        device: str = "tpu",
+        dimension: int = 384,
+        **init_kwargs,
+    ):
+        import os
+
+        self.model_name = model
+        call_kwargs = call_kwargs or {}
+        if os.path.isdir(model):
+            from sentence_transformers import SentenceTransformer
+
+            st_model = SentenceTransformer(model, **init_kwargs)
+            self._dimension = int(st_model.get_sentence_embedding_dimension())
+
+            def embed(texts) -> np.ndarray:
+                return np.asarray(
+                    st_model.encode(list(texts), **call_kwargs), dtype=np.float32
+                )
+
+        else:
+            from ...models.encoder import SentenceEncoder
+
+            encoder = SentenceEncoder(model=model, dimension=dimension)
+            self._dimension = encoder.get_embedding_dimension()
+
+            def embed(texts) -> np.ndarray:
+                return encoder.encode(list(texts))
+
+        super().__init__(embed, batched=True)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._dimension
+
+
+class _ApiEmbedder(BaseEmbedder):
+    """Async API embedders (capacity/retry/cache via udfs.async_options)."""
+
+    _import_error = "this embedder's client library is not installed"
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        retry_strategy=None,
+        cache_strategy=None,
+        model: Optional[str] = None,
+        **call_kwargs,
+    ):
+        self.model = model
+        self.call_kwargs = call_kwargs
+        embed = self._make_embed_fn()
+        super().__init__(
+            embed,
+            executor="async",
+            capacity=capacity,
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+
+    def _make_embed_fn(self) -> Callable:
+        raise NotImplementedError
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        import asyncio
+
+        return int(
+            np.asarray(asyncio.run(self.func(".", **kwargs))).shape[-1]
+        )
+
+
+class OpenAIEmbedder(_ApiEmbedder):
+    """(reference: embedders.py:85 — async OpenAI embeddings API)"""
+
+    def __init__(self, model: str = "text-embedding-3-small", **kwargs):
+        super().__init__(model=model, **kwargs)
+
+    def _make_embed_fn(self):
+        model = self.model if hasattr(self, "model") else None
+        call_kwargs = getattr(self, "call_kwargs", {})
+
+        async def embed(text: str, **kw):
+            try:
+                import openai
+            except ImportError as e:
+                raise ImportError(
+                    "OpenAIEmbedder requires the `openai` package"
+                ) from e
+            client = openai.AsyncOpenAI()
+            response = await client.embeddings.create(
+                input=[text or "."], model=self.model, **{**call_kwargs, **kw}
+            )
+            return np.array(response.data[0].embedding, dtype=np.float32)
+
+        return embed
+
+
+class LiteLLMEmbedder(_ApiEmbedder):
+    """(reference: embedders.py:180)"""
+
+    def __init__(self, model: str = "text-embedding-3-small", **kwargs):
+        super().__init__(model=model, **kwargs)
+
+    def _make_embed_fn(self):
+        call_kwargs = getattr(self, "call_kwargs", {})
+
+        async def embed(text: str, **kw):
+            try:
+                import litellm
+            except ImportError as e:
+                raise ImportError(
+                    "LiteLLMEmbedder requires the `litellm` package"
+                ) from e
+            response = await litellm.aembedding(
+                input=[text or "."], model=self.model, **{**call_kwargs, **kw}
+            )
+            return np.array(response.data[0]["embedding"], dtype=np.float32)
+
+        return embed
+
+
+class GeminiEmbedder(_ApiEmbedder):
+    """(reference: embedders.py:330)"""
+
+    def __init__(self, model: str = "models/embedding-001", **kwargs):
+        super().__init__(model=model, **kwargs)
+
+    def _make_embed_fn(self):
+        async def embed(text: str, **kw):
+            try:
+                import google.generativeai as genai
+            except ImportError as e:
+                raise ImportError(
+                    "GeminiEmbedder requires `google-generativeai`"
+                ) from e
+            result = genai.embed_content(model=self.model, content=text or ".")
+            return np.array(result["embedding"], dtype=np.float32)
+
+        return embed
+
+
+class ClipTextEmbedder(BaseEmbedder):
+    """Text side of the multimodal CLIP embedder (BASELINE config 3)."""
+
+    def __init__(self, clip_model=None, **kwargs):
+        from ...models.clip import ClipModel
+
+        self._model = clip_model or ClipModel()
+        model = self._model
+
+        def embed(texts) -> np.ndarray:
+            return model.encode_text(list(texts))
+
+        super().__init__(embed, batched=True, **kwargs)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._model.get_embedding_dimension()
+
+
+class ClipImageEmbedder(BaseEmbedder):
+    """Image side: embeds ndarray image columns."""
+
+    def __init__(self, clip_model=None, **kwargs):
+        from ...models.clip import ClipModel
+
+        self._model = clip_model or ClipModel()
+        model = self._model
+
+        def embed(images) -> np.ndarray:
+            return model.encode_image(list(images))
+
+        super().__init__(embed, batched=True, **kwargs)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._model.get_embedding_dimension()

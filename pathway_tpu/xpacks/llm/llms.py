@@ -1,0 +1,209 @@
+"""Chat model UDFs (reference: xpacks/llm/llms.py:84-544 — OpenAIChat,
+LiteLLMChat, HFPipelineChat, CohereChat; capacity/retry/cache via
+udfs.async_options)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ...internals.udfs import UDF
+
+__all__ = [
+    "BaseChat",
+    "OpenAIChat",
+    "LiteLLMChat",
+    "CohereChat",
+    "HFPipelineChat",
+    "TpuChat",
+    "prompt_chat_single_qa",
+]
+
+Message = Dict[str, str]
+
+
+def _messages_to_prompt(messages: Union[str, Sequence[Message]]) -> str:
+    if isinstance(messages, str):
+        return messages
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        parts.append(f"{role}: {m.get('content', '')}")
+    return "\n".join(parts)
+
+
+def prompt_chat_single_qa(question: str) -> List[Message]:
+    """(reference: llms.py prompt_chat_single_qa helper)"""
+    return [{"role": "user", "content": str(question)}]
+
+
+class BaseChat(UDF):
+    """Chat UDFs accept a message list (or plain string) per row and return
+    the model answer."""
+
+    model: Optional[str] = None
+
+    def _accepts_call_arg(self, name: str) -> bool:
+        return True
+
+
+class TpuChat(BaseChat):
+    """Local generation on the flax causal LM (batched decode under one jit)
+    — the TPU-native slot for the reference's HFPipelineChat."""
+
+    def __init__(
+        self,
+        model: str = "pathway-mini-lm",
+        max_new_tokens: int = 48,
+        temperature: float = 0.0,
+        checkpoint_path: Optional[str] = None,
+        generator=None,
+        **kwargs,
+    ):
+        from ...models.generator import TextGenerator
+
+        self.model = model
+        self._generator = generator or TextGenerator(
+            model=model, checkpoint_path=checkpoint_path
+        )
+        gen = self._generator
+
+        def chat(messages) -> str:
+            prompts = [_messages_to_prompt(m) for m in messages]
+            outs = gen.generate(
+                prompts, max_new_tokens=max_new_tokens, temperature=temperature
+            )
+            import numpy as np
+
+            return np.array(outs, dtype=object)
+
+        super().__init__(chat, batched=True, **kwargs)
+
+
+class HFPipelineChat(BaseChat):
+    """Local transformers pipeline (reference: llms.py:441).  Works when the
+    model files exist locally; batched over the micro-batch."""
+
+    def __init__(
+        self,
+        model: Optional[str] = None,
+        call_kwargs: dict | None = None,
+        device: str = "cpu",
+        **pipeline_kwargs,
+    ):
+        self.model = model
+        call_kwargs = call_kwargs or {}
+        import transformers
+
+        pipe = transformers.pipeline(
+            "text-generation", model=model, device=device, **pipeline_kwargs
+        )
+
+        def chat(messages) -> Any:
+            import numpy as np
+
+            prompts = [_messages_to_prompt(m) for m in messages]
+            outs = pipe(prompts, **call_kwargs)
+            texts = []
+            for out in outs:
+                if isinstance(out, list):
+                    out = out[0]
+                texts.append(out.get("generated_text", ""))
+            return np.array(texts, dtype=object)
+
+        super().__init__(chat, batched=True)
+
+    def crop_to_max_context_size(self, text: str) -> str:
+        return text
+
+
+class _ApiChat(BaseChat):
+    def __init__(
+        self,
+        model: Optional[str] = None,
+        capacity: Optional[int] = None,
+        retry_strategy=None,
+        cache_strategy=None,
+        temperature: float = 0.0,
+        max_tokens: Optional[int] = None,
+        **call_kwargs,
+    ):
+        self.model = model
+        self.temperature = temperature
+        self.max_tokens = max_tokens
+        self.call_kwargs = call_kwargs
+        super().__init__(
+            self._make_chat_fn(),
+            executor="async",
+            capacity=capacity,
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+
+    def _make_chat_fn(self):
+        raise NotImplementedError
+
+
+class OpenAIChat(_ApiChat):
+    """(reference: llms.py:84)"""
+
+    def __init__(self, model: str = "gpt-4o-mini", **kwargs):
+        super().__init__(model=model, **kwargs)
+
+    def _make_chat_fn(self):
+        async def chat(messages, **kw):
+            try:
+                import openai
+            except ImportError as e:
+                raise ImportError("OpenAIChat requires the `openai` package") from e
+            client = openai.AsyncOpenAI()
+            if isinstance(messages, str):
+                messages = prompt_chat_single_qa(messages)
+            response = await client.chat.completions.create(
+                model=kw.pop("model", self.model),
+                messages=list(messages),
+                temperature=self.temperature,
+                **{**self.call_kwargs, **kw},
+            )
+            return response.choices[0].message.content
+
+        return chat
+
+
+class LiteLLMChat(_ApiChat):
+    """(reference: llms.py:313)"""
+
+    def _make_chat_fn(self):
+        async def chat(messages, **kw):
+            try:
+                import litellm
+            except ImportError as e:
+                raise ImportError("LiteLLMChat requires the `litellm` package") from e
+            if isinstance(messages, str):
+                messages = prompt_chat_single_qa(messages)
+            response = await litellm.acompletion(
+                model=kw.pop("model", self.model),
+                messages=list(messages),
+                **{**self.call_kwargs, **kw},
+            )
+            return response.choices[0].message.content
+
+        return chat
+
+
+class CohereChat(_ApiChat):
+    """(reference: llms.py:544)"""
+
+    def _make_chat_fn(self):
+        async def chat(messages, **kw):
+            try:
+                import cohere
+            except ImportError as e:
+                raise ImportError("CohereChat requires the `cohere` package") from e
+            client = cohere.AsyncClient()
+            prompt = _messages_to_prompt(messages)
+            response = await client.chat(
+                message=prompt, model=kw.pop("model", self.model) or "command-r"
+            )
+            return response.text
+
+        return chat

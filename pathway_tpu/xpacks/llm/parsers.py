@@ -1,0 +1,145 @@
+"""Document parsers (reference: xpacks/llm/parsers.py:53-928 — ParseUtf8,
+ParseUnstructured, OpenParse, ImageParser, SlideParser, PypdfParser).
+Parsers map raw bytes -> list[(text, metadata)]."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...internals.udfs import UDF
+
+__all__ = [
+    "ParseUtf8",
+    "Utf8Parser",
+    "ParseUnstructured",
+    "UnstructuredParser",
+    "PypdfParser",
+    "ImageParser",
+    "SlideParser",
+    "ParseMarkdown",
+]
+
+Chunk = Tuple[str, Dict]
+
+
+def _to_text(contents: Any) -> str:
+    if isinstance(contents, bytes):
+        return contents.decode("utf-8", errors="replace")
+    return str(contents)
+
+
+class ParseUtf8(UDF):
+    """(reference: parsers.py:53)"""
+
+    def __init__(self, **kwargs):
+        super().__init__(lambda contents: [(_to_text(contents), {})], **kwargs)
+
+
+Utf8Parser = ParseUtf8
+
+
+class ParseMarkdown(UDF):
+    """Split a markdown document on headings into (section, metadata) chunks."""
+
+    def __init__(self, **kwargs):
+        def parse(contents: Any) -> List[Chunk]:
+            text = _to_text(contents)
+            chunks: List[Chunk] = []
+            current: List[str] = []
+            heading = ""
+            for line in text.splitlines():
+                if line.startswith("#"):
+                    if current:
+                        chunks.append(("\n".join(current).strip(), {"heading": heading}))
+                    heading = line.lstrip("# ").strip()
+                    current = [line]
+                else:
+                    current.append(line)
+            if current:
+                chunks.append(("\n".join(current).strip(), {"heading": heading}))
+            return [c for c in chunks if c[0]]
+
+        super().__init__(parse, **kwargs)
+
+
+class ParseUnstructured(UDF):
+    """(reference: parsers.py:79 — unstructured-io; gated on the library)"""
+
+    def __init__(self, mode: str = "single", **kwargs):
+        try:
+            from unstructured.partition.auto import partition
+        except ImportError as e:
+            raise ImportError(
+                "ParseUnstructured requires the `unstructured` package; use "
+                "ParseUtf8 / ParseMarkdown / PypdfParser instead"
+            ) from e
+
+        def parse(contents: Any) -> List[Chunk]:
+            import io
+
+            elements = partition(file=io.BytesIO(contents))
+            if mode == "single":
+                return [("\n\n".join(str(e) for e in elements), {})]
+            return [(str(e), {"category": e.category}) for e in elements]
+
+        super().__init__(parse, **kwargs)
+
+
+UnstructuredParser = ParseUnstructured
+
+
+class PypdfParser(UDF):
+    """(reference: parsers.py:746 — pypdf text extraction; gated)"""
+
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs):
+        try:
+            import pypdf
+        except ImportError as e:
+            raise ImportError("PypdfParser requires the `pypdf` package") from e
+
+        def parse(contents: bytes) -> List[Chunk]:
+            import io
+
+            reader = pypdf.PdfReader(io.BytesIO(contents))
+            out = []
+            for i, page in enumerate(reader.pages):
+                text = page.extract_text() or ""
+                if apply_text_cleanup:
+                    text = " ".join(text.split())
+                if text:
+                    out.append((text, {"page": i}))
+            return out
+
+        super().__init__(parse, **kwargs)
+
+
+class ImageParser(UDF):
+    """(reference: parsers.py:396 — vision-LLM image description; here decodes
+    the image into an ndarray chunk for the CLIP image embedder path)."""
+
+    def __init__(self, downsize_to: int = 64, **kwargs):
+        def parse(contents: bytes) -> List[Chunk]:
+            import io
+
+            import numpy as np
+
+            try:
+                from PIL import Image
+            except ImportError as e:
+                raise ImportError("ImageParser requires `Pillow`") from e
+            img = Image.open(io.BytesIO(contents)).convert("RGB")
+            img = img.resize((downsize_to, downsize_to))
+            arr = np.asarray(img, dtype=np.float32) / 255.0
+            return [("", {"image": arr})]
+
+        super().__init__(parse, **kwargs)
+
+
+class SlideParser(UDF):
+    """(reference: parsers.py:569 — slide decks via vision LLM; gated)"""
+
+    def __init__(self, **kwargs):
+        raise ImportError(
+            "SlideParser requires vision-LLM tooling unavailable offline; "
+            "use ParseUtf8/PypdfParser"
+        )

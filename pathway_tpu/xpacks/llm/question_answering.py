@@ -1,0 +1,309 @@
+"""RAG question answering
+(reference: xpacks/llm/question_answering.py — BaseRAGQuestionAnswerer :314,
+AdaptiveRAGQuestionAnswerer :622, answer_with_geometric_rag_strategy
+:97/:162 — geometric document-count growth bounds LLM token cost)."""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression
+from ...internals.schema import Schema, column_definition
+from ...internals.table import Table
+from ...internals.thisclass import this
+from .document_store import DocumentStore
+from .prompts import prompt_qa, prompt_qa_geometric_rag, prompt_summarize
+
+__all__ = [
+    "BaseQuestionAnswerer",
+    "BaseRAGQuestionAnswerer",
+    "AdaptiveRAGQuestionAnswerer",
+    "RAGClient",
+    "answer_with_geometric_rag_strategy",
+    "answer_with_geometric_rag_strategy_from_index",
+]
+
+NO_ANSWER = "No information found."
+
+
+def _call_chat(llm, prompt: str) -> str:
+    """Call a chat UDF's underlying function synchronously with one prompt."""
+    fn = llm.func
+    messages = [{"role": "user", "content": prompt}]
+    if inspect.iscoroutinefunction(fn):
+        return str(asyncio.run(fn(messages)))
+    if getattr(llm, "batched", False):
+        arr = np.empty(1, dtype=object)
+        arr[0] = messages
+        return str(fn(arr)[0])
+    return str(fn(messages))
+
+
+def answer_with_geometric_rag_strategy(
+    question: str,
+    documents: Sequence[str],
+    llm,
+    n_starting_documents: int = 2,
+    factor: int = 2,
+    max_iterations: int = 4,
+    strict_prompt: bool = False,
+) -> str:
+    """Ask with 2, 4, 8, ... docs until the model finds an answer
+    (reference: question_answering.py:97 — the Adaptive RAG loop giving ~4x
+    token-cost reduction, docs/.adaptive-rag/article.py:28)."""
+    documents = list(documents)
+    n = n_starting_documents
+    for _ in range(max_iterations):
+        docs = documents[:n]
+        prompt = prompt_qa_geometric_rag(
+            question, docs, information_not_found_response=NO_ANSWER
+        )
+        answer = _call_chat(llm, prompt)
+        if answer and NO_ANSWER.lower() not in answer.lower():
+            return answer
+        if n >= len(documents):
+            break
+        n *= factor
+    return NO_ANSWER
+
+
+def answer_with_geometric_rag_strategy_from_index(
+    question_column,
+    index,
+    documents_column_name: str,
+    llm,
+    n_starting_documents: int = 2,
+    factor: int = 2,
+    max_iterations: int = 4,
+    **kwargs,
+):
+    """(reference: question_answering.py:162) — retrieve max docs once, then
+    run the geometric loop per row."""
+    max_docs = n_starting_documents * factor ** (max_iterations - 1)
+    result = index.query_as_of_now(question_column, number_of_matches=max_docs)
+    docs_table = result.select(
+        _pw_question=question_column,
+        _pw_docs=getattr(index.data_table, documents_column_name),
+    )
+    return docs_table.select(
+        result=ApplyExpression(
+            lambda q, docs: answer_with_geometric_rag_strategy(
+                q, list(docs or ()), llm, n_starting_documents, factor, max_iterations
+            ),
+            dt.STR,
+            args=(this._pw_question, this._pw_docs),
+        )
+    )
+
+
+class BaseQuestionAnswerer:
+    AnswerQuerySchema: type
+    RetrieveQuerySchema: type
+    StatisticsQuerySchema: type
+    InputsQuerySchema: type
+
+
+class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
+    """(reference: question_answering.py:314) — answer/summarize/retrieve
+    endpoints over a DocumentStore + chat model."""
+
+    class AnswerQuerySchema(Schema):
+        prompt: str
+        filters: Optional[str] = column_definition(default_value=None)
+        model: Optional[str] = column_definition(default_value=None)
+        return_context_docs: bool = column_definition(default_value=False)
+
+    class SummarizeQuerySchema(Schema):
+        text_list: Any
+        model: Optional[str] = column_definition(default_value=None)
+
+    RetrieveQuerySchema = DocumentStore.RetrieveQuerySchema
+    StatisticsQuerySchema = DocumentStore.StatisticsQuerySchema
+    InputsQuerySchema = DocumentStore.InputsQuerySchema
+
+    def __init__(
+        self,
+        llm,
+        indexer: DocumentStore,
+        *,
+        default_llm_name: Optional[str] = None,
+        search_topk: int = 6,
+        prompt_template: Callable[[str, Sequence[str]], str] = prompt_qa,
+    ):
+        self.llm = llm
+        self.indexer = indexer
+        self.search_topk = search_topk
+        self.prompt_template = prompt_template
+        self.server = None
+
+    # -- dataflow endpoints -------------------------------------------------
+    def answer_query(self, queries: Table) -> Table:
+        """prompt -> retrieve -> build prompt -> chat -> answer."""
+        topk = self.search_topk
+        store = self.indexer
+        enriched = queries.select(
+            query=this.prompt,
+            k=ApplyExpression(lambda *_: topk, dt.INT, args=()),
+            metadata_filter=this.filters,
+            filepath_globpattern=ApplyExpression(lambda *_: None, dt.ANY, args=()),
+        )
+        retrieved = store.retrieve_query(enriched)
+        llm = self.llm
+        template = self.prompt_template
+
+        def answer(prompt, docs, return_docs):
+            doc_texts = [d["text"] for d in (docs or [])]
+            response = _call_chat(llm, template(prompt, doc_texts))
+            if return_docs:
+                return {"response": response, "context_docs": docs}
+            return response
+
+        combined = queries.select(
+            _pw_prompt=this.prompt,
+            _pw_return=this.return_context_docs,
+            _pw_docs=retrieved.result,
+        )
+        return combined.select(
+            result=ApplyExpression(
+                answer, dt.ANY, args=(this._pw_prompt, this._pw_docs, this._pw_return)
+            )
+        )
+
+    def summarize_query(self, queries: Table) -> Table:
+        llm = self.llm
+
+        def summarize(text_list):
+            if isinstance(text_list, str):
+                text_list = [text_list]
+            return _call_chat(llm, prompt_summarize(list(text_list or [])))
+
+        return queries.select(
+            result=ApplyExpression(summarize, dt.STR, args=(this.text_list,))
+        )
+
+    def retrieve(self, queries: Table) -> Table:
+        return self.indexer.retrieve_query(queries)
+
+    def statistics(self, queries: Table) -> Table:
+        return self.indexer.statistics_query(queries)
+
+    def list_documents(self, queries: Table) -> Table:
+        return self.indexer.inputs_query(queries)
+
+    # -- serving ------------------------------------------------------------
+    def build_server(self, host: str, port: int, **kwargs) -> None:
+        """(reference: question_answering.py build_server)"""
+        from .servers import QASummaryRestServer
+
+        self.server = QASummaryRestServer(host, port, self, **kwargs)
+
+    def run_server(self, threaded: bool = False, with_cache: bool = True, **kwargs):
+        if self.server is None:
+            raise RuntimeError("call build_server(host, port) first")
+        return self.server.run(threaded=threaded, with_cache=with_cache, **kwargs)
+
+
+class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
+    """(reference: question_answering.py:622) — geometric context growth."""
+
+    def __init__(
+        self,
+        llm,
+        indexer: DocumentStore,
+        *,
+        n_starting_documents: int = 2,
+        factor: int = 2,
+        max_iterations: int = 4,
+        strict_prompt: bool = False,
+        **kwargs,
+    ):
+        super().__init__(llm, indexer, **kwargs)
+        self.n_starting_documents = n_starting_documents
+        self.factor = factor
+        self.max_iterations = max_iterations
+        self.strict_prompt = strict_prompt
+
+    def answer_query(self, queries: Table) -> Table:
+        max_docs = self.n_starting_documents * self.factor ** (
+            self.max_iterations - 1
+        )
+        store = self.indexer
+        enriched = queries.select(
+            query=this.prompt,
+            k=ApplyExpression(lambda *_: max_docs, dt.INT, args=()),
+            metadata_filter=this.filters,
+            filepath_globpattern=ApplyExpression(lambda *_: None, dt.ANY, args=()),
+        )
+        retrieved = store.retrieve_query(enriched)
+        llm = self.llm
+        n0, factor, iters = self.n_starting_documents, self.factor, self.max_iterations
+
+        def answer(prompt, docs):
+            doc_texts = [d["text"] for d in (docs or [])]
+            return answer_with_geometric_rag_strategy(
+                prompt, doc_texts, llm, n0, factor, iters
+            )
+
+        combined = queries.select(
+            _pw_prompt=this.prompt, _pw_docs=retrieved.result
+        )
+        return combined.select(
+            result=ApplyExpression(
+                answer, dt.STR, args=(this._pw_prompt, this._pw_docs)
+            )
+        )
+
+
+class RAGClient:
+    """HTTP client for the QA servers (reference: question_answering.py RAGClient)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000, url: Optional[str] = None):
+        self.url = url or f"http://{host}:{port}"
+
+    def _post(self, route: str, payload: dict):
+        import requests
+
+        resp = requests.post(self.url + route, json=payload, timeout=120)
+        resp.raise_for_status()
+        return resp.json()
+
+    def answer(self, prompt: str, filters: Optional[str] = None, **kwargs):
+        return self._post(
+            "/v1/pw_ai_answer", {"prompt": prompt, "filters": filters, **kwargs}
+        )
+
+    pw_ai_answer = answer
+
+    def summarize(self, text_list: List[str], **kwargs):
+        return self._post("/v1/pw_ai_summary", {"text_list": text_list, **kwargs})
+
+    pw_ai_summary = summarize
+
+    def retrieve(self, query: str, k: int = 3, metadata_filter=None, filepath_globpattern=None):
+        return self._post(
+            "/v1/retrieve",
+            {
+                "query": query,
+                "k": k,
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )
+
+    def statistics(self):
+        return self._post("/v1/statistics", {})
+
+    def list_documents(self, metadata_filter=None, filepath_globpattern=None):
+        return self._post(
+            "/v1/pw_list_documents",
+            {
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )

@@ -1,0 +1,144 @@
+"""Rerankers (reference: xpacks/llm/rerankers.py:15-345 — rerank_topk_filter,
+LLMReranker, CrossEncoderReranker, EncoderReranker, FlashRankReranker)."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression
+from ...internals.udfs import UDF
+
+__all__ = [
+    "rerank_topk_filter",
+    "LLMReranker",
+    "CrossEncoderReranker",
+    "EncoderReranker",
+    "FlashRankReranker",
+]
+
+
+def rerank_topk_filter(docs, scores, k: int = 5):
+    """Keep the k best docs by score (reference: rerankers.py:15) — expression
+    over (docs tuple, scores tuple) columns."""
+
+    def topk(doc_list, score_list):
+        if doc_list is None:
+            return ()
+        pairs = sorted(
+            zip(doc_list, score_list), key=lambda p: -float(p[1])
+        )[:k]
+        return tuple(d for d, _ in pairs), tuple(float(s) for _, s in pairs)
+
+    return ApplyExpression(topk, dt.ANY, args=(docs, scores))
+
+
+class CrossEncoderReranker(UDF):
+    """Pair scoring with the on-device cross-encoder (reference:
+    rerankers.py:186 uses sentence_transformers CrossEncoder per row; here the
+    whole micro-batch of (query, doc) pairs is one jitted forward)."""
+
+    def __init__(
+        self,
+        model_name: str = "pathway-mini-cross",
+        checkpoint_path: Optional[str] = None,
+        cross_encoder=None,
+        **kwargs,
+    ):
+        import os
+
+        if cross_encoder is not None:
+            self._model = cross_encoder
+        elif os.path.isdir(model_name):
+            from sentence_transformers import CrossEncoder
+
+            st = CrossEncoder(model_name)
+            self._model = st
+        else:
+            from ...models.cross_encoder import CrossEncoderModel
+
+            self._model = CrossEncoderModel(
+                model=model_name, checkpoint_path=checkpoint_path
+            )
+
+        model = self._model
+
+        def score(docs, queries) -> np.ndarray:
+            pairs = [(str(q), str(d)) for q, d in zip(queries, docs)]
+            return np.asarray(model.predict(pairs), dtype=np.float64)
+
+        super().__init__(score, batched=True, **kwargs)
+
+
+class EncoderReranker(UDF):
+    """Embedding dot-product reranker (reference: rerankers.py:251)."""
+
+    def __init__(self, embedder, **kwargs):
+        self._embedder = embedder
+
+        def score(docs, queries) -> np.ndarray:
+            texts = [str(d) for d in docs] + [str(q) for q in queries]
+            embs = embedder.func(np.array(texts, dtype=object))
+            embs = np.asarray([np.asarray(e) for e in embs])
+            n = len(docs)
+            de, qe = embs[:n], embs[n:]
+            de = de / np.maximum(np.linalg.norm(de, axis=1, keepdims=True), 1e-9)
+            qe = qe / np.maximum(np.linalg.norm(qe, axis=1, keepdims=True), 1e-9)
+            return np.sum(de * qe, axis=1).astype(np.float64)
+
+        super().__init__(score, batched=True, **kwargs)
+
+
+class LLMReranker(UDF):
+    """LLM scores each (doc, query) 1-5 (reference: rerankers.py:58)."""
+
+    def __init__(self, llm, *, retry_strategy=None, cache_strategy=None, **kwargs):
+        self.llm = llm
+        chat_fn = llm.func
+
+        def score(doc: str, query: str) -> float:
+            prompt = (
+                "Given a query and a document snippet, rate on an integer "
+                "scale of 1 to 5 how relevant the document is to the query. "
+                "Answer with ONLY the number.\n"
+                f"Query: {query}\nDocument: {doc}\nScore:"
+            )
+            import asyncio
+            import inspect
+
+            if inspect.iscoroutinefunction(chat_fn):
+                answer = asyncio.run(chat_fn([{"role": "user", "content": prompt}]))
+            else:
+                result = chat_fn(np.array([[{"role": "user", "content": prompt}]], dtype=object))
+                answer = result[0] if hasattr(result, "__getitem__") else result
+            m = re.search(r"[1-5]", str(answer))
+            return float(m.group(0)) if m else 1.0
+
+        super().__init__(
+            score, retry_strategy=retry_strategy, cache_strategy=cache_strategy, **kwargs
+        )
+
+
+class FlashRankReranker(UDF):
+    """(reference: rerankers.py:319 — flashrank library; gated)"""
+
+    def __init__(self, model: str = "ms-marco-TinyBERT-L-2-v2", **kwargs):
+        try:
+            from flashrank import Ranker, RerankRequest
+        except ImportError as e:
+            raise ImportError(
+                "FlashRankReranker requires the `flashrank` package; use "
+                "CrossEncoderReranker for the on-device equivalent"
+            ) from e
+        ranker = Ranker(model_name=model)
+
+        def score(doc: str, query: str) -> float:
+            from flashrank import RerankRequest
+
+            req = RerankRequest(query=str(query), passages=[{"text": str(doc)}])
+            return float(ranker.rerank(req)[0]["score"])
+
+        super().__init__(score, **kwargs)

@@ -1,13 +1,19 @@
 import os
 
 # Virtual 8-device CPU mesh for sharding tests (tests never need the real TPU;
-# the driver benchmarks separately on hardware).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the driver benchmarks separately on hardware).  The TPU plugin registers at
+# interpreter startup via sitecustomize, so env vars alone are unreliable —
+# flip the jax config to cpu BEFORE the first backend initialisation, which
+# skips the plugin entirely (and survives a wedged device tunnel).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,223 @@
+"""Index stack tests: device KNN, BM25, hybrid, DataIndex query semantics
+(reference suites: python/pathway/tests/external_index/, tests/ml)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.executor import Executor
+from pathway_tpu.internals.keys import ref_scalar
+from pathway_tpu.stdlib.indexing import (
+    BruteForceKnnFactory,
+    DataIndex,
+    HybridIndexFactory,
+    InnerIndex,
+    TantivyBM25Factory,
+    TpuKnnFactory,
+)
+from pathway_tpu.stdlib.indexing.filters import compile_filter
+
+from .test_streaming import make_executor, make_stream_table, rows_of
+from .utils import T, assert_rows
+
+
+def _vec(*xs):
+    return np.array(xs, dtype=np.float32)
+
+
+def docs_table():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, vec=np.ndarray),
+        [
+            ("a", _vec(1, 0, 0, 0)),
+            ("b", _vec(0, 1, 0, 0)),
+            ("c", _vec(0.9, 0.1, 0, 0)),
+        ],
+    )
+
+
+def test_data_index_collapsed():
+    docs = docs_table()
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qv=np.ndarray),
+        [(_vec(1, 0.05, 0, 0),)],
+    )
+    index = DataIndex(
+        docs,
+        InnerIndex(
+            data_column=docs.vec,
+            factory=BruteForceKnnFactory(dimension=4),
+            dimension=4,
+        ),
+    )
+    result = index.query_as_of_now(queries.qv, number_of_matches=2)
+    out = result.select(names=docs.name, scores=result.score)
+    pw.run(monitoring_level=None)
+    keys, cols = out._materialize()
+    assert len(keys) == 1
+    assert cols["names"][0] == ("a", "c")
+    assert len(cols["scores"][0]) == 2
+
+
+def test_data_index_flat():
+    docs = docs_table()
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qv=np.ndarray),
+        [(_vec(0, 1, 0, 0),)],
+    )
+    index = DataIndex(
+        docs,
+        InnerIndex(
+            data_column=docs.vec,
+            factory=BruteForceKnnFactory(dimension=4),
+            dimension=4,
+        ),
+    )
+    result = index.query_as_of_now(queries.qv, number_of_matches=2, collapse_rows=False)
+    out = result.select(name=docs.name, score=result.score)
+    pw.run(monitoring_level=None)
+    keys, cols = out._materialize()
+    assert sorted(cols["name"]) == ["a", "b"] or sorted(cols["name"]) == ["b", "c"]
+    assert cols["name"][np.argmax(cols["score"])] == "b"
+
+
+def test_metadata_filter():
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, vec=np.ndarray, meta=dict),
+        [
+            ("a", _vec(1, 0), {"lang": "en"}),
+            ("b", _vec(0.99, 0.1), {"lang": "fr"}),
+            ("c", _vec(0.98, 0.15), {"lang": "en"}),
+        ],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qv=np.ndarray, filt=str),
+        [(_vec(1, 0), "lang == 'en'")],
+    )
+    index = DataIndex(
+        docs,
+        InnerIndex(
+            data_column=docs.vec,
+            metadata_column=docs.meta,
+            factory=BruteForceKnnFactory(dimension=2),
+            dimension=2,
+        ),
+    )
+    result = index.query_as_of_now(
+        queries.qv, number_of_matches=2, metadata_filter=queries.filt
+    )
+    out = result.select(names=docs.name)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert cols["names"][0] == ("a", "c")
+
+
+def test_bm25_index():
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str),
+        [
+            ("the quick brown fox",),
+            ("jumped over the lazy dog",),
+            ("quick quick quick repetition",),
+        ],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [("quick fox",)]
+    )
+    index = DataIndex(
+        docs,
+        InnerIndex(data_column=docs.text, factory=TantivyBM25Factory()),
+    )
+    result = index.query_as_of_now(queries.q, number_of_matches=2)
+    out = result.select(texts=docs.text)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert "the quick brown fox" in cols["texts"][0]
+
+
+def test_streaming_index_as_of_now_vs_consistent():
+    docs, dsession = make_stream_table(vec=np.ndarray)
+    queries, qsession = make_stream_table(qv=np.ndarray)
+    index = DataIndex(
+        docs,
+        InnerIndex(
+            data_column=docs.vec,
+            factory=BruteForceKnnFactory(dimension=2),
+            dimension=2,
+        ),
+    )
+    asof = index.query_as_of_now(queries.qv, number_of_matches=1).select(
+        score=index.query_as_of_now.__self__ and None  # placeholder no-op
+    ) if False else None
+    r_asof = index.query_as_of_now(queries.qv, number_of_matches=1)
+    out_asof = r_asof.select(s=r_asof.score)
+    r_cons = index.query(queries.qv, number_of_matches=1)
+    out_cons = r_cons.select(s=r_cons.score)
+    ex = make_executor()
+
+    dsession.insert(int(ref_scalar(1)), (_vec(1, 0),))
+    ex.step()
+    qsession.insert(int(ref_scalar(10)), (_vec(0.9, 0.1),))
+    ex.step()
+    asof_before = rows_of(out_asof)
+    cons_before = rows_of(out_cons)
+    assert len(asof_before) == 1 and len(cons_before) == 1
+
+    # add a closer doc AFTER the query
+    dsession.insert(int(ref_scalar(2)), (_vec(0.9, 0.1),))
+    ex.step()
+    assert rows_of(out_asof) == asof_before  # as-of-now never updates
+    cons_after = rows_of(out_cons)
+    assert cons_after != cons_before  # consistent mode re-answers
+    assert cons_after[0][0][0] > cons_before[0][0][0]
+
+
+def test_hybrid_index():
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, both=tuple),
+        [
+            ("a", (_vec(1, 0), "alpha document")),
+            ("b", (_vec(0, 1), "beta document")),
+        ],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qb=tuple),
+        [((_vec(1, 0), "alpha"),)],
+    )
+    factory = HybridIndexFactory(
+        [BruteForceKnnFactory(dimension=2), TantivyBM25Factory()]
+    )
+    index = DataIndex(
+        docs, InnerIndex(data_column=docs.both, factory=factory, dimension=2)
+    )
+    result = index.query_as_of_now(queries.qb, number_of_matches=1)
+    out = result.select(names=docs.name)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert cols["names"][0] == ("a",)
+
+
+def test_knn_index_legacy_api():
+    docs = docs_table()
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qv=np.ndarray),
+        [(_vec(0.95, 0.05, 0, 0),)],
+    )
+    knn = pw.ml.index.KNNIndex(docs.vec, docs, n_dimensions=4)
+    out = knn.get_nearest_items(queries.qv, k=2, with_distances=True)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert cols["name"][0] == ("a", "c")
+
+
+def test_filter_language():
+    f = compile_filter("a == 'x' && n > 3")
+    assert f({"a": "x", "n": 4})
+    assert not f({"a": "x", "n": 2})
+    assert not f({"a": "y", "n": 9})
+    g = compile_filter("globmatch('*.md', path) || contains(tags, 'keep')")
+    assert g({"path": "doc/readme.md", "tags": []})
+    assert g({"path": "a.py", "tags": ["keep", "x"]})
+    assert not g({"path": "a.py", "tags": ["drop"]})
+    h = compile_filter("!(owner == 'alice')")
+    assert h({"owner": "bob"}) and not h({"owner": "alice"})

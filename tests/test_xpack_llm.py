@@ -1,0 +1,200 @@
+"""LLM xpack tests with fake embedders/chats
+(reference strategy: xpacks/llm/tests/mocks.py + test_vector_store.py:408,
+test_document_store.py:665 — canned models, debug batch mode)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.embedders import BaseEmbedder
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+
+from .utils import T
+
+
+class FakeEmbedder(BaseEmbedder):
+    """Deterministic 8-dim embedding: counts of marker words."""
+
+    WORDS = ["cat", "dog", "fish", "bird", "tree", "rock", "sun", "moon"]
+
+    def __init__(self):
+        words = self.WORDS
+
+        def embed(texts) -> np.ndarray:
+            out = np.zeros((len(texts), 8), np.float32)
+            for i, t in enumerate(texts):
+                for j, w in enumerate(words):
+                    out[i, j] = str(t).lower().count(w)
+                n = np.linalg.norm(out[i])
+                if n > 0:
+                    out[i] /= n
+                else:
+                    out[i, -1] = 1.0
+            return out
+
+        super().__init__(embed, batched=True)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return 8
+
+
+class FakeChat(pw.UDF):
+    """Echoes the number of sources it can see; 'answers' only when the
+    keyword is in context."""
+
+    def __init__(self, keyword="cat"):
+        self.calls = []
+        kw = keyword
+        calls = self.calls
+
+        def chat(messages) -> str:
+            content = messages[0]["content"] if isinstance(messages, list) else str(messages)
+            calls.append(content)
+            if kw in content.lower():
+                return f"answer about {kw}"
+            return "No information found."
+
+        super().__init__(chat)
+
+
+def docs_table():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(data=str, _metadata=dict),
+        [
+            ("the cat sat on the mat.", {"path": "a.txt"}),
+            ("a dog chased the ball.", {"path": "b.txt"}),
+            ("fish swim in the sea. " * 3, {"path": "c.md"}),
+        ],
+    )
+
+
+def make_store():
+    embedder = FakeEmbedder()
+    return DocumentStore(
+        docs_table(),
+        retriever_factory=BruteForceKnnFactory(dimension=8, embedder=embedder),
+        splitter=None,
+    )
+
+
+def retrieve_queries(rows):
+    return pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        rows,
+    )
+
+
+def test_document_store_retrieve():
+    store = make_store()
+    queries = retrieve_queries([("cat", 2, None, None)])
+    out = store.retrieve_query(queries)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    results = cols["result"][0]
+    assert len(results) == 2
+    assert "cat" in results[0]["text"]
+    assert results[0]["metadata"]["path"] == "a.txt"
+
+
+def test_document_store_glob_filter():
+    store = make_store()
+    queries = retrieve_queries([("fish cat dog", 3, None, "*.md")])
+    out = store.retrieve_query(queries)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    results = cols["result"][0]
+    assert all(r["metadata"]["path"].endswith(".md") for r in results)
+    assert len(results) == 1
+
+
+def test_document_store_inputs_and_statistics():
+    store = make_store()
+    inputs_q = pw.debug.table_from_rows(
+        DocumentStore.InputsQuerySchema, [(None, None)]
+    )
+    stats_q = pw.debug.table_from_rows(DocumentStore.StatisticsQuerySchema, [()])
+    inputs_out = store.inputs_query(inputs_q)
+    stats_out = store.statistics_query(stats_q)
+    pw.run(monitoring_level=None)
+    _, icols = inputs_out._materialize()
+    paths = sorted(d["path"] for d in icols["result"][0])
+    assert paths == ["a.txt", "b.txt", "c.md"]
+    _, scols = stats_out._materialize()
+    assert scols["result"][0]["file_count"] == 3
+
+
+def test_token_count_splitter():
+    sp = TokenCountSplitter(min_tokens=3, max_tokens=6)
+    chunks = sp.func("one two three four. five six seven eight nine ten eleven.")
+    assert all(isinstance(c, tuple) for c in chunks)
+    text = " ".join(c[0] for c in chunks)
+    assert "eleven" in text
+    assert len(chunks) >= 2
+
+
+def test_geometric_rag_strategy():
+    chat = FakeChat(keyword="cat")
+    docs = ["dog story", "bird story", "cat story", "rock story"]
+    answer = answer_with_geometric_rag_strategy(
+        "who sat?", docs, chat, n_starting_documents=1, factor=2, max_iterations=4
+    )
+    assert answer == "answer about cat"
+    # 1 doc (miss), 2 docs (miss), 4 docs (hit) -> 3 LLM calls
+    assert len(chat.calls) == 3
+
+
+def test_rag_question_answerer():
+    store = make_store()
+    chat = FakeChat(keyword="cat")
+    rag = BaseRAGQuestionAnswerer(chat, store, search_topk=2)
+    queries = pw.debug.table_from_rows(
+        BaseRAGQuestionAnswerer.AnswerQuerySchema,
+        [("cat question", None, None, False)],
+    )
+    out = rag.answer_query(queries)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert cols["result"][0] == "answer about cat"
+
+
+def test_adaptive_rag_question_answerer():
+    store = make_store()
+    chat = FakeChat(keyword="cat")
+    rag = AdaptiveRAGQuestionAnswerer(
+        chat, store, n_starting_documents=1, factor=2, max_iterations=2
+    )
+    queries = pw.debug.table_from_rows(
+        BaseRAGQuestionAnswerer.AnswerQuerySchema,
+        [("cat question", None, None, False)],
+    )
+    out = rag.answer_query(queries)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert cols["result"][0] == "answer about cat"
+
+
+def test_cross_encoder_reranker_shape():
+    from pathway_tpu.xpacks.llm.rerankers import CrossEncoderReranker
+
+    rr = CrossEncoderReranker(model_name="tiny", cross_encoder=None)
+
+
+def test_rerank_topk_filter():
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(docs=tuple, scores=tuple),
+        [(("a", "b", "c"), (0.1, 0.9, 0.5))],
+    )
+    out = t.select(best=rerank_topk_filter(t.docs, t.scores, 2))
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    docs, scores = cols["best"][0]
+    assert docs == ("b", "c")
