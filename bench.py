@@ -1,0 +1,93 @@
+"""Headline benchmark: end-to-end live retrieval latency.
+
+Measures the north-star path (BASELINE.json / SURVEY.md §3.3): query text ->
+on-device SentenceEncoder embedding -> sharded DeviceKnnIndex search (one
+[B,d]x[d,N] matmul on the MXU + lax.top_k) over a 1M-document index in HBM.
+
+Prints ONE JSON line:
+  {"metric": "retrieval_p50_ms_1M", "value": p50_ms, "unit": "ms",
+   "vs_baseline": 50.0 / p50_ms}
+vs_baseline > 1.0 means better than the driver-set target of 50 ms p50
+(BASELINE.md: <50 ms on v5e-16 at 1M docs; here a single chip holds all 1M).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    n_docs = int(
+        os.environ.get(
+            "BENCH_N_DOCS", "1000000" if backend == "tpu" else "100000"
+        )
+    )
+    dim = 384
+    n_queries = 64
+    k = 10
+
+    from pathway_tpu.models.encoder import SentenceEncoder
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    encoder = SentenceEncoder(dimension=dim, n_layers=6, max_length=128)
+    index = DeviceKnnIndex(dimension=dim, metric="cos", initial_capacity=n_docs)
+
+    rng = np.random.default_rng(0)
+    t_ingest0 = time.perf_counter()
+    chunk = 65536
+    for start in range(0, n_docs, chunk):
+        n = min(chunk, n_docs - start)
+        vecs = rng.normal(size=(n, dim)).astype(np.float32)
+        index.add(range(start, start + n), vecs)
+    ingest_s = time.perf_counter() - t_ingest0
+
+    queries = [
+        f"how does incremental dataflow pipeline number {i} maintain a live "
+        f"vector index with streaming updates and exactly once consistency"
+        for i in range(n_queries)
+    ]
+
+    def serve_once():
+        emb = encoder.encode(queries)  # [B, d] on-device forward
+        return index.search(emb, k=k)  # MXU matmul + top-k
+
+    # warmup: compile encoder fwd + search kernel
+    hits = serve_once()
+    assert len(hits) == n_queries and len(hits[0]) == k
+
+    latencies = []
+    n_iter = int(os.environ.get("BENCH_ITERS", "30"))
+    for _ in range(n_iter):
+        t0 = time.perf_counter()
+        serve_once()
+        latencies.append((time.perf_counter() - t0) * 1e3)
+
+    p50 = float(np.percentile(latencies, 50))
+    print(
+        f"[bench] backend={backend} docs={n_docs} queries/batch={n_queries} "
+        f"k={k} ingest={ingest_s:.1f}s ({n_docs/ingest_s:.0f} docs/s) "
+        f"p50={p50:.2f}ms p95={float(np.percentile(latencies, 95)):.2f}ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"retrieval_p50_ms_{'1M' if n_docs >= 10**6 else n_docs}",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(50.0 / p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -135,15 +135,7 @@ def table_transformer(fn=None, **kwargs):
     return wrap(fn) if fn is not None else wrap
 
 
-def iterate(func, iteration_limit: int = 128, **kwargs):
-    """Fixed-point iteration (reference pw.iterate, internals/common.py:39).
-
-    Round-1 semantics: applies ``func`` repeatedly on materialised static
-    data until convergence.  Streaming fixed-point scopes land with the
-    iterate operator in a later revision."""
-    raise NotImplementedError(
-        "pw.iterate is not yet available in pathway_tpu; see ROADMAP"
-    )
+from .internals.iterate import iterate  # noqa: E402
 
 
 # Heavy subpackages (flax model zoo, LLM xpack, device kernels) load lazily

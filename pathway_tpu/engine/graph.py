@@ -89,6 +89,14 @@ class EngineOperator:
         """Called when all sources are exhausted (flush buffers)."""
         return None
 
+    def snapshot_state(self):
+        """Serializable operator state for OPERATOR_PERSISTING checkpoints
+        (reference operator_snapshot.rs); stateless operators raise."""
+        raise NotImplementedError
+
+    def restore_state(self, state) -> None:
+        raise NotImplementedError
+
     def __repr__(self):  # pragma: no cover
         return f"<{self.name}#{self.id}>"
 
@@ -175,27 +183,38 @@ class EngineGraph:
                         heap, (consumer.topo_index, next(seq), consumer, cport, out)
                     )
 
+    def _collect(self, op, out, pending) -> None:
+        """Queue an operator's tick-end/flush output; ``out`` is either a
+        Delta for ``op.output`` or a list of (table, delta) for multi-output
+        operators (iterate)."""
+        if out is None:
+            return
+        if isinstance(out, list):
+            for table, delta in out:
+                if delta is None or delta.n == 0:
+                    continue
+                delta = delta.consolidated()
+                table.store.apply(delta)
+                for consumer, cport in table.consumers:
+                    pending.append((consumer, cport, delta))
+            return
+        if out.n > 0 and op.output is not None:
+            out = out.consolidated()
+            op.output.store.apply(out)
+            for consumer, cport in op.output.consumers:
+                pending.append((consumer, cport, out))
+
     def tick_end(self, ts: int) -> None:
         """Run on_tick_end hooks (time-based operators may release buffers)."""
         pending: List[Tuple[EngineOperator, int, Delta]] = []
         for op in sorted(self.operators, key=lambda o: o.topo_index):
-            out = op.on_tick_end(ts)
-            if out is not None and out.n > 0 and op.output is not None:
-                out = out.consolidated()
-                op.output.store.apply(out)
-                for consumer, cport in op.output.consumers:
-                    pending.append((consumer, cport, out))
+            self._collect(op, op.on_tick_end(ts), pending)
         if pending:
             self.propagate(pending, ts)
 
     def flush_end(self, ts: int) -> None:
         pending: List[Tuple[EngineOperator, int, Delta]] = []
         for op in sorted(self.operators, key=lambda o: o.topo_index):
-            out = op.on_end()
-            if out is not None and out.n > 0 and op.output is not None:
-                out = out.consolidated()
-                op.output.store.apply(out)
-                for consumer, cport in op.output.consumers:
-                    pending.append((consumer, cport, out))
+            self._collect(op, op.on_end(), pending)
         if pending:
             self.propagate(pending, ts)

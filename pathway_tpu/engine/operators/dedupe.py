@@ -37,6 +37,12 @@ class DeduplicateOperator(EngineOperator):
         # instance key -> (accepted value, row)
         self._state: Dict[int, Tuple[Any, Tuple[Any, ...]]] = {}
 
+    def snapshot_state(self):
+        return self._state
+
+    def restore_state(self, state) -> None:
+        self._state = state
+
     def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
         ins = delta.insertions()
         if ins.n == 0:

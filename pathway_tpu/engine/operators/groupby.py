@@ -58,6 +58,12 @@ class GroupByOperator(EngineOperator):
         # group_key -> [row_count, grouping_values_tuple, [reducer states]]
         self._groups: Dict[int, List[Any]] = {}
 
+    def snapshot_state(self):
+        return self._groups
+
+    def restore_state(self, state) -> None:
+        self._groups = state
+
     def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
         if delta.n == 0:
             return None

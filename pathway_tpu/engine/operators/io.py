@@ -35,16 +35,24 @@ class InputSession:
         self._events: List[Tuple[int, int, Optional[Tuple[Any, ...]]]] = []
         self.upsert = upsert
         self.finished = False
+        # persistence hook: called with each raw event as it is appended
+        # (persistence/engine_state.py SourcePersistence.record); replayed
+        # events injected via push_raw are deliberately not re-recorded
+        self.recorder = None
 
     def insert(self, key: int, row: Tuple[Any, ...]) -> None:
+        event = (_UPSERT if self.upsert else _INSERT, key, row)
         with self._lock:
-            self._events.append((_UPSERT if self.upsert else _INSERT, key, row))
+            self._events.append(event)
+        if self.recorder is not None:
+            self.recorder(event)
 
     def remove(self, key: int, row: Optional[Tuple[Any, ...]] = None) -> None:
+        event = (_DELETE_BY_KEY if row is None else _REMOVE, key, row)
         with self._lock:
-            self._events.append(
-                (_DELETE_BY_KEY if row is None else _REMOVE, key, row)
-            )
+            self._events.append(event)
+        if self.recorder is not None:
+            self.recorder(event)
 
     def close(self) -> None:
         with self._lock:
@@ -54,6 +62,11 @@ class InputSession:
         with self._lock:
             events, self._events = self._events, []
             return events
+
+    def push_raw(self, events: List[Tuple[int, int, Optional[Tuple[Any, ...]]]]) -> None:
+        """Inject raw events verbatim (persistence replay path)."""
+        with self._lock:
+            self._events.extend(events)
 
     @property
     def has_pending(self) -> bool:

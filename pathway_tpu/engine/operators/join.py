@@ -78,6 +78,13 @@ class JoinOperator(EngineOperator):
         self._left: Dict[int, Dict[int, Tuple[Any, ...]]] = {}
         self._right: Dict[int, Dict[int, Tuple[Any, ...]]] = {}
 
+    def snapshot_state(self):
+        return {"left": self._left, "right": self._right}
+
+    def restore_state(self, state) -> None:
+        self._left = state["left"]
+        self._right = state["right"]
+
     # -- helpers -----------------------------------------------------------
     def _join_keys(self, delta: Delta, side: int) -> np.ndarray:
         exprs = self.left_key_exprs if side == 0 else self.right_key_exprs

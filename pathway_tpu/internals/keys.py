@@ -107,11 +107,15 @@ def sequential_keys(start: int, count: int, salt: int = 0) -> np.ndarray:
     Hashing (vs. raw counters) keeps the shard distribution uniform, which is
     what the sharded index/groupby paths on the mesh rely on."""
     idx = np.arange(start, start + count, dtype=np.uint64)
-    # splitmix64 finalizer - cheap, vectorized, well distributed
-    z = idx + np.uint64(_SEQ_SALT) + (np.uint64(salt) * np.uint64(0xBF58476D1CE4E5B9))
-    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    z = z ^ (z >> np.uint64(31))
+    # splitmix64 finalizer - cheap, vectorized, well distributed; uint64
+    # wraparound is intentional (mod-2^64 arithmetic)
+    with np.errstate(over="ignore"):
+        z = idx + np.uint64(_SEQ_SALT) + (
+            np.uint64(salt) * np.uint64(0xBF58476D1CE4E5B9)
+        )
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
     return z.astype(KEY_DTYPE)
 
 

@@ -35,6 +35,7 @@ def run(
     monitoring_level=None,
     with_http_server: bool = False,
     debug: bool = False,
+    persistence_config=None,
     **kwargs,
 ) -> None:
     global _current_executor
@@ -59,15 +60,29 @@ def run(
     executor = Executor(G.engine_graph, commit_duration_ms)
     with _executor_lock:
         _current_executor = executor
+    tick_hooks = []
+    manager = None
+    if persistence_config is not None and persistence_config.backend is not None:
+        from ..persistence.engine_state import PersistenceManager
+
+        manager = PersistenceManager(persistence_config)
+        manager.attach(G.engine_graph)
+        tick_hooks.append(manager.on_tick)
     monitor = None
     if monitoring_level is not None and str(monitoring_level) not in ("MonitoringLevel.NONE", "none"):
         try:
             from .monitoring import StatsMonitor
 
             monitor = StatsMonitor(G.engine_graph)
-            executor.on_tick = monitor.on_tick
+            tick_hooks.append(monitor.on_tick)
         except Exception:
             monitor = None
+    if tick_hooks:
+        executor.on_tick = (
+            tick_hooks[0]
+            if len(tick_hooks) == 1
+            else (lambda ts: [h(ts) for h in tick_hooks])
+        )
     if with_http_server:
         try:
             from .metrics import start_metrics_server
@@ -82,6 +97,16 @@ def run(
         executor.run(bootstrap=bootstrap)
         G.ran_ops.update(op.id for op in G.engine_graph.operators)
     finally:
+        if manager is not None:
+            try:
+                manager.finalize(executor.current_ts)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "final persistence commit failed — events since the last "
+                    "interval snapshot were NOT persisted"
+                )
         for hook in G.post_run_hooks:
             try:
                 hook()

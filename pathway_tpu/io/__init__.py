@@ -1,26 +1,70 @@
 """pw.io — connectors
-(reference inventory: python/pathway/io/ — fs, csv, jsonlines, plaintext,
-kafka, s3, http, python, debezium, postgres, elasticsearch, … — SURVEY.md
-§2.8).  Implemented natively here: fs/csv/jsonlines/plaintext/binary, python
-subjects, http (REST server), subscribe, null; service-backed connectors
-(kafka, s3, postgres, …) arrive as optional backends behind the same
-Reader/Writer split."""
+(reference inventory: python/pathway/io/ — SURVEY.md §2.8).
+
+Natively implemented: fs/csv/jsonlines/plaintext/binary, python subjects,
+http (REST server), subscribe, null, sqlite, debezium (file transport),
+elasticsearch/logstash/slack (REST via requests), bigquery (bundled client).
+Service-library-gated (import succeeds, transport errors with a clear
+message at call time): kafka, redpanda, nats, s3/minio, deltalake, postgres,
+mongodb, pubsub, gdrive, airbyte.
+"""
 
 from __future__ import annotations
 
-from . import csv, fs, jsonlines, null, plaintext, python
+from . import (
+    airbyte,
+    bigquery,
+    csv,
+    debezium,
+    deltalake,
+    elasticsearch,
+    fs,
+    gdrive,
+    jsonlines,
+    kafka,
+    logstash,
+    minio,
+    mongodb,
+    nats,
+    null,
+    plaintext,
+    postgres,
+    pubsub,
+    python,
+    redpanda,
+    s3,
+    slack,
+    sqlite,
+)
 from ._subscribe import subscribe
 
 # http imported lazily (aiohttp); kept importable as pw.io.http
 from . import http  # noqa: E402
 
 __all__ = [
+    "airbyte",
+    "bigquery",
     "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
     "fs",
+    "gdrive",
+    "http",
     "jsonlines",
+    "kafka",
+    "logstash",
+    "minio",
+    "mongodb",
+    "nats",
     "null",
     "plaintext",
+    "postgres",
+    "pubsub",
     "python",
-    "http",
+    "redpanda",
+    "s3",
+    "slack",
+    "sqlite",
     "subscribe",
 ]

@@ -22,7 +22,20 @@ from ..internals.schema import Schema
 from ..internals.table import Table
 from ..internals.universe import Universe
 
-__all__ = ["SessionWriter", "register_source", "coerce_row_types"]
+__all__ = ["SessionWriter", "register_source", "coerce_row_types", "jsonable"]
+
+
+def jsonable(v):
+    """Coerce engine values to JSON-encodable ones (shared by all writers)."""
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    return v
 
 
 class SessionWriter:
@@ -45,6 +58,9 @@ class SessionWriter:
         self._counter = 0
         self._salt = salt
         self._lock = threading.Lock()
+        # set by the PersistenceManager when a persistence config is active
+        # (persistence/engine_state.py SourcePersistence)
+        self.persistence = None
 
     def key_of(self, values: Mapping[str, Any]) -> int:
         if self.primary_key:
@@ -104,6 +120,7 @@ def register_source(
     mode: str = "streaming",
     upsert: bool = False,
     name: str = "source",
+    persistent_id: Optional[str] = None,
 ) -> Table:
     """Create the engine source + api table and schedule ``runner`` to feed it.
 
@@ -119,9 +136,11 @@ def register_source(
         session, column_names, schema.primary_key_columns(), dtypes, salt=salt
     )
     et = G.engine_graph.add_table(column_names, name)
-    G.engine_graph.add_operator(
+    op = G.engine_graph.add_operator(
         SourceOperator(et, session, dtypes, name=name)
     )
+    op.persistent_id = persistent_id
+    op.writer = writer
 
     if mode == "static":
 

@@ -1,0 +1,28 @@
+"""pw.io.airbyte — Airbyte sources
+(reference: python/pathway/io/airbyte/__init__.py + vendored
+airbyte_serverless — 300+ SaaS sources via Airbyte connector docker images /
+pypi packages).  Gated: requires an airbyte runner (docker or
+airbyte-serverless), neither bundled."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ...internals.table import Table
+
+__all__ = ["read"]
+
+
+def read(
+    config_file_path: str,
+    streams: List[str],
+    *,
+    mode: str = "streaming",
+    refresh_interval_ms: int = 60000,
+    **kwargs,
+) -> Table:
+    raise ImportError(
+        "pw.io.airbyte requires an Airbyte source runner (docker or the "
+        "airbyte-serverless package), which is not installed in this "
+        "environment; ingest via pw.io.kafka / pw.io.fs instead"
+    )

@@ -1,0 +1,56 @@
+"""pw.io.bigquery — BigQuery output connector
+(reference: python/pathway/io/bigquery/__init__.py — streams the update
+stream into a table via the google-cloud-bigquery client, which IS bundled
+in this image)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["write"]
+
+
+def write(
+    table: Table,
+    dataset_name: str,
+    table_name: str,
+    *,
+    service_user_credentials_file: Optional[str] = None,
+    max_batch_size: int = 500,
+    **kwargs,
+) -> None:
+    from google.cloud import bigquery  # bundled
+
+    if service_user_credentials_file:
+        client = bigquery.Client.from_service_account_json(
+            service_user_credentials_file
+        )
+    else:
+        client = bigquery.Client()
+    table_ref = f"{client.project}.{dataset_name}.{table_name}"
+    names = table.column_names
+    buffer = []
+
+    def on_change(key, row, time, is_addition):
+        rec = {n: _plain(row[n]) for n in names}
+        rec["time"] = time
+        rec["diff"] = 1 if is_addition else -1
+        buffer.append(rec)
+        if len(buffer) >= max_batch_size:
+            flush()
+
+    def flush(ts=None):
+        if not buffer:
+            return
+        errors = client.insert_rows_json(table_ref, list(buffer))
+        del buffer[:]
+        if errors:
+            raise RuntimeError(f"BigQuery insert errors: {errors}")
+
+    subscribe(table, on_change=on_change, on_time_end=flush, on_end=flush)
+
+
+from .._connector import jsonable as _plain  # noqa: E402

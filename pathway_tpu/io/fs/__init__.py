@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional, Sequence, Type
 from ...internals import dtype as dt
 from ...internals.schema import Schema, schema_from_types
 from ...internals.table import Table
-from .._connector import SessionWriter, register_source
+from .._connector import SessionWriter, jsonable, register_source
 
 __all__ = ["read", "write"]
 
@@ -28,6 +28,61 @@ def _expand(path: str) -> List[str]:
                 out.append(os.path.join(root, f))
         return out
     return sorted(_glob.glob(path)) or ([path] if os.path.exists(path) else [])
+
+
+def _parse_into(
+    fpath: str,
+    writer: SessionWriter,
+    format: str,
+    schema: Optional[Type[Schema]],
+    with_metadata: bool = False,
+) -> None:
+    """Parse one local file into the session (shared by fs/s3/gdrive)."""
+    columns = (
+        [c for c in schema.columns().keys() if c != "_metadata"]
+        if schema is not None
+        else ["data"]
+    )
+    meta = None
+    if with_metadata:
+        st = os.stat(fpath)
+        meta = {
+            "path": fpath,
+            "size": st.st_size,
+            "modified_at": int(st.st_mtime),
+            "created_at": int(st.st_ctime),
+            "seen_at": int(time.time()),
+        }
+
+    def emit(values: Dict[str, Any]):
+        if with_metadata:
+            values = {**values, "_metadata": meta}
+        writer.insert(values)
+
+    if format == "csv":
+        with open(fpath, newline="") as f:
+            for row in _csv.DictReader(f):
+                emit({c: row.get(c) for c in columns})
+    elif format in ("json", "jsonlines"):
+        with open(fpath) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = _json.loads(line)
+                emit({c: obj.get(c) for c in columns})
+    elif format in ("plaintext",):
+        with open(fpath) as f:
+            for line in f:
+                emit({"data": line.rstrip("\n")})
+    elif format == "plaintext_by_file":
+        with open(fpath) as f:
+            emit({"data": f.read()})
+    elif format == "binary":
+        with open(fpath, "rb") as f:
+            emit({"data": f.read()})
+    else:
+        raise ValueError(f"unknown format {format!r}")
 
 
 def _plaintext_schema():
@@ -50,6 +105,7 @@ def read(
     autocommit_duration_ms: int = 100,
     name: str = "fs",
     poll_interval_s: float = 1.0,
+    persistent_id: Optional[str] = None,
     **kwargs,
 ) -> Table:
     """Read files under ``path``.  ``mode="static"`` reads once;
@@ -71,57 +127,35 @@ def read(
     dtypes = schema.typehints()
 
     def parse_file(fpath: str, writer: SessionWriter):
-        meta = None
-        if with_metadata:
-            st = os.stat(fpath)
-            meta = {
-                "path": fpath,
-                "size": st.st_size,
-                "modified_at": int(st.st_mtime),
-                "created_at": int(st.st_ctime),
-                "seen_at": int(time.time()),
-            }
+        _parse_into(fpath, writer, format, schema, with_metadata=with_metadata)
 
-        def emit(values: Dict[str, Any]):
-            if with_metadata:
-                values = {**values, "_metadata": meta}
-            writer.insert(values)
-
-        if format == "csv":
-            with open(fpath, newline="") as f:
-                for row in _csv.DictReader(f):
-                    emit({c: row.get(c) for c in columns})
-        elif format in ("json", "jsonlines"):
-            with open(fpath) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    obj = _json.loads(line)
-                    emit({c: obj.get(c) for c in columns})
-        elif format in ("plaintext",):
-            with open(fpath) as f:
-                for line in f:
-                    emit({"data": line.rstrip("\n")})
-        elif format == "plaintext_by_file":
-            with open(fpath) as f:
-                emit({"data": f.read()})
-        elif format == "binary":
-            with open(fpath, "rb") as f:
-                emit({"data": f.read()})
-        else:
-            raise ValueError(f"unknown format {format!r}")
-
+    # offsets for persistence = {path: mtime} of fully-ingested files; after
+    # snapshot replay the runner seeks past them (reference seek semantics,
+    # src/connectors/mod.rs ReadersQueryPurpose)
     if mode == "static":
 
         def runner(writer: SessionWriter):
+            pers = writer.persistence
+            seen: Dict[str, float] = dict((pers.offsets() or {}) if pers else {})
             for fpath in _expand(path):
+                try:
+                    mtime = os.path.getmtime(fpath)
+                except OSError:
+                    continue
+                if seen.get(fpath) == mtime:
+                    continue
                 parse_file(fpath, writer)
+                seen[fpath] = mtime
+            if pers is not None:
+                pers.save_offsets(dict(seen))
 
-        return register_source(schema, runner, mode="static", name=name)
+        return register_source(
+            schema, runner, mode="static", name=name, persistent_id=persistent_id
+        )
 
     def runner(writer: SessionWriter):
-        seen: Dict[str, float] = {}
+        pers = writer.persistence
+        seen: Dict[str, float] = dict((pers.offsets() or {}) if pers else {})
         while True:
             for fpath in _expand(path):
                 try:
@@ -130,11 +164,18 @@ def read(
                     continue
                 if seen.get(fpath) == mtime:
                     continue
-                seen[fpath] = mtime
+                # mark ingested only AFTER the parse completes, and hand the
+                # persistence layer its own copy — a snapshot taken mid-parse
+                # must not claim the file was fully read
                 parse_file(fpath, writer)
+                seen[fpath] = mtime
+                if pers is not None:
+                    pers.save_offsets(dict(seen))
             time.sleep(poll_interval_s)
 
-    return register_source(schema, runner, mode="streaming", name=name)
+    return register_source(
+        schema, runner, mode="streaming", name=name, persistent_id=persistent_id
+    )
 
 
 def write(table: Table, filename: str, *, format: str = "csv", **kwargs) -> None:
@@ -172,15 +213,5 @@ def write(table: Table, filename: str, *, format: str = "csv", **kwargs) -> None
     subscribe(table, on_change=on_change, on_end=on_end)
 
 
-def _jsonable(v):
-    import numpy as np
-
-    if isinstance(v, (np.integer,)):
-        return int(v)
-    if isinstance(v, (np.floating,)):
-        return float(v)
-    if isinstance(v, np.ndarray):
-        return v.tolist()
-    if isinstance(v, bytes):
-        return v.decode(errors="replace")
-    return v
+# shared JSON coercion lives in the connector runtime
+_jsonable = jsonable

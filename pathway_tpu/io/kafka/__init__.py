@@ -1,0 +1,158 @@
+"""pw.io.kafka — Kafka connector
+(reference: python/pathway/io/kafka/__init__.py, 686 LoC, over KafkaReader /
+KafkaWriter, src/connectors/data_storage.rs).
+
+Gated on a Python Kafka client (``kafka-python`` or ``confluent_kafka`` —
+neither is bundled in this image); all parsing/formatting logic is local so
+only the transport needs the client library.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, Optional, Type
+
+from ...internals.schema import Schema, schema_from_types
+from ...internals.table import Table
+from .._connector import SessionWriter, register_source
+
+__all__ = ["read", "write", "simple_read"]
+
+
+def _make_consumer(rdkafka_settings: Dict, topic: str):
+    try:
+        from kafka import KafkaConsumer  # type: ignore
+
+        return KafkaConsumer(
+            topic,
+            bootstrap_servers=rdkafka_settings.get("bootstrap.servers"),
+            group_id=rdkafka_settings.get("group.id"),
+            auto_offset_reset=rdkafka_settings.get("auto.offset.reset", "earliest"),
+        )
+    except ImportError:
+        pass
+    try:
+        from confluent_kafka import Consumer  # type: ignore
+
+        consumer = Consumer(rdkafka_settings)
+        consumer.subscribe([topic])
+        return consumer
+    except ImportError as e:
+        raise ImportError(
+            "pw.io.kafka requires a Kafka client library (kafka-python or "
+            "confluent_kafka); neither is installed"
+        ) from e
+
+
+def _consume_raw(rdkafka_settings: Dict, topic: str) -> Iterable[bytes]:
+    consumer = _make_consumer(rdkafka_settings or {}, topic)
+    if hasattr(consumer, "poll") and not hasattr(consumer, "subscription"):
+        # confluent_kafka style
+        while True:
+            msg = consumer.poll(0.2)
+            if msg is None or msg.error():
+                continue
+            yield msg.value()
+    else:  # kafka-python style iterator
+        for msg in consumer:
+            yield msg.value
+
+
+def read(
+    rdkafka_settings: Dict,
+    topic: Optional[str] = None,
+    *,
+    schema: Optional[Type[Schema]] = None,
+    format: str = "json",
+    autocommit_duration_ms: int = 100,
+    name: str = "kafka",
+    persistent_id: Optional[str] = None,
+    **kwargs,
+) -> Table:
+    """Consume a topic as a stream of rows (json / plaintext / raw)."""
+    if format in ("plaintext", "raw"):
+        schema = schema or schema_from_types(data=(str if format == "plaintext" else bytes))
+    elif schema is None:
+        raise ValueError(f"schema is required for format={format!r}")
+    columns = list(schema.columns().keys())
+
+    def runner(writer: SessionWriter):
+        for raw in _consume_raw(rdkafka_settings, topic):
+            if format == "raw":
+                writer.insert({"data": raw})
+            elif format == "plaintext":
+                writer.insert({"data": raw.decode(errors="replace")})
+            else:
+                try:
+                    obj = json.loads(raw)
+                except ValueError:
+                    continue
+                writer.insert({c: obj.get(c) for c in columns})
+
+    return register_source(
+        schema, runner, mode="streaming", name=name, persistent_id=persistent_id
+    )
+
+
+def simple_read(server: str, topic: str, *, format: str = "raw", **kwargs) -> Table:
+    return read(
+        {"bootstrap.servers": server, "group.id": f"pathway-{topic}"},
+        topic,
+        format=format,
+        **kwargs,
+    )
+
+
+def write(
+    table: Table,
+    rdkafka_settings: Dict,
+    topic_name: str,
+    *,
+    format: str = "json",
+    **kwargs,
+) -> None:
+    """Produce the table's update stream to a topic (json rows with
+    time/diff fields, matching the reference's output format)."""
+    try:
+        from kafka import KafkaProducer  # type: ignore
+
+        producer = KafkaProducer(
+            bootstrap_servers=rdkafka_settings.get("bootstrap.servers")
+        )
+
+        def send(payload: bytes):
+            producer.send(topic_name, payload)
+
+        def flush():
+            producer.flush()
+
+    except ImportError:
+        try:
+            from confluent_kafka import Producer  # type: ignore
+
+            producer = Producer(rdkafka_settings)
+
+            def send(payload: bytes):
+                producer.produce(topic_name, payload)
+
+            def flush():
+                producer.flush()
+
+        except ImportError as e:
+            raise ImportError(
+                "pw.io.kafka requires a Kafka client library (kafka-python or "
+                "confluent_kafka); neither is installed"
+            ) from e
+
+    from .._connector import jsonable as _jsonable
+    from .._subscribe import subscribe
+
+    names = table.column_names
+
+    def on_change(key, row, time, is_addition):
+        obj = {n: _jsonable(row[n]) for n in names}
+        obj["time"] = time
+        obj["diff"] = 1 if is_addition else -1
+        send(json.dumps(obj).encode())
+
+    subscribe(table, on_change=on_change, on_time_end=lambda ts: flush())

@@ -53,18 +53,21 @@ class Backend:
     def mock(cls) -> "Backend":
         return cls(kind="mock")
 
+    _mock_instance = None
+
     def make_store(self):
-        from .backends import FileBackend, MemoryBackend
+        from .backends import FileBackend, MemoryBackend, S3Backend
 
         if self.kind == "filesystem":
             return FileBackend(self.path)
         if self.kind == "mock":
-            return MemoryBackend()
+            # one shared in-memory store per Backend object, so successive
+            # runs against the same Backend see earlier snapshots (tests)
+            if self._mock_instance is None:
+                self._mock_instance = MemoryBackend()
+            return self._mock_instance
         if self.kind == "s3":
-            raise NotImplementedError(
-                "S3 persistence backend requires an S3 client; mount the bucket "
-                "and use Backend.filesystem instead"
-            )
+            return S3Backend(bucket=self.bucket or "", root_path=self.path or "")
         raise ValueError(self.kind)
 
 

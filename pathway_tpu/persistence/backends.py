@@ -1,0 +1,147 @@
+"""Snapshot storage backends — KV blob stores.
+
+Reference: trait PersistenceBackend (src/persistence/backends/mod.rs:50) with
+file / S3 / memory / mock implementations.  Keys are slash-separated paths;
+values are opaque byte blobs.  Writes are atomic (temp file + rename on the
+filesystem backend) so a crash mid-snapshot never corrupts an earlier one.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["PersistenceBackend", "FileBackend", "MemoryBackend", "S3Backend"]
+
+
+class PersistenceBackend:
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+
+class FileBackend(PersistenceBackend):
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        path = os.path.normpath(os.path.join(self.root, key))
+        if not path.startswith(self.root):
+            raise ValueError(f"key escapes storage root: {key!r}")
+        return path
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def put(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        out = []
+        for root, _dirs, files in os.walk(self.root):
+            for f in files:
+                if f.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(root, f), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+
+class MemoryBackend(PersistenceBackend):
+    """In-memory store (reference mock.rs) — shared when the same instance is
+    passed to successive runs; used by tests."""
+
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+
+class S3Backend(PersistenceBackend):
+    """S3 KV backend (reference backends/s3.rs), gated on boto3."""
+
+    def __init__(self, bucket: str, root_path: str = "", client=None):
+        if client is None:
+            try:
+                import boto3  # type: ignore
+            except ImportError as e:  # pragma: no cover
+                raise ImportError(
+                    "S3 persistence requires boto3 (not installed); pass a "
+                    "client explicitly or use Backend.filesystem"
+                ) from e
+            client = boto3.client("s3")
+        self.client = client
+        self.bucket = bucket
+        self.root = root_path.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.root}/{key}" if self.root else key
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            obj = self.client.get_object(Bucket=self.bucket, Key=self._key(key))
+            return obj["Body"].read()
+        except self.client.exceptions.NoSuchKey:
+            return None
+
+    def put(self, key: str, value: bytes) -> None:
+        self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=value)
+
+    def delete(self, key: str) -> None:
+        self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        full = self._key(prefix)
+        out = []
+        paginator = self.client.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=full):
+            for item in page.get("Contents", []):
+                key = item["Key"]
+                if self.root:
+                    key = key[len(self.root) + 1 :]
+                out.append(key)
+        return sorted(out)

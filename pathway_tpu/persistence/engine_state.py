@@ -1,0 +1,199 @@
+"""Snapshot record/replay engine state.
+
+Reference model (src/persistence/): two snapshot kinds through a pluggable
+backend —
+  * input snapshots: raw connector events + source offsets per persistent_id
+    (input_snapshot.rs; connectors replay the log then *seek* the source past
+    already-ingested data);
+  * operator snapshots: stateful-operator state at a committed frontier
+    (operator_snapshot.rs), enabled by PersistenceMode.OPERATOR_PERSISTING.
+
+Chunk layout under the backend:
+  sources/{pid}/chunk-{seq:08d}   pickled list of raw session events
+  sources/{pid}/METADATA          {"chunks": n, "offsets": obj, "frontier": ts}
+  operators/{stable_id}           pickled operator state at last commit
+  COMMIT                          {"frontier": ts, "ops": bool} — written last
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import Config, PersistenceMode, SnapshotAccess
+from .backends import PersistenceBackend
+
+__all__ = ["SourcePersistence", "PersistenceManager"]
+
+Event = Tuple[int, int, Optional[tuple]]
+
+
+class SourcePersistence:
+    """Per-connector recorder + restored state handed to the connector runner
+    (via ``SessionWriter.persistence``)."""
+
+    def __init__(
+        self,
+        backend: PersistenceBackend,
+        persistent_id: str,
+        record: bool = True,
+    ):
+        self.backend = backend
+        self.pid = persistent_id
+        self.record_enabled = record
+        self._lock = threading.Lock()
+        self._buffer: List[Event] = []
+        self._offsets: Any = None
+        meta = backend.get(f"sources/{self.pid}/METADATA")
+        self._meta = pickle.loads(meta) if meta else {"chunks": 0, "offsets": None}
+        self._offsets = self._meta.get("offsets")
+
+    # -- runner-facing API -------------------------------------------------
+    def offsets(self) -> Any:
+        """Last committed source position (connector-defined shape)."""
+        return self._offsets
+
+    def save_offsets(self, offsets: Any) -> None:
+        with self._lock:
+            self._offsets = offsets
+
+    # -- engine-facing API -------------------------------------------------
+    def record(self, event: Event) -> None:
+        if not self.record_enabled:
+            return
+        with self._lock:
+            self._buffer.append(event)
+
+    def replay_events(self) -> List[Event]:
+        events: List[Event] = []
+        for seq in range(self._meta.get("chunks", 0)):
+            blob = self.backend.get(f"sources/{self.pid}/chunk-{seq:08d}")
+            if blob:
+                events.extend(pickle.loads(blob))
+        return events
+
+    def flush(self, frontier: int) -> None:
+        with self._lock:
+            buffer, self._buffer = self._buffer, []
+            offsets = self._offsets
+        if buffer:
+            seq = self._meta["chunks"]
+            self.backend.put(
+                f"sources/{self.pid}/chunk-{seq:08d}", pickle.dumps(buffer)
+            )
+            self._meta["chunks"] = seq + 1
+        self._meta["offsets"] = offsets
+        self._meta["frontier"] = frontier
+        self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
+
+
+class PersistenceManager:
+    """Wires a Config into a built engine graph: replays input snapshots
+    before the run, records new events, and (in OPERATOR_PERSISTING mode)
+    checkpoints/restores stateful-operator state."""
+
+    def __init__(self, config: Config):
+        if config.backend is None:
+            raise ValueError("persistence Config.backend is required")
+        self.config = config
+        self.backend: PersistenceBackend = config.backend.make_store()
+        self.interval_ms = max(int(config.snapshot_interval_ms), 1)
+        self._sources: List[Tuple[Any, SourcePersistence]] = []
+        self._graph = None
+        self._last_flush_ts = 0
+        commit = self.backend.get("COMMIT")
+        self._commit = pickle.loads(commit) if commit else None
+
+    @property
+    def operator_mode(self) -> bool:
+        return self.config.persistence_mode == PersistenceMode.OPERATOR_PERSISTING
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self, graph) -> None:
+        """Replay snapshots into source sessions and start recording.
+        Must run after graph build, before connector hooks start."""
+        self._graph = graph
+        access = self.config.snapshot_access
+        record = access in (SnapshotAccess.RECORD, SnapshotAccess.FULL)
+        replay = access in (SnapshotAccess.REPLAY, SnapshotAccess.FULL)
+        restored_ops = self.operator_mode and self._restore_operators()
+        for src in graph.sources:
+            pid = getattr(src, "persistent_id", None)
+            writer = getattr(src, "writer", None)
+            if not pid:
+                continue
+            sp = SourcePersistence(self.backend, pid, record=record)
+            if writer is not None:
+                writer.persistence = sp
+            if record:
+                src.session.recorder = sp.record
+            if replay and not restored_ops:
+                events = sp.replay_events()
+                if events:
+                    src.session.push_raw(events)
+            self._sources.append((src, sp))
+
+    def _stable_ids(self):
+        """Deterministic operator keys: construction order + class name (the
+        same user script rebuilds the same graph in the same order)."""
+        out = []
+        for i, op in enumerate(self._graph.operators):
+            out.append((f"{i:05d}-{type(op).__name__}", op))
+        return out
+
+    def _restore_operators(self) -> bool:
+        if not self._commit or not self._commit.get("ops"):
+            return False
+        restored = 0
+        for stable_id, op in self._stable_ids():
+            blob = self.backend.get(f"operators/{stable_id}")
+            if blob is None:
+                continue
+            state = pickle.loads(blob)
+            try:
+                op.restore_state(state)
+                restored += 1
+            except NotImplementedError:
+                pass
+        # table row stores (retraction-lookup state — the analog of restored
+        # differential arrangements)
+        for i, table in enumerate(self._graph.tables):
+            blob = self.backend.get(f"tables/{i:05d}")
+            if blob is not None:
+                table.store._rows = pickle.loads(blob)
+                restored += 1
+        return restored > 0
+
+    def _snapshot_operators(self) -> bool:
+        any_saved = False
+        for stable_id, op in self._stable_ids():
+            try:
+                state = op.snapshot_state()
+            except NotImplementedError:
+                continue
+            if state is None:
+                continue
+            self.backend.put(f"operators/{stable_id}", pickle.dumps(state))
+            any_saved = True
+        if any_saved:
+            for i, table in enumerate(self._graph.tables):
+                self.backend.put(f"tables/{i:05d}", pickle.dumps(table.store._rows))
+        return any_saved
+
+    # -- runtime -----------------------------------------------------------
+    def on_tick(self, ts: int) -> None:
+        if ts - self._last_flush_ts >= self.interval_ms:
+            self.commit(ts)
+
+    def commit(self, ts: int) -> None:
+        self._last_flush_ts = ts
+        for _src, sp in self._sources:
+            sp.flush(ts)
+        ops_saved = self.operator_mode and self._snapshot_operators()
+        self.backend.put(
+            "COMMIT", pickle.dumps({"frontier": ts, "ops": bool(ops_saved)})
+        )
+
+    def finalize(self, ts: int) -> None:
+        self.commit(ts)

@@ -1,0 +1,132 @@
+"""Connector tests: sqlite (real), debezium file transport (real),
+elasticsearch REST writer (against a local mock server), gated imports."""
+
+import json
+import sqlite3
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+
+
+class KV(pw.Schema):
+    k: str = pw.column_definition(primary_key=True)
+    v: int
+
+
+def _collect(table):
+    rows = []
+
+    def on_change(key, row, time, is_addition):
+        rows.append((tuple(row[c] for c in table.column_names), is_addition))
+
+    pw.io.subscribe(table, on_change=on_change)
+    return rows
+
+
+def test_sqlite_read_static(tmp_path):
+    db = str(tmp_path / "d.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE kv (k TEXT, v INTEGER)")
+    conn.executemany("INSERT INTO kv VALUES (?, ?)", [("a", 1), ("b", 2)])
+    conn.commit()
+    conn.close()
+
+    t = pw.io.sqlite.read(db, "kv", KV, mode="static")
+    rows = _collect(t)
+    pw.run()
+    assert sorted(r for r, add in rows if add) == [("a", 1), ("b", 2)]
+
+
+def test_sqlite_write_roundtrip(tmp_path):
+    db = str(tmp_path / "out.db")
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        a | 1
+        b | 2
+        """
+    )
+    pw.io.sqlite.write(t, db, "mirror")
+    pw.run()
+    conn = sqlite3.connect(db)
+    got = sorted(conn.execute("SELECT k, v FROM mirror"))
+    conn.close()
+    assert got == [("a", 1), ("b", 2)]
+
+
+def test_debezium_file_transport(tmp_path):
+    d = tmp_path / "cdc"
+    d.mkdir()
+    msgs = [
+        {"payload": {"op": "c", "after": {"k": "a", "v": 1}}},
+        {"payload": {"op": "c", "after": {"k": "b", "v": 2}}},
+        {"payload": {"op": "u", "before": {"k": "a", "v": 1}, "after": {"k": "a", "v": 5}}},
+        {"payload": {"op": "d", "before": {"k": "b", "v": 2}}},
+    ]
+    with open(d / "000.jsonl", "w") as f:
+        for m in msgs:
+            f.write(json.dumps(m) + "\n")
+
+    t = pw.io.debezium.read(input_dir=str(d), schema=KV, mode="static")
+    counts = t.groupby().reduce(total=pw.reducers.sum(pw.this.v))
+    rows = _collect(counts)
+    pw.run()
+    # final state: only a=5 remains -> sum 5
+    finals = [r for r, add in rows if add]
+    assert finals[-1] == (5,)
+
+
+def test_elasticsearch_bulk_writer():
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(self.rfile.read(n).decode())
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b'{"errors": false}')
+
+        def log_message(self, *args):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        t = pw.debug.table_from_markdown(
+            """
+            k | v
+            a | 1
+            """
+        )
+        pw.io.elasticsearch.write(t, f"http://127.0.0.1:{port}", index_name="idx")
+        pw.run()
+    finally:
+        server.shutdown()
+    assert received, "no bulk request arrived"
+    lines = [json.loads(line) for line in received[0].strip().split("\n")]
+    assert lines[0]["index"]["_index"] == "idx"
+    assert lines[1]["k"] == "a" and lines[1]["v"] == 1
+
+
+def test_gated_connectors_raise_clearly():
+    t = pw.debug.table_from_markdown(
+        """
+        x
+        1
+        """
+    )
+    with pytest.raises(ImportError, match="kafka"):
+        pw.io.kafka.write(t, {}, "topic")
+    with pytest.raises(ImportError, match="psycopg"):
+        pw.io.postgres.write(t, {}, "tbl")
+    with pytest.raises(ImportError, match="pymongo"):
+        pw.io.mongodb.write(t, "mongodb://x", "db", "coll")
+    with pytest.raises(ImportError, match="airbyte"):
+        pw.io.airbyte.read("cfg.yaml", ["users"])

@@ -1,0 +1,88 @@
+"""pw.iterate fixed-point tests (reference: python/pathway/tests/test_common.py
+iterate cases — collatz, shortest paths)."""
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index
+
+
+def test_iterate_collatz():
+    t = T(
+        """
+        n
+        1
+        3
+        5
+        7
+        """
+    )
+
+    def body(t):
+        return t.select(
+            n=pw.if_else(
+                t.n == 1,
+                t.n,
+                pw.if_else(t.n % 2 == 0, t.n // 2, 3 * t.n + 1),
+            )
+        )
+
+    result = pw.iterate(body, t=t)
+    expected = T(
+        """
+        n
+        1
+        1
+        1
+        1
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_iterate_limit():
+    t = T(
+        """
+        x
+        0
+        """
+    )
+    result = pw.iterate(lambda t: t.select(x=t.x + 1), iteration_limit=5, t=t)
+    expected = T(
+        """
+        x
+        6
+        """
+    )
+    # limit reached: 1 initial step + 5 feedback applications
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_iterate_streaming_updates():
+    """New rows arriving after the first tick iterate independently."""
+    import pathway_tpu.io.python as pwio_python
+
+    class Nums(pw.Schema):
+        n: int
+
+    class Subject(pwio_python.ConnectorSubject):
+        def run(self):
+            self.next(n=6)
+            self.commit()
+            self.next(n=24)
+            self.commit()
+
+    t = pwio_python.read(Subject(), schema=Nums)
+
+    def halve_to_odd(t):
+        return t.select(n=pw.if_else(t.n % 2 == 0, t.n // 2, t.n))
+
+    result = pw.iterate(halve_to_odd, t=t)
+    rows = []
+    pw.io.subscribe(
+        result,
+        on_change=lambda key, row, time, is_addition: rows.append(
+            (row["n"], is_addition)
+        ),
+    )
+    pw.run()
+    inserted = [n for n, add in rows if add]
+    assert sorted(inserted)[-2:] == [3, 3]  # 6 -> 3, 24 -> 3

@@ -1,0 +1,137 @@
+"""Checkpoint/resume tests (reference: test_persistence.py +
+integration_tests/wordcount recovery strategy — run, stop, re-run against the
+same storage, assert no duplicates and continued processing)."""
+
+import os
+
+import pathway_tpu as pw
+
+
+def _write_csv(path, rows, header="k,v"):
+    with open(path, "w") as f:
+        f.write(header + "\n")
+        for r in rows:
+            f.write(",".join(str(x) for x in r) + "\n")
+
+
+class KV(pw.Schema):
+    k: str
+    v: int
+
+
+def _wordcount(path, pid, backend):
+    t = pw.io.csv.read(path, schema=KV, mode="static", persistent_id=pid)
+    counts = t.groupby(pw.this.k).reduce(
+        k=pw.this.k, total=pw.reducers.sum(pw.this.v)
+    )
+    results = []
+
+    def on_change(key, row, time, is_addition):
+        results.append(((row["k"], row["total"]), 1 if is_addition else -1))
+
+    pw.io.subscribe(counts, on_change=on_change)
+    pw.run(
+        persistence_config=pw.persistence.Config.simple_config(
+            backend, snapshot_interval_ms=1
+        )
+    )
+    return results
+
+
+def test_input_snapshot_replay_survives_source_loss(tmp_path):
+    """After a run is recorded, the pipeline reproduces the same output even
+    if the original source files disappear (input snapshots, SURVEY §5.4)."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "snap"))
+    src = tmp_path / "data.csv"
+    _write_csv(src, [("a", 1), ("b", 2), ("a", 3)])
+
+    out1 = _wordcount(str(src), "wc", backend)
+    assert {(r[0][0], r[0][1]) for r in out1 if r[1] == 1} >= {("a", 4), ("b", 2)}
+
+    os.remove(src)
+    pw.reset()
+    out2 = _wordcount(str(src), "wc", backend)
+    final1 = _final_counts(out1)
+    final2 = _final_counts(out2)
+    assert final1 == final2 == {"a": 4, "b": 2}
+
+
+def test_resume_skips_ingested_files_and_reads_new(tmp_path):
+    """Second run replays run-1 input from the snapshot, seeks past the
+    already-read file, and ingests only the new file — each row exactly once."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "snap"))
+    d = tmp_path / "in"
+    d.mkdir()
+    _write_csv(d / "one.csv", [("a", 1), ("b", 2)])
+
+    out1 = _wordcount(str(d), "wc2", backend)
+    assert _final_counts(out1) == {"a": 1, "b": 2}
+
+    pw.reset()
+    _write_csv(d / "two.csv", [("a", 10)])
+    out2 = _wordcount(str(d), "wc2", backend)
+    assert _final_counts(out2) == {"a": 11, "b": 2}
+
+
+def test_operator_persisting_mode(tmp_path):
+    """OPERATOR_PERSISTING restores reducer + store state instead of
+    replaying inputs; a new file still folds into restored aggregates."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "snap"))
+    d = tmp_path / "in"
+    d.mkdir()
+    _write_csv(d / "one.csv", [("a", 1), ("b", 2)])
+
+    def go():
+        t = pw.io.csv.read(str(d), schema=KV, mode="static", persistent_id="op")
+        counts = t.groupby(pw.this.k).reduce(
+            k=pw.this.k, total=pw.reducers.sum(pw.this.v)
+        )
+        results = []
+
+        def on_change(key, row, time, is_addition):
+            results.append(((row["k"], row["total"]), 1 if is_addition else -1))
+
+        pw.io.subscribe(counts, on_change=on_change)
+        pw.run(
+            persistence_config=pw.persistence.Config.simple_config(
+                backend,
+                snapshot_interval_ms=1,
+                persistence_mode=pw.persistence.PersistenceMode.OPERATOR_PERSISTING,
+            )
+        )
+        return results
+
+    out1 = go()
+    assert _final_counts(out1) == {"a": 1, "b": 2}
+
+    pw.reset()
+    _write_csv(d / "two.csv", [("b", 5)])
+    out2 = go()
+    # restored state means no re-emission of unchanged group "a"
+    assert _final_counts(out2, base={"a": 1, "b": 2}) == {"a": 1, "b": 7}
+
+
+def test_memory_backend_roundtrip():
+    from pathway_tpu.persistence.backends import MemoryBackend
+
+    b = MemoryBackend()
+    b.put("sources/x/chunk-00000000", b"abc")
+    b.put("sources/x/METADATA", b"meta")
+    assert b.get("sources/x/chunk-00000000") == b"abc"
+    assert b.list_keys("sources/x/") == [
+        "sources/x/METADATA",
+        "sources/x/chunk-00000000",
+    ]
+    b.delete("sources/x/METADATA")
+    assert b.get("sources/x/METADATA") is None
+
+
+def _final_counts(events, base=None):
+    counts = dict(base or {})
+    for row, diff in events:
+        k, total = row[0], row[1]
+        if diff == 1:
+            counts[k] = total
+        elif diff == -1 and counts.get(k) == total:
+            del counts[k]
+    return counts
