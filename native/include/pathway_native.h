@@ -1,0 +1,87 @@
+/* C ABI of the pathway-tpu native runtime library.
+ *
+ * Host-side hot loops that the reference implements in Rust (connector
+ * scanners src/connectors/scanner/, value serialization src/engine/value.rs,
+ * snapshot framing src/persistence/) are implemented here in C++ and loaded
+ * from Python via ctypes (pathway_tpu/native/__init__.py).  Every entry point
+ * has a pure-Python fallback with identical semantics.
+ */
+#pragma once
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- version ---- */
+int64_t pn_abi_version(void);
+
+/* ---- CSV scanning (RFC-4180: quoted fields, "" escapes, \r\n) ----
+ *
+ * Two-pass API over an in-memory buffer:
+ *   pass 1: pn_csv_count fills n_rows / n_cells so the caller can allocate;
+ *   pass 2: pn_csv_scan fills
+ *     row_cell_start[n_rows+1] — cumulative cell index per row,
+ *     cell_off[n_cells], cell_len[n_cells] — byte extents of each cell
+ *       (excluding the outer quotes of a quoted field),
+ *     cell_quoted[n_cells] — 1 if the field was quoted (may contain "").
+ * Rows are terminated by \n or \r\n; a trailing row without a newline counts.
+ * Empty lines produce zero-cell rows (callers usually skip them).
+ * Returns 0 on success, -1 on inconsistent arguments. */
+int pn_csv_count(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
+                 int64_t* n_rows, int64_t* n_cells);
+int pn_csv_scan(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
+                int64_t* row_cell_start, int64_t* cell_off, int64_t* cell_len,
+                uint8_t* cell_quoted);
+
+/* Collapse "" -> " in a quoted field body; dst must hold len bytes.
+ * Returns the number of bytes written. */
+int64_t pn_csv_unescape(const uint8_t* src, int64_t len, uint8_t quote,
+                        uint8_t* dst);
+
+/* ---- typed field parsers (columnar, ASCII) ----
+ * Parse n fields given by (off, len) into typed outputs; ok[i]=1 on success,
+ * 0 on malformed input (out[i] is then 0/NaN). */
+void pn_parse_int64(const uint8_t* buf, const int64_t* off, const int64_t* len,
+                    int64_t n, int64_t* out, uint8_t* ok);
+void pn_parse_float64(const uint8_t* buf, const int64_t* off, const int64_t* len,
+                      int64_t n, double* out, uint8_t* ok);
+
+/* ---- row serialization for key derivation ----
+ * Byte-for-byte identical to pathway_tpu.internals.keys._serialize_value.
+ * col_types: 0=none, 1=bool, 2=int64, 3=float64, 4=str, 5=bytes, 6=pointer.
+ * col_data[c]: pointer to int64_t / uint8_t / double data per type; for
+ * str/bytes it is the concatenated blob with col_offsets[c] =
+ * int64_t[n_rows+1] extents.
+ * col_null[c]: optional byte mask (1 = null -> serialize as None), or NULL.
+ * Writes rows into out (capacity out_cap) and row_offsets[n_rows+1].
+ * Returns total bytes needed; if > out_cap nothing useful was written and the
+ * caller must retry with a larger buffer. */
+int64_t pn_serialize_rows(int64_t n_rows, int32_t n_cols,
+                          const uint8_t* col_types,
+                          const void* const* col_data,
+                          const int64_t* const* col_offsets,
+                          const uint8_t* const* col_null,
+                          uint8_t* out, int64_t out_cap,
+                          int64_t* row_offsets);
+
+/* ---- CRC32 (IEEE, zlib-compatible) and snapshot frame scanning ----
+ * Frame format: [u32 LE payload_len][u32 LE crc32(payload)][payload].
+ * pn_frame_scan walks buf, validating frames; fills offsets/lengths of up to
+ * max_frames payloads, sets *consumed to the byte length of the valid prefix
+ * (truncation/corruption point), and returns the number of valid frames. */
+uint32_t pn_crc32(const uint8_t* data, int64_t len, uint32_t crc);
+int64_t pn_frame_scan(const uint8_t* buf, int64_t len, int64_t* offsets,
+                      int64_t* lengths, int64_t max_frames, int64_t* consumed);
+
+/* ---- shard routing ----
+ * shard(key) = (key & shard_mask) % n_shards (reference
+ * src/engine/dataflow/shard.rs:6 + value.rs:38).  Produces per-shard counts
+ * and a stable permutation `order` grouping row indices by shard — the host
+ * side of the mesh exchange. */
+void pn_shard_rows(const uint64_t* keys, int64_t n, uint32_t n_shards,
+                   uint64_t shard_mask, int64_t* counts, int64_t* order);
+
+#ifdef __cplusplus
+}
+#endif

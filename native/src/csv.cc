@@ -1,0 +1,141 @@
+// RFC-4180 CSV scanner — the native data-loader hot loop.
+// Reference analog: the Rust CSV reader path in src/connectors/
+// (data_storage.rs CsvFilesystemReader) and scanner/filesystem.rs; here the
+// scan produces columnar (offset, length) extents instead of row objects so
+// Python materializes values at most once per cell.
+#include "../include/pathway_native.h"
+
+namespace {
+
+// Single state machine parameterized over a sink; run twice (count, fill).
+struct CountSink {
+  int64_t rows = 0;
+  int64_t cells = 0;
+  inline void cell(int64_t, int64_t, bool) { ++cells; }
+  inline void row_end() { ++rows; }
+};
+
+struct FillSink {
+  int64_t* row_cell_start;
+  int64_t* cell_off;
+  int64_t* cell_len;
+  uint8_t* cell_quoted;
+  int64_t rows = 0;
+  int64_t cells = 0;
+  inline void cell(int64_t off, int64_t len, bool quoted) {
+    cell_off[cells] = off;
+    cell_len[cells] = len;
+    cell_quoted[cells] = quoted ? 1 : 0;
+    ++cells;
+  }
+  inline void row_end() {
+    ++rows;
+    row_cell_start[rows] = cells;
+  }
+};
+
+template <typename Sink>
+void scan(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
+          Sink& sink) {
+  int64_t i = 0;
+  while (i < len) {
+    // start of a row
+    if (buf[i] == '\n') {  // empty line -> zero-cell row
+      sink.row_end();
+      ++i;
+      continue;
+    }
+    if (buf[i] == '\r' && i + 1 < len && buf[i + 1] == '\n') {
+      sink.row_end();
+      i += 2;
+      continue;
+    }
+    bool row_open = true;
+    while (row_open) {
+      // start of a cell
+      if (i < len && buf[i] == quote) {
+        // quoted field: body excludes outer quotes; "" stays in the extent
+        // (flagged for unescape)
+        int64_t start = ++i;
+        while (i < len) {
+          if (buf[i] == quote) {
+            if (i + 1 < len && buf[i + 1] == quote) {
+              i += 2;  // escaped quote, part of the body
+              continue;
+            }
+            break;  // closing quote
+          }
+          ++i;
+        }
+        sink.cell(start, i - start, true);
+        if (i < len) ++i;  // skip closing quote
+        // consume until delim / newline / EOF (junk after quote is dropped,
+        // matching the python csv module's lenient behavior)
+        while (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r')
+          ++i;
+      } else {
+        int64_t start = i;
+        while (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r')
+          ++i;
+        sink.cell(start, i - start, false);
+      }
+      // cell terminator
+      if (i >= len) {
+        sink.row_end();
+        row_open = false;
+      } else if (buf[i] == delim) {
+        ++i;
+        if (i >= len) {  // trailing delimiter at EOF -> final empty cell
+          sink.cell(len, 0, false);
+          sink.row_end();
+          row_open = false;
+        }
+      } else if (buf[i] == '\n') {
+        ++i;
+        sink.row_end();
+        row_open = false;
+      } else {  // '\r'
+        ++i;
+        if (i < len && buf[i] == '\n') ++i;
+        sink.row_end();
+        row_open = false;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int pn_csv_count(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
+                 int64_t* n_rows, int64_t* n_cells) {
+  if (!buf && len > 0) return -1;
+  CountSink sink;
+  scan(buf, len, delim, quote, sink);
+  *n_rows = sink.rows;
+  *n_cells = sink.cells;
+  return 0;
+}
+
+int pn_csv_scan(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
+                int64_t* row_cell_start, int64_t* cell_off, int64_t* cell_len,
+                uint8_t* cell_quoted) {
+  if (!buf && len > 0) return -1;
+  FillSink sink{row_cell_start, cell_off, cell_len, cell_quoted};
+  row_cell_start[0] = 0;
+  scan(buf, len, delim, quote, sink);
+  return 0;
+}
+
+int64_t pn_csv_unescape(const uint8_t* src, int64_t len, uint8_t quote,
+                        uint8_t* dst) {
+  int64_t o = 0;
+  for (int64_t i = 0; i < len; ++i) {
+    dst[o++] = src[i];
+    if (src[i] == quote && i + 1 < len && src[i + 1] == quote) ++i;
+  }
+  return o;
+}
+
+}  // extern "C"

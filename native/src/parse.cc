@@ -1,0 +1,73 @@
+// Columnar typed-field parsers: text cells -> int64 / float64 numpy columns
+// without a Python object per cell (reference analog: the typed DSV parser in
+// src/connectors/data_format.rs).
+#include "../include/pathway_native.h"
+
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+inline bool parse_i64(const uint8_t* p, int64_t n, int64_t* out) {
+  // trim ASCII whitespace
+  while (n > 0 && (*p == ' ' || *p == '\t')) ++p, --n;
+  while (n > 0 && (p[n - 1] == ' ' || p[n - 1] == '\t')) --n;
+  if (n <= 0) return false;
+  bool neg = false;
+  if (*p == '+' || *p == '-') {
+    neg = *p == '-';
+    ++p;
+    --n;
+    if (n == 0) return false;
+  }
+  uint64_t acc = 0;
+  const uint64_t limit = neg ? 0x8000000000000000ULL : 0x7FFFFFFFFFFFFFFFULL;
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t c = p[i];
+    if (c < '0' || c > '9') return false;
+    uint64_t d = c - '0';
+    if (acc > (limit - d) / 10) return false;  // overflow
+    acc = acc * 10 + d;
+  }
+  *out = neg ? -(int64_t)acc : (int64_t)acc;
+  return true;
+}
+
+inline bool parse_f64(const uint8_t* p, int64_t n, double* out) {
+  while (n > 0 && (*p == ' ' || *p == '\t')) ++p, --n;
+  while (n > 0 && (p[n - 1] == ' ' || p[n - 1] == '\t')) --n;
+  if (n <= 0 || n > 510) return false;
+  char tmp[512];
+  std::memcpy(tmp, p, n);
+  tmp[n] = '\0';
+  char* end = nullptr;
+  double v = std::strtod(tmp, &end);
+  if (end != tmp + n) return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pn_parse_int64(const uint8_t* buf, const int64_t* off, const int64_t* len,
+                    int64_t n, int64_t* out, uint8_t* ok) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t v = 0;
+    ok[i] = parse_i64(buf + off[i], len[i], &v) ? 1 : 0;
+    out[i] = ok[i] ? v : 0;
+  }
+}
+
+void pn_parse_float64(const uint8_t* buf, const int64_t* off,
+                      const int64_t* len, int64_t n, double* out, uint8_t* ok) {
+  for (int64_t i = 0; i < n; ++i) {
+    double v = 0.0;
+    ok[i] = parse_f64(buf + off[i], len[i], &v) ? 1 : 0;
+    out[i] = ok[i] ? v : std::nan("");
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+// Row serialization for key derivation — byte-identical to
+// pathway_tpu.internals.keys._serialize_value (the canonical tagged format
+// whose hash is the row key; reference analog: ShardPolicy/Key derivation in
+// src/engine/value.rs:30-41).  Doing the per-row tag+pack loop in C++ removes
+// the Python-level serialization cost from ref_scalars_batch.
+#include "../include/pathway_native.h"
+
+#include <cstring>
+
+namespace {
+
+enum ColType : uint8_t {
+  COL_NONE = 0,
+  COL_BOOL = 1,
+  COL_INT64 = 2,
+  COL_FLOAT64 = 3,
+  COL_STR = 4,
+  COL_BYTES = 5,
+  COL_POINTER = 6,
+};
+
+inline int64_t cell_size(uint8_t type, const void* data, const int64_t* offs,
+                         int64_t row) {
+  switch (type) {
+    case COL_NONE:
+      return 1;
+    case COL_BOOL:
+      return 2;
+    case COL_INT64:
+    case COL_FLOAT64:
+    case COL_POINTER:
+      return 9;
+    case COL_STR:
+    case COL_BYTES:
+      return 5 + (offs[row + 1] - offs[row]);
+    default:
+      return 1;
+  }
+  (void)data;
+}
+
+inline void put_u32(uint8_t* p, uint32_t v) { std::memcpy(p, &v, 4); }
+inline void put_u64(uint8_t* p, uint64_t v) { std::memcpy(p, &v, 8); }
+
+inline int64_t write_cell(uint8_t type, const void* data, const int64_t* offs,
+                          int64_t row, uint8_t* out) {
+  switch (type) {
+    case COL_NONE:
+      out[0] = 0x00;
+      return 1;
+    case COL_BOOL:
+      out[0] = 0x01;
+      out[1] = ((const uint8_t*)data)[row] ? 0x01 : 0x00;
+      return 2;
+    case COL_INT64:
+      out[0] = 0x02;
+      put_u64(out + 1, (uint64_t)((const int64_t*)data)[row]);
+      return 9;
+    case COL_FLOAT64: {
+      out[0] = 0x03;
+      uint64_t bits;
+      std::memcpy(&bits, &((const double*)data)[row], 8);
+      put_u64(out + 1, bits);
+      return 9;
+    }
+    case COL_POINTER:
+      out[0] = 0x06;
+      put_u64(out + 1, ((const uint64_t*)data)[row]);
+      return 9;
+    case COL_STR:
+    case COL_BYTES: {
+      int64_t n = offs[row + 1] - offs[row];
+      out[0] = type == COL_STR ? 0x04 : 0x05;
+      put_u32(out + 1, (uint32_t)n);
+      std::memcpy(out + 5, (const uint8_t*)data + offs[row], n);
+      return 5 + n;
+    }
+    default:
+      out[0] = 0x00;
+      return 1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pn_serialize_rows(int64_t n_rows, int32_t n_cols,
+                          const uint8_t* col_types,
+                          const void* const* col_data,
+                          const int64_t* const* col_offsets,
+                          const uint8_t* const* col_null,
+                          uint8_t* out, int64_t out_cap,
+                          int64_t* row_offsets) {
+  // size pass
+  int64_t total = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    for (int32_t c = 0; c < n_cols; ++c) {
+      if (col_null && col_null[c] && col_null[c][r])
+        total += 1;  // null serializes as None
+      else
+        total += cell_size(col_types[c], col_data[c],
+                           col_offsets ? col_offsets[c] : nullptr, r);
+    }
+  }
+  if (total > out_cap) return total;
+  // write pass
+  int64_t pos = 0;
+  row_offsets[0] = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    for (int32_t c = 0; c < n_cols; ++c) {
+      if (col_null && col_null[c] && col_null[c][r]) {
+        out[pos++] = 0x00;
+      } else {
+        pos += write_cell(col_types[c], col_data[c],
+                          col_offsets ? col_offsets[c] : nullptr, r, out + pos);
+      }
+    }
+    row_offsets[r + 1] = pos;
+  }
+  return total;
+}
+
+}  // extern "C"

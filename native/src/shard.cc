@@ -1,0 +1,29 @@
+// Shard routing: stable counting-sort of row indices by key shard — the host
+// side of the mesh exchange (reference analog: timely exchange on Key shard
+// bits, src/engine/value.rs:38 + src/engine/dataflow/shard.rs:6; here the
+// permutation feeds jax device_put / all_to_all instead of TCP channels).
+#include "../include/pathway_native.h"
+
+#include <vector>
+
+extern "C" {
+
+void pn_shard_rows(const uint64_t* keys, int64_t n, uint32_t n_shards,
+                   uint64_t shard_mask, int64_t* counts, int64_t* order) {
+  for (uint32_t s = 0; s < n_shards; ++s) counts[s] = 0;
+  std::vector<uint32_t> shard(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t s = (uint32_t)((keys[i] & shard_mask) % n_shards);
+    shard[i] = s;
+    ++counts[s];
+  }
+  std::vector<int64_t> pos(n_shards, 0);
+  int64_t acc = 0;
+  for (uint32_t s = 0; s < n_shards; ++s) {
+    pos[s] = acc;
+    acc += counts[s];
+  }
+  for (int64_t i = 0; i < n; ++i) order[pos[shard[i]]++] = i;
+}
+
+}  // extern "C"

@@ -89,9 +89,108 @@ def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
     return Pointer(xxhash.xxh3_64_intdigest(bytes(buf)))
 
 
+def _native_col_spec(col, n: int):
+    """Map one id column onto the native serializer's typed layout
+    (pathway_tpu.native.serialize_rows); None if the column needs the generic
+    per-value Python path."""
+    from .. import native as _native
+
+    if isinstance(col, np.ndarray) and col.ndim == 1:
+        if col.dtype == np.bool_:
+            return _native.COL_BOOL, col.astype(np.uint8), None
+        if np.issubdtype(col.dtype, np.integer):
+            if col.dtype == np.uint64 and (col >> np.uint64(63)).any():
+                return None  # would serialize under the big-uint tag
+            return _native.COL_INT64, col.astype(np.int64), None
+        if np.issubdtype(col.dtype, np.floating):
+            return _native.COL_FLOAT64, col.astype(np.float64), None
+        if col.dtype != object:
+            return None
+    nulls = None
+    kinds = set()
+    for v in col:
+        if v is None:
+            nulls = True
+            continue
+        if isinstance(v, (bool, np.bool_)):
+            kinds.add("bool")
+        elif isinstance(v, Pointer):
+            kinds.add("ptr")
+        elif isinstance(v, (int, np.integer)):
+            if not -(1 << 63) <= int(v) < (1 << 63):
+                return None
+            kinds.add("int")
+        elif isinstance(v, (float, np.floating)):
+            kinds.add("float")
+        elif isinstance(v, str):
+            kinds.add("str")
+        elif isinstance(v, bytes):
+            kinds.add("bytes")
+        else:
+            return None
+        if len(kinds) > 1:
+            return None
+    mask = None
+    if nulls:
+        mask = np.fromiter((v is None for v in col), dtype=np.uint8, count=n)
+    if not kinds:  # all null
+        return _native.COL_NONE, None, mask
+    kind = kinds.pop()
+    fill = {"bool": False, "ptr": 0, "int": 0, "float": 0.0}.get(kind)
+    if kind in ("str", "bytes"):
+        offsets = np.empty(n + 1, dtype=np.int64)
+        offsets[0] = 0
+        parts = []
+        for i, v in enumerate(col):
+            b = b"" if v is None else (v.encode() if kind == "str" else v)
+            parts.append(b)
+            offsets[i + 1] = offsets[i] + len(b)
+        tag = _native.COL_STR if kind == "str" else _native.COL_BYTES
+        return tag, (b"".join(parts), offsets), mask
+    vals = [fill if v is None else v for v in col]
+    if kind == "bool":
+        return _native.COL_BOOL, np.asarray(vals, dtype=np.uint8), mask
+    if kind == "ptr":
+        return _native.COL_POINTER, np.asarray(
+            [int(v) for v in vals], dtype=np.uint64
+        ), mask
+    if kind == "int":
+        return _native.COL_INT64, np.asarray(
+            [int(v) for v in vals], dtype=np.int64
+        ), mask
+    return _native.COL_FLOAT64, np.asarray(vals, dtype=np.float64), mask
+
+
 def ref_scalars_batch(columns: Sequence[Sequence[Any]]) -> np.ndarray:
-    """Vector of keys for rows given as parallel columns of id values."""
+    """Vector of keys for rows given as parallel columns of id values.
+
+    Uniformly-typed columns take the native path: C++ serialization
+    (native/src/serialize.cc, byte-identical to ``_serialize_value``)
+    followed by one xxh3 per row over the packed buffer.  Mixed/exotic
+    columns fall back to the per-value Python serializer."""
     n = len(columns[0])
+    specs = []
+    for col in columns:
+        spec = _native_col_spec(col, n)
+        if spec is None:
+            specs = None
+            break
+        specs.append(spec)
+    if specs is not None:
+        from .. import native as _native
+
+        buf, row_offsets = _native.serialize_rows(
+            n,
+            [s[0] for s in specs],
+            [s[1] for s in specs],
+            [s[2] for s in specs],
+        )
+        out = np.empty(n, dtype=KEY_DTYPE)
+        view = memoryview(buf)
+        digest = xxhash.xxh3_64_intdigest
+        for i in range(n):
+            out[i] = digest(view[row_offsets[i] : row_offsets[i + 1]])
+        return out
     out = np.empty(n, dtype=KEY_DTYPE)
     for i in range(n):
         buf = bytearray()

@@ -46,9 +46,10 @@ def write(
         if not buffer:
             return
         errors = client.insert_rows_json(table_ref, list(buffer))
-        del buffer[:]
         if errors:
+            # keep the batch buffered so a later flush can retry it
             raise RuntimeError(f"BigQuery insert errors: {errors}")
+        del buffer[:]
 
     subscribe(table, on_change=on_change, on_time_end=flush, on_end=flush)
 

@@ -76,6 +76,19 @@ def write(
             headers={"Content-Type": "application/x-ndjson"},
         )
         resp.raise_for_status()
+        # _bulk returns HTTP 200 even when individual items fail
+        body = resp.json()
+        if body.get("errors"):
+            failed = [
+                item
+                for item in body.get("items", [])
+                for op in item.values()
+                if op.get("error")
+            ]
+            raise RuntimeError(
+                f"Elasticsearch bulk rejected {len(failed)} item(s): "
+                f"{failed[:3]!r}"
+            )
 
     def on_change(key, row, time, is_addition):
         doc_id = str(int(key))

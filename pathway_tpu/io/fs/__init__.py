@@ -60,9 +60,23 @@ def _parse_into(
         writer.insert(values)
 
     if format == "csv":
-        with open(fpath, newline="") as f:
-            for row in _csv.DictReader(f):
-                emit({c: row.get(c) for c in columns})
+        # native C++ scanner (native/src/csv.cc) — columnar extents, one str
+        # per cell; pure-Python fallback inside csv_rows when the library is
+        # unavailable
+        from ... import native as _native
+
+        with open(fpath, "rb") as f:
+            rows = _native.csv_rows(f.read())
+        if rows:
+            header = rows[0]
+            idx = {c: header.index(c) if c in header else None for c in columns}
+            for row in rows[1:]:
+                emit(
+                    {
+                        c: (row[i] if i is not None and i < len(row) else None)
+                        for c, i in idx.items()
+                    }
+                )
     elif format in ("json", "jsonlines"):
         with open(fpath) as f:
             for line in f:

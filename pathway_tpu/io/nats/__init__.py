@@ -87,7 +87,8 @@ def write(table: Table, uri: str, topic: str, *, format: str = "json", **kwargs)
         loop.run_forever()
 
     threading.Thread(target=loop_main, daemon=True).start()
-    ready.wait(10)
+    if not ready.wait(10):
+        raise ConnectionError(f"could not connect to NATS at {uri!r} within 10s")
 
     def on_change(key, row, time, is_addition):
         obj = {n: _plain(row[n]) for n in names}

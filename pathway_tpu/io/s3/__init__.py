@@ -6,6 +6,7 @@ Gated on boto3 (not bundled); parsing reuses the fs format stack.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
 import time
@@ -85,7 +86,12 @@ def read(
                     key, etag = obj["Key"], obj.get("ETag", "")
                     if seen.get(key) == etag:
                         continue
-                    local = os.path.join(tmpdir, key.replace("/", "__"))
+                    # hash-suffixed cache name: '/'-flattening alone is not
+                    # injective ('a/b' vs 'a__b')
+                    digest = hashlib.sha1(key.encode()).hexdigest()[:12]
+                    local = os.path.join(
+                        tmpdir, f"{os.path.basename(key)}.{digest}"
+                    )
                     client.download_file(bucket, key, local)
                     _parse_into(local, writer, format, schema)
                     seen[key] = etag

@@ -1,0 +1,382 @@
+"""ctypes bindings for the native C++ runtime (``native/`` at the repo root).
+
+The reference implements its host-side hot loops — connector scanners/parsers,
+value serialization for key hashing, snapshot framing, shard routing — in Rust
+(src/connectors/, src/engine/value.rs, src/persistence/); here they live in
+C++ built to ``libpathway_native.so`` and loaded through ctypes.  Everything
+degrades gracefully: if the library is missing and cannot be built (or
+``PATHWAY_TPU_DISABLE_NATIVE=1``), pure-Python fallbacks with identical
+semantics take over — tests assert native/fallback agreement bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "available",
+    "lib",
+    "build",
+    "csv_scan",
+    "csv_unescape",
+    "parse_int64",
+    "parse_float64",
+    "serialize_rows",
+    "crc32",
+    "frame_scan",
+    "shard_rows",
+]
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_NATIVE_DIR = _REPO_ROOT / "native"
+_SO_PATH = _NATIVE_DIR / "build" / "libpathway_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i64 = ctypes.c_int64
+_u64 = ctypes.c_uint64
+_u32 = ctypes.c_uint32
+_i32 = ctypes.c_int32
+_u8 = ctypes.c_uint8
+_p_u8 = ctypes.POINTER(_u8)
+_p_i64 = ctypes.POINTER(_i64)
+_p_u64 = ctypes.POINTER(_u64)
+
+
+def _sources_newer_than_so() -> bool:
+    if not _SO_PATH.exists():
+        return True
+    so_mtime = _SO_PATH.stat().st_mtime
+    for src in list((_NATIVE_DIR / "src").glob("*.cc")) + list(
+        (_NATIVE_DIR / "include").glob("*.h")
+    ):
+        if src.stat().st_mtime > so_mtime:
+            return True
+    return False
+
+
+def build(force: bool = False) -> bool:
+    """Build libpathway_native.so (make, falling back to a direct g++ call).
+    Returns True if the library exists afterwards."""
+    if not _NATIVE_DIR.exists():
+        return False
+    if not force and not _sources_newer_than_so():
+        return True
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_NATIVE_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            (_NATIVE_DIR / "build").mkdir(exist_ok=True)
+            srcs = sorted(str(p) for p in (_NATIVE_DIR / "src").glob("*.cc"))
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", *srcs,
+                 "-o", str(_SO_PATH)],
+                cwd=_NATIVE_DIR,
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return False
+    return _SO_PATH.exists()
+
+
+def _declare(dll: ctypes.CDLL) -> ctypes.CDLL:
+    dll.pn_abi_version.restype = _i64
+    dll.pn_csv_count.restype = _i32
+    dll.pn_csv_count.argtypes = [_p_u8, _i64, _u8, _u8, _p_i64, _p_i64]
+    dll.pn_csv_scan.restype = _i32
+    dll.pn_csv_scan.argtypes = [_p_u8, _i64, _u8, _u8, _p_i64, _p_i64, _p_i64, _p_u8]
+    dll.pn_csv_unescape.restype = _i64
+    dll.pn_csv_unescape.argtypes = [_p_u8, _i64, _u8, _p_u8]
+    dll.pn_parse_int64.restype = None
+    dll.pn_parse_int64.argtypes = [_p_u8, _p_i64, _p_i64, _i64, _p_i64, _p_u8]
+    dll.pn_parse_float64.restype = None
+    dll.pn_parse_float64.argtypes = [
+        _p_u8, _p_i64, _p_i64, _i64, ctypes.POINTER(ctypes.c_double), _p_u8,
+    ]
+    dll.pn_serialize_rows.restype = _i64
+    dll.pn_serialize_rows.argtypes = [
+        _i64, _i32, _p_u8,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+        _p_u8, _i64, _p_i64,
+    ]
+    dll.pn_crc32.restype = _u32
+    dll.pn_crc32.argtypes = [_p_u8, _i64, _u32]
+    dll.pn_frame_scan.restype = _i64
+    dll.pn_frame_scan.argtypes = [_p_u8, _i64, _p_i64, _p_i64, _i64, _p_i64]
+    dll.pn_shard_rows.restype = None
+    dll.pn_shard_rows.argtypes = [_p_u64, _i64, _u32, _u64, _p_i64, _p_i64]
+    return dll
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if disabled
+    or unbuildable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PATHWAY_TPU_DISABLE_NATIVE", "") not in ("", "0"):
+            return None
+        if not build():
+            return None
+        try:
+            _lib = _declare(ctypes.CDLL(str(_SO_PATH)))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _as_u8_ptr(buf: bytes):
+    return ctypes.cast(ctypes.c_char_p(buf), _p_u8)
+
+
+def _np_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------- CSV
+
+
+def csv_scan(
+    data: bytes, delim: str = ",", quote: str = '"'
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Scan a CSV byte buffer into columnar extents:
+    (row_cell_start[n_rows+1], cell_off, cell_len, cell_quoted)."""
+    dll = lib()
+    if dll is None:
+        from . import fallback
+
+        return fallback.csv_scan(data, delim, quote)
+    d, q = ord(delim), ord(quote)
+    n_rows = _i64(0)
+    n_cells = _i64(0)
+    buf = _as_u8_ptr(data)
+    dll.pn_csv_count(buf, len(data), d, q, ctypes.byref(n_rows), ctypes.byref(n_cells))
+    rcs = np.empty(n_rows.value + 1, dtype=np.int64)
+    off = np.empty(n_cells.value, dtype=np.int64)
+    ln = np.empty(n_cells.value, dtype=np.int64)
+    quoted = np.empty(n_cells.value, dtype=np.uint8)
+    dll.pn_csv_scan(
+        buf, len(data), d, q,
+        _np_ptr(rcs, _i64), _np_ptr(off, _i64), _np_ptr(ln, _i64), _np_ptr(quoted, _u8),
+    )
+    return rcs, off, ln, quoted
+
+
+def csv_unescape(cell: bytes, quote: str = '"') -> bytes:
+    dll = lib()
+    if dll is None:
+        return cell.replace((quote * 2).encode(), quote.encode())
+    out = ctypes.create_string_buffer(len(cell))
+    n = dll.pn_csv_unescape(
+        _as_u8_ptr(cell), len(cell), ord(quote), ctypes.cast(out, _p_u8)
+    )
+    return out.raw[:n]
+
+
+def csv_rows(data: bytes, delim: str = ",", quote: str = '"') -> List[List[str]]:
+    """Decode a CSV buffer into rows of str (skipping zero-cell rows)."""
+    rcs, off, ln, quoted = csv_scan(data, delim, quote)
+    qbytes = (quote * 2).encode()
+    rows: List[List[str]] = []
+    for r in range(len(rcs) - 1):
+        lo, hi = rcs[r], rcs[r + 1]
+        if lo == hi:
+            continue
+        row = []
+        for c in range(lo, hi):
+            cell = data[off[c] : off[c] + ln[c]]
+            if quoted[c] and qbytes in cell:
+                cell = cell.replace(qbytes, quote.encode())
+            row.append(cell.decode("utf-8", errors="replace"))
+        rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------- typed parse
+
+
+def parse_int64(
+    data: bytes, off: np.ndarray, ln: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    dll = lib()
+    if dll is None:
+        from . import fallback
+
+        return fallback.parse_int64(data, off, ln)
+    n = len(off)
+    out = np.empty(n, dtype=np.int64)
+    ok = np.empty(n, dtype=np.uint8)
+    off = np.ascontiguousarray(off, dtype=np.int64)
+    ln = np.ascontiguousarray(ln, dtype=np.int64)
+    dll.pn_parse_int64(
+        _as_u8_ptr(data), _np_ptr(off, _i64), _np_ptr(ln, _i64), n,
+        _np_ptr(out, _i64), _np_ptr(ok, _u8),
+    )
+    return out, ok
+
+
+def parse_float64(
+    data: bytes, off: np.ndarray, ln: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    dll = lib()
+    if dll is None:
+        from . import fallback
+
+        return fallback.parse_float64(data, off, ln)
+    n = len(off)
+    out = np.empty(n, dtype=np.float64)
+    ok = np.empty(n, dtype=np.uint8)
+    off = np.ascontiguousarray(off, dtype=np.int64)
+    ln = np.ascontiguousarray(ln, dtype=np.int64)
+    dll.pn_parse_float64(
+        _as_u8_ptr(data), _np_ptr(off, _i64), _np_ptr(ln, _i64), n,
+        _np_ptr(out, ctypes.c_double), _np_ptr(ok, _u8),
+    )
+    return out, ok
+
+
+# ---------------------------------------------------------------- serialize
+
+# column type tags shared with native/src/serialize.cc
+COL_NONE, COL_BOOL, COL_INT64, COL_FLOAT64, COL_STR, COL_BYTES, COL_POINTER = range(7)
+
+
+def serialize_rows(
+    n_rows: int,
+    col_types: Sequence[int],
+    col_arrays: Sequence[object],
+    col_nulls: Sequence[Optional[np.ndarray]],
+) -> Tuple[bytes, np.ndarray]:
+    """Serialize typed columns into per-row key-derivation buffers.
+
+    ``col_arrays[c]``: np.int64/float64/uint8/uint64 array, or
+    ``(blob: bytes, offsets: np.int64[n_rows+1])`` for str/bytes columns.
+    Returns (buffer, row_offsets[n_rows+1]) matching
+    internals.keys._serialize_value byte-for-byte."""
+    dll = lib()
+    if dll is None:
+        from . import fallback
+
+        return fallback.serialize_rows(n_rows, col_types, col_arrays, col_nulls)
+    n_cols = len(col_types)
+    types = np.asarray(col_types, dtype=np.uint8)
+    data_ptrs = (ctypes.c_void_p * n_cols)()
+    off_ptrs = (ctypes.c_void_p * n_cols)()
+    null_ptrs = (ctypes.c_void_p * n_cols)()
+    keepalive = []
+    for c, t in enumerate(col_types):
+        if t in (COL_STR, COL_BYTES):
+            blob, offs = col_arrays[c]
+            offs = np.ascontiguousarray(offs, dtype=np.int64)
+            keepalive.append((blob, offs))
+            data_ptrs[c] = ctypes.cast(ctypes.c_char_p(blob), ctypes.c_void_p)
+            off_ptrs[c] = ctypes.c_void_p(offs.ctypes.data)
+        elif t == COL_NONE:
+            data_ptrs[c] = None
+            off_ptrs[c] = None
+        else:
+            arr = np.ascontiguousarray(col_arrays[c])
+            keepalive.append(arr)
+            data_ptrs[c] = ctypes.c_void_p(arr.ctypes.data)
+            off_ptrs[c] = None
+        mask = col_nulls[c] if col_nulls else None
+        if mask is not None:
+            mask = np.ascontiguousarray(mask, dtype=np.uint8)
+            keepalive.append(mask)
+            null_ptrs[c] = ctypes.c_void_p(mask.ctypes.data)
+        else:
+            null_ptrs[c] = None
+    row_offsets = np.empty(n_rows + 1, dtype=np.int64)
+    needed = dll.pn_serialize_rows(
+        n_rows, n_cols, _np_ptr(types, _u8),
+        data_ptrs, off_ptrs, null_ptrs,
+        ctypes.cast(None, _p_u8), 0, _np_ptr(row_offsets, _i64),
+    )
+    out = ctypes.create_string_buffer(max(int(needed), 1))
+    dll.pn_serialize_rows(
+        n_rows, n_cols, _np_ptr(types, _u8),
+        data_ptrs, off_ptrs, null_ptrs,
+        ctypes.cast(out, _p_u8), needed, _np_ptr(row_offsets, _i64),
+    )
+    return out.raw[:needed], row_offsets
+
+
+# ---------------------------------------------------------------- crc / frames
+
+
+def crc32(data: bytes, value: int = 0) -> int:
+    dll = lib()
+    if dll is None:
+        import zlib
+
+        return zlib.crc32(data, value) & 0xFFFFFFFF
+    return int(dll.pn_crc32(_as_u8_ptr(data), len(data), value & 0xFFFFFFFF))
+
+
+def frame_scan(data: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Scan concatenated [len][crc][payload] frames; returns
+    (payload_offsets, payload_lengths, consumed_bytes) of the valid prefix."""
+    dll = lib()
+    if dll is None:
+        from . import fallback
+
+        return fallback.frame_scan(data)
+    max_frames = max(len(data) // 8, 1)
+    offs = np.empty(max_frames, dtype=np.int64)
+    lens = np.empty(max_frames, dtype=np.int64)
+    consumed = _i64(0)
+    n = dll.pn_frame_scan(
+        _as_u8_ptr(data), len(data), _np_ptr(offs, _i64), _np_ptr(lens, _i64),
+        max_frames, ctypes.byref(consumed),
+    )
+    return offs[:n].copy(), lens[:n].copy(), consumed.value
+
+
+# ---------------------------------------------------------------- sharding
+
+
+def shard_rows(
+    keys: np.ndarray, n_shards: int, shard_mask: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(counts[n_shards], order[n]) — stable grouping of row indices by
+    shard(key) = (key & mask) % n_shards."""
+    dll = lib()
+    if dll is None:
+        from . import fallback
+
+        return fallback.shard_rows(keys, n_shards, shard_mask)
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    counts = np.empty(n_shards, dtype=np.int64)
+    order = np.empty(len(keys), dtype=np.int64)
+    dll.pn_shard_rows(
+        _np_ptr(keys, _u64), len(keys), n_shards, shard_mask,
+        _np_ptr(counts, _i64), _np_ptr(order, _i64),
+    )
+    return counts, order

@@ -1,0 +1,178 @@
+"""Pure-Python fallbacks for the native library — semantics identical to the
+C++ implementations in native/src/ (tests assert bit-for-bit agreement)."""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def csv_scan(data: bytes, delim: str = ",", quote: str = '"'):
+    d, q = delim.encode(), quote.encode()
+    n = len(data)
+    row_cell_start: List[int] = [0]
+    off: List[int] = []
+    ln: List[int] = []
+    quoted: List[int] = []
+    i = 0
+    while i < n:
+        ch = data[i : i + 1]
+        if ch == b"\n":
+            row_cell_start.append(len(off))
+            i += 1
+            continue
+        if ch == b"\r" and data[i + 1 : i + 2] == b"\n":
+            row_cell_start.append(len(off))
+            i += 2
+            continue
+        row_open = True
+        while row_open:
+            if i < n and data[i : i + 1] == q:
+                i += 1
+                start = i
+                while i < n:
+                    if data[i : i + 1] == q:
+                        if data[i + 1 : i + 2] == q:
+                            i += 2
+                            continue
+                        break
+                    i += 1
+                off.append(start)
+                ln.append(i - start)
+                quoted.append(1)
+                if i < n:
+                    i += 1
+                while i < n and data[i : i + 1] not in (d, b"\n", b"\r"):
+                    i += 1
+            else:
+                start = i
+                while i < n and data[i : i + 1] not in (d, b"\n", b"\r"):
+                    i += 1
+                off.append(start)
+                ln.append(i - start)
+                quoted.append(0)
+            if i >= n:
+                row_cell_start.append(len(off))
+                row_open = False
+            elif data[i : i + 1] == d:
+                i += 1
+                if i >= n:
+                    off.append(n)
+                    ln.append(0)
+                    quoted.append(0)
+                    row_cell_start.append(len(off))
+                    row_open = False
+            elif data[i : i + 1] == b"\n":
+                i += 1
+                row_cell_start.append(len(off))
+                row_open = False
+            else:  # \r
+                i += 1
+                if i < n and data[i : i + 1] == b"\n":
+                    i += 1
+                row_cell_start.append(len(off))
+                row_open = False
+    return (
+        np.asarray(row_cell_start, dtype=np.int64),
+        np.asarray(off, dtype=np.int64),
+        np.asarray(ln, dtype=np.int64),
+        np.asarray(quoted, dtype=np.uint8),
+    )
+
+
+def parse_int64(data: bytes, off, ln) -> Tuple[np.ndarray, np.ndarray]:
+    n = len(off)
+    out = np.zeros(n, dtype=np.int64)
+    ok = np.zeros(n, dtype=np.uint8)
+    for i in range(n):
+        s = data[off[i] : off[i] + ln[i]].strip()
+        try:
+            v = int(s)
+        except ValueError:
+            continue
+        if -(1 << 63) <= v < (1 << 63):
+            out[i] = v
+            ok[i] = 1
+    return out, ok
+
+
+def parse_float64(data: bytes, off, ln) -> Tuple[np.ndarray, np.ndarray]:
+    n = len(off)
+    out = np.full(n, np.nan, dtype=np.float64)
+    ok = np.zeros(n, dtype=np.uint8)
+    for i in range(n):
+        s = data[off[i] : off[i] + ln[i]].strip()
+        if not s:
+            continue
+        try:
+            out[i] = float(s)
+            ok[i] = 1
+        except ValueError:
+            pass
+    return out, ok
+
+
+def serialize_rows(
+    n_rows: int,
+    col_types: Sequence[int],
+    col_arrays: Sequence[object],
+    col_nulls: Sequence[Optional[np.ndarray]],
+) -> Tuple[bytes, np.ndarray]:
+    from . import COL_BOOL, COL_BYTES, COL_FLOAT64, COL_INT64, COL_NONE, COL_POINTER, COL_STR
+
+    out = bytearray()
+    row_offsets = np.empty(n_rows + 1, dtype=np.int64)
+    row_offsets[0] = 0
+    for r in range(n_rows):
+        for c, t in enumerate(col_types):
+            mask = col_nulls[c] if col_nulls else None
+            if (mask is not None and mask[r]) or t == COL_NONE:
+                out += b"\x00"
+            elif t == COL_BOOL:
+                out += b"\x01" + (b"\x01" if col_arrays[c][r] else b"\x00")
+            elif t == COL_INT64:
+                out += b"\x02" + struct.pack("<q", int(col_arrays[c][r]))
+            elif t == COL_FLOAT64:
+                out += b"\x03" + struct.pack("<d", float(col_arrays[c][r]))
+            elif t == COL_POINTER:
+                out += b"\x06" + struct.pack("<Q", int(col_arrays[c][r]))
+            elif t in (COL_STR, COL_BYTES):
+                blob, offs = col_arrays[c]
+                cell = blob[offs[r] : offs[r + 1]]
+                tag = b"\x04" if t == COL_STR else b"\x05"
+                out += tag + struct.pack("<I", len(cell)) + cell
+        row_offsets[r + 1] = len(out)
+    return bytes(out), row_offsets
+
+
+def frame_scan(data: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
+    offs: List[int] = []
+    lens: List[int] = []
+    pos = 0
+    n = len(data)
+    while pos + 8 <= n:
+        (payload_len, crc) = struct.unpack_from("<II", data, pos)
+        if pos + 8 + payload_len > n:
+            break
+        payload = data[pos + 8 : pos + 8 + payload_len]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            break
+        offs.append(pos + 8)
+        lens.append(payload_len)
+        pos += 8 + payload_len
+    return (
+        np.asarray(offs, dtype=np.int64),
+        np.asarray(lens, dtype=np.int64),
+        pos,
+    )
+
+
+def shard_rows(keys, n_shards: int, shard_mask: int):
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    shards = (keys & np.uint64(shard_mask)) % np.uint64(n_shards)
+    counts = np.bincount(shards.astype(np.int64), minlength=n_shards).astype(np.int64)
+    order = np.argsort(shards, kind="stable").astype(np.int64)
+    return counts, order

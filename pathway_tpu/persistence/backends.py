@@ -36,7 +36,7 @@ class FileBackend(PersistenceBackend):
 
     def _path(self, key: str) -> str:
         path = os.path.normpath(os.path.join(self.root, key))
-        if not path.startswith(self.root):
+        if path != self.root and not path.startswith(self.root + os.sep):
             raise ValueError(f"key escapes storage root: {key!r}")
         return path
 

@@ -17,12 +17,16 @@ Chunk layout under the backend:
 
 from __future__ import annotations
 
+import logging
 import pickle
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import Config, PersistenceMode, SnapshotAccess
 from .backends import PersistenceBackend
+from .framing import frame, scan
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["SourcePersistence", "PersistenceManager"]
 
@@ -66,11 +70,26 @@ class SourcePersistence:
             self._buffer.append(event)
 
     def replay_events(self) -> List[Event]:
+        """Replay recorded events; each chunk is a CRC-framed record log, so a
+        torn/corrupt tail truncates replay at the last intact record rather
+        than failing (the reference's rewind-to-common-frontier behavior,
+        docs/.../10.worker-architecture.md:58-61)."""
         events: List[Event] = []
         for seq in range(self._meta.get("chunks", 0)):
             blob = self.backend.get(f"sources/{self.pid}/chunk-{seq:08d}")
-            if blob:
-                events.extend(pickle.loads(blob))
+            if not blob:
+                continue
+            payloads, intact = scan(blob)
+            for p in payloads:
+                events.append(pickle.loads(p))
+            if not intact:
+                logger.warning(
+                    "snapshot chunk %s/%08d has a corrupt tail; replay "
+                    "truncated at the last intact record",
+                    self.pid,
+                    seq,
+                )
+                break
         return events
 
     def flush(self, frontier: int) -> None:
@@ -79,9 +98,8 @@ class SourcePersistence:
             offsets = self._offsets
         if buffer:
             seq = self._meta["chunks"]
-            self.backend.put(
-                f"sources/{self.pid}/chunk-{seq:08d}", pickle.dumps(buffer)
-            )
+            chunk = b"".join(frame(pickle.dumps(event)) for event in buffer)
+            self.backend.put(f"sources/{self.pid}/chunk-{seq:08d}", chunk)
             self._meta["chunks"] = seq + 1
         self._meta["offsets"] = offsets
         self._meta["frontier"] = frontier

@@ -1,0 +1,30 @@
+"""CRC-framed record encoding for snapshot chunks.
+
+Frame = ``[u32 LE payload_len][u32 LE crc32(payload)][payload]`` — the framing
+under the input-snapshot event log (reference analog: chunked snapshot events
+in src/persistence/input_snapshot.rs).  A torn write (process killed mid-put)
+or bit rot is detected on replay: ``scan`` returns only the valid prefix, so
+recovery rewinds to the last intact record instead of failing the run.
+Scanning is done by the native library (native/src/snapshot.cc) when present.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+from .. import native
+
+__all__ = ["frame", "scan"]
+
+
+def frame(payload: bytes) -> bytes:
+    return struct.pack("<II", len(payload), native.crc32(payload)) + payload
+
+
+def scan(blob: bytes) -> Tuple[List[bytes], bool]:
+    """Decode concatenated frames; returns (payloads, intact) where intact is
+    False if a truncated/corrupt tail was dropped."""
+    offs, lens, consumed = native.frame_scan(blob)
+    payloads = [bytes(blob[o : o + l]) for o, l in zip(offs, lens)]
+    return payloads, consumed == len(blob)
