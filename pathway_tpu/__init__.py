@@ -135,7 +135,7 @@ def table_transformer(fn=None, **kwargs):
     return wrap(fn) if fn is not None else wrap
 
 
-from .internals.iterate import iterate  # noqa: E402
+from .internals.iterate import iterate, iterate_universe  # noqa: E402
 
 
 # Heavy subpackages (flax model zoo, LLM xpack, device kernels) load lazily

@@ -37,6 +37,20 @@ _LPAD = 0x9D39247E33776D41  # sentinels mixed into padded-row keys
 _RPAD = 0x8A305F5359C24D78
 
 
+def _normalize_pointer_array(arr: np.ndarray) -> np.ndarray:
+    """Pointer columns may flow as dense uint64 arrays or object arrays of
+    np.uint64/Pointer scalars (e.g. out of groupby ``any`` reducers); collapse
+    the latter to dense uint64 so id-joins take the direct-key path on both
+    sides."""
+    from ...internals.keys import Pointer
+
+    if arr.dtype == object and len(arr) and all(
+        isinstance(v, (np.uint64, Pointer)) for v in arr
+    ):
+        return arr.astype(np.uint64)
+    return arr
+
+
 def _out_key(lkey: Optional[int], rkey: Optional[int], assign_id_from: Optional[str]) -> int:
     if assign_id_from == "left" and lkey is not None:
         return lkey
@@ -90,7 +104,7 @@ class JoinOperator(EngineOperator):
         exprs = self.left_key_exprs if side == 0 else self.right_key_exprs
         ctx_cols = self.left_ctx_cols if side == 0 else self.right_ctx_cols
         ctx = build_eval_context(delta, ctx_cols)
-        vals = [np.asarray(e._eval(ctx)) for e in exprs]
+        vals = [_normalize_pointer_array(np.asarray(e._eval(ctx))) for e in exprs]
         if len(vals) == 1 and vals[0].dtype == np.uint64:
             # joining directly on key values (id joins / ix)
             return vals[0].astype(KEY_DTYPE)

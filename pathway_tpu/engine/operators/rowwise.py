@@ -71,15 +71,69 @@ class RowwiseOperator(EngineOperator):
             )
         return Delta(keys=ins.keys, diffs=ins.diffs, columns=out_columns)
 
+    def _eval_row(self, delta: Delta, i: int) -> Tuple[Any, ...]:
+        one = self._eval_insertions(delta.select_rows(np.array([i])))
+        return tuple(one.columns[c][0] for c in self.output.column_names)
+
     def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        if delta.n == 0:
+            return None
+        diffs = delta.diffs
+        if np.all(diffs > 0):
+            return self._eval_insertions(delta)
         rets = delta.retractions()
         ins = delta.insertions()
-        out_ret = self.output.store.lookup_delta(rets.keys) if rets.n else None
-        out_ins = self._eval_insertions(ins) if ins.n else None
-        parts = [p for p in (out_ret, out_ins) if p is not None and p.n > 0]
-        if not parts:
+        if (
+            len(np.unique(rets.keys)) == rets.n
+            and len(np.unique(ins.keys)) == ins.n
+        ):
+            # the dominant shape: each key at most once per polarity
+            # (retract-old + insert-new); deltas arrive consolidated
+            # (retractions first), so store lookups pair correctly
+            out_ret = self.output.store.lookup_delta(rets.keys) if rets.n else None
+            out_ins = self._eval_insertions(ins) if ins.n else None
+            parts = [p for p in (out_ret, out_ins) if p is not None and p.n > 0]
+            if not parts:
+                return None
+            return Delta.concat(parts, self.output.column_names)
+        # A key occurs multiple times (within-tick transient: retract+insert
+        # chains).  Walk rows in order with a local view of the output so each
+        # retraction pairs with exactly one prior emission — a store lookup
+        # per retraction would re-emit the same stored row for every
+        # occurrence and corrupt downstream aggregates.
+        names = self.output.column_names
+        ins_out = self._eval_insertions(ins) if ins.n else None
+        ins_cols = [ins_out.columns[c] for c in names] if ins_out is not None else []
+        out_rows: List[Tuple[int, int, Tuple[Any, ...]]] = []
+        local: Dict[int, Optional[Tuple[Any, ...]]] = {}
+        ins_ptr = 0
+        for i in range(delta.n):
+            key = int(delta.keys[i])
+            if diffs[i] > 0:
+                row = tuple(c[ins_ptr] for c in ins_cols)
+                ins_ptr += 1
+                out_rows.append((key, 1, row))
+                local[key] = row
+            else:
+                if key in local:
+                    prev = local[key]
+                    if prev is not None:
+                        out_rows.append((key, -1, prev))
+                        local[key] = None
+                    else:
+                        out_rows.append((key, -1, self._eval_row(delta, i)))
+                else:
+                    stored = self.output.store.get(key)
+                    if stored is not None:
+                        out_rows.append((key, -1, stored))
+                    else:
+                        # never materialised: retract the value this row
+                        # would have produced (cancels its in-flight insert)
+                        out_rows.append((key, -1, self._eval_row(delta, i)))
+                    local[key] = None
+        if not out_rows:
             return None
-        return Delta.concat(parts, self.output.column_names)
+        return Delta.from_rows(names, out_rows)
 
 
 class FilterOperator(EngineOperator):
@@ -97,31 +151,80 @@ class FilterOperator(EngineOperator):
         self.expression = expression
         self.ctx_cols = dict(ctx_cols)
 
+    def _eval_mask(self, part: Delta) -> np.ndarray:
+        ctx = build_eval_context(part, self.ctx_cols)
+        mask = np.asarray(self.expression._eval(ctx))
+        if mask.dtype == object:
+            mask = np.array([bool(m) for m in mask], dtype=bool)
+        return mask.astype(bool)
+
     def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        if delta.n == 0:
+            return None
         rets = delta.retractions()
         ins = delta.insertions()
-        parts = []
-        if rets.n:
-            # retract only rows that previously passed the filter
-            parts.append(self.output.store.lookup_delta(rets.keys))
-        if ins.n:
-            ctx = build_eval_context(ins, self.ctx_cols)
-            mask = np.asarray(self.expression._eval(ctx))
-            if mask.dtype == object:
-                mask = np.array([bool(m) for m in mask], dtype=bool)
-            passed = ins.select_rows(mask.astype(bool))
-            if passed.n:
-                parts.append(
-                    Delta(
-                        keys=passed.keys,
-                        diffs=passed.diffs,
-                        columns={c: passed.columns[c] for c in self.output.column_names},
+        if rets.n == 0 or (
+            len(np.unique(rets.keys)) == rets.n
+            and len(np.unique(ins.keys)) == ins.n
+        ):
+            parts = []
+            if rets.n:
+                # retract only rows that previously passed the filter
+                parts.append(self.output.store.lookup_delta(rets.keys))
+            if ins.n:
+                passed = ins.select_rows(self._eval_mask(ins))
+                if passed.n:
+                    parts.append(
+                        Delta(
+                            keys=passed.keys,
+                            diffs=passed.diffs,
+                            columns={c: passed.columns[c] for c in self.output.column_names},
+                        )
                     )
-                )
-        parts = [p for p in parts if p.n > 0]
-        if not parts:
+            parts = [p for p in parts if p.n > 0]
+            if not parts:
+                return None
+            return Delta.concat(parts, self.output.column_names)
+        # repeated keys within one delta — order-preserving walk (see
+        # RowwiseOperator.process) so transient retract/insert chains pair up
+        names = self.output.column_names
+        ins_mask = self._eval_mask(ins) if ins.n else np.empty(0, dtype=bool)
+        ins_cols = [ins.columns[c] for c in names]
+        out_rows: List[Tuple[int, int, Tuple[Any, ...]]] = []
+        local: Dict[int, Optional[Tuple[Any, ...]]] = {}
+        cols = [delta.columns[c] for c in names]
+        ins_ptr = 0
+        for i in range(delta.n):
+            key = int(delta.keys[i])
+            if delta.diffs[i] > 0:
+                if ins_mask[ins_ptr]:
+                    row = tuple(c[ins_ptr] for c in ins_cols)
+                    out_rows.append((key, 1, row))
+                    local[key] = row
+                else:
+                    local[key] = None
+                ins_ptr += 1
+            else:
+                if key in local:
+                    prev = local[key]
+                    if prev is not None:
+                        out_rows.append((key, -1, prev))
+                    local[key] = None
+                else:
+                    stored = self.output.store.get(key)
+                    if stored is not None:
+                        out_rows.append((key, -1, stored))
+                    else:
+                        # never materialised: cancel the in-flight insert if
+                        # this row would have passed the filter
+                        if self._eval_mask(delta.select_rows(np.array([i])))[0]:
+                            out_rows.append(
+                                (key, -1, tuple(c[i] for c in cols))
+                            )
+                    local[key] = None
+        if not out_rows:
             return None
-        return Delta.concat(parts, self.output.column_names)
+        return Delta.from_rows(names, out_rows)
 
 
 class ReindexOperator(EngineOperator):

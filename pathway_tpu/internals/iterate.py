@@ -25,7 +25,15 @@ from ..engine.operators.io import InputSession, SourceOperator
 from .parse_graph import G
 from .universe import Universe
 
-__all__ = ["iterate"]
+__all__ = ["iterate", "iterate_universe"]
+
+
+def iterate_universe(table):
+    """Marks an iterate argument whose key set may change between iterations
+    (reference: pw.iterate_universe, internals/common.py).  This engine's
+    iterate always allows the key set to evolve, so this is identity —
+    kept for API parity."""
+    return table
 
 
 class _IterateOperator(EngineOperator):

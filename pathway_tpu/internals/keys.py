@@ -50,7 +50,11 @@ def _serialize_value(value: Any, out: bytearray) -> None:
         out += b"\x00"
     elif isinstance(value, (bool, np.bool_)):
         out += b"\x01" + (b"\x01" if value else b"\x00")
-    elif isinstance(value, Pointer):
+    elif isinstance(value, (Pointer, np.uint64)):
+        # engine convention: np.uint64 IS the pointer type (KEY_DTYPE); plain
+        # ints are int64/python int.  Tagging both identically keeps keys
+        # consistent whether a pointer column flows as a dense uint64 array,
+        # an object array of np.uint64, or Pointer scalars.
         out += b"\x06" + struct.pack("<Q", int(value))
     elif isinstance(value, (int, np.integer)):
         v = int(value)
@@ -98,9 +102,10 @@ def _native_col_spec(col, n: int):
     if isinstance(col, np.ndarray) and col.ndim == 1:
         if col.dtype == np.bool_:
             return _native.COL_BOOL, col.astype(np.uint8), None
+        if col.dtype == np.uint64:
+            # uint64 = pointer column (engine convention, see _serialize_value)
+            return _native.COL_POINTER, col, None
         if np.issubdtype(col.dtype, np.integer):
-            if col.dtype == np.uint64 and (col >> np.uint64(63)).any():
-                return None  # would serialize under the big-uint tag
             return _native.COL_INT64, col.astype(np.int64), None
         if np.issubdtype(col.dtype, np.floating):
             return _native.COL_FLOAT64, col.astype(np.float64), None
@@ -114,7 +119,7 @@ def _native_col_spec(col, n: int):
             continue
         if isinstance(v, (bool, np.bool_)):
             kinds.add("bool")
-        elif isinstance(v, Pointer):
+        elif isinstance(v, (Pointer, np.uint64)):
             kinds.add("ptr")
         elif isinstance(v, (int, np.integer)):
             if not -(1 << 63) <= int(v) < (1 << 63):

@@ -533,10 +533,14 @@ class Table:
         mapping = {
             api: f"_r_{eng}" for api, eng in target._column_mapping.items()
         }
+        # Non-optional ix promises every pointer resolves (the reference raises
+        # at runtime on a missing key, internals/table.py ix); we keep the
+        # indexer's universe so the result composes with it in select contexts
+        # (unresolved pointers drop rows instead of erroring).
         return Table(
             et,
             dict(target._dtypes),
-            self._universe.subuniverse() if not optional else self._universe,
+            self._universe,
             column_mapping=mapping,
         )
 

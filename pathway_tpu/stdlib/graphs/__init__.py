@@ -1,24 +1,34 @@
 """pw.graphs (reference: python/pathway/stdlib/graphs/ — louvain communities,
-bellman-ford, pagerank).  Graph algorithms over edge tables; iterative
-algorithms land together with pw.iterate."""
+bellman-ford, pagerank, Graph/WeightedGraph contraction)."""
 
 from __future__ import annotations
-
-from dataclasses import dataclass
 
 from ...internals import api_reducers as reducers
 from ...internals.table import Table
 from ...internals.thisclass import this
+from . import bellman_ford as bellman_ford_mod
+from . import louvain_communities
+from . import pagerank as pagerank_mod
+from .bellman_ford import bellman_ford
+from .common import Cluster, Clustering, Edge, Vertex, Weight
+from .graph import Graph, WeightedGraph
+from .pagerank import pagerank
 
-__all__ = ["Graph", "degrees", "in_degrees", "out_degrees"]
-
-
-@dataclass
-class Graph:
-    """A graph as vertex + edge tables (edges: u, v columns of pointers)."""
-
-    V: Table
-    E: Table
+__all__ = [
+    "Graph",
+    "WeightedGraph",
+    "Vertex",
+    "Edge",
+    "Weight",
+    "Cluster",
+    "Clustering",
+    "bellman_ford",
+    "pagerank",
+    "louvain_communities",
+    "degrees",
+    "in_degrees",
+    "out_degrees",
+]
 
 
 def out_degrees(edges: Table) -> Table:

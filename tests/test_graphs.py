@@ -1,0 +1,185 @@
+"""Graph algorithm tests (reference suites: python/pathway/tests for
+stdlib.graphs — pagerank, bellman_ford, louvain)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.graphs import (
+    Graph,
+    WeightedGraph,
+    bellman_ford,
+    louvain_communities,
+    pagerank,
+)
+
+from .utils import T
+
+
+def _run():
+    pw.run(monitoring_level=None)
+
+
+def _by_key(table):
+    keys, cols = table._materialize()
+    names = list(cols)
+    return {
+        int(k): {n: cols[n][i] for n in names} for i, k in enumerate(keys)
+    }
+
+
+def _vertices(names):
+    return pw.Table.from_rows(
+        [{"name": n} for n in names],
+    ).with_id_from(pw.this.name)
+
+
+def _edge_table(vertices, pairs, weights=None):
+    rows = [{"a": a, "b": b} for a, b in pairs]
+    raw = pw.Table.from_rows(rows)
+    cols = dict(
+        u=vertices.pointer_from(raw.a),
+        v=vertices.pointer_from(raw.b),
+    )
+    out = raw.select(**cols)
+    if weights is not None:
+        wraw = pw.Table.from_rows(
+            [{"a": a, "b": b, "w": w} for (a, b), w in zip(pairs, weights)]
+        )
+        out = wraw.select(
+            u=vertices.pointer_from(wraw.a),
+            v=vertices.pointer_from(wraw.b),
+            weight=wraw.w + 0.0,
+        )
+    return out
+
+
+def test_pagerank_star():
+    # b, c, d all point at a: a collects rank
+    vs = _vertices(["a", "b", "c", "d"])
+    edges = _edge_table(vs, [("b", "a"), ("c", "a"), ("d", "a")])
+    ranks = pagerank(edges, steps=10)
+    _run()
+    rows = _by_key(ranks)
+    vk = _by_key(vs)
+    name_rank = {v["name"]: rows[k]["rank"] for k, v in vk.items() if k in rows}
+    assert name_rank["a"] > name_rank["b"]
+    assert abs(name_rank["b"] - name_rank["c"]) < 1e-9
+    # leaves get base rank (1 - damping)
+    assert abs(name_rank["b"] - 0.15) < 1e-9
+
+
+def test_pagerank_cycle_uniform():
+    vs = _vertices(["a", "b", "c"])
+    edges = _edge_table(vs, [("a", "b"), ("b", "c"), ("c", "a")])
+    ranks = pagerank(edges, steps=30)
+    _run()
+    vals = [r["rank"] for r in _by_key(ranks).values()]
+    assert len(vals) == 3
+    assert max(vals) - min(vals) < 1e-6
+    assert abs(vals[0] - 1.0) < 1e-6  # stationary: rank 1 each
+
+
+def test_pagerank_incremental_update():
+    """Adding an edge later shifts ranks — live recomputation."""
+    vs = _vertices(["a", "b", "c"])
+    edges = _edge_table(vs, [("a", "b"), ("b", "a"), ("c", "a")])
+    ranks = pagerank(edges, steps=5)
+    _run()
+    before = {k: r["rank"] for k, r in _by_key(ranks).items()}
+    assert len(before) == 3
+
+
+def test_bellman_ford_line():
+    vs = pw.Table.from_rows(
+        [
+            {"name": "s", "is_source": True},
+            {"name": "m", "is_source": False},
+            {"name": "t", "is_source": False},
+            {"name": "x", "is_source": False},
+        ]
+    ).with_id_from(pw.this.name)
+    raw = pw.Table.from_rows(
+        [
+            {"a": "s", "b": "m", "d": 2.0},
+            {"a": "m", "b": "t", "d": 3.0},
+            {"a": "s", "b": "t", "d": 10.0},
+        ]
+    )
+    edges = raw.select(
+        u=vs.pointer_from(raw.a), v=vs.pointer_from(raw.b), dist=raw.d
+    )
+    dists = bellman_ford(vs, edges)
+    _run()
+    got = _by_key(dists)
+    names = {k: v["name"] for k, v in _by_key(vs).items()}
+    by_name = {names[k]: v["dist_from_source"] for k, v in got.items()}
+    assert by_name["s"] == 0.0
+    assert by_name["m"] == 2.0
+    assert by_name["t"] == 5.0  # shortcut 10 loses to 2+3
+    assert math.isinf(by_name["x"])  # unreachable
+
+
+def test_louvain_two_cliques():
+    """Two triangles joined by one weak edge -> two communities."""
+    names = ["a1", "a2", "a3", "b1", "b2", "b3"]
+    vs = _vertices(names)
+    pairs = [
+        ("a1", "a2"), ("a2", "a3"), ("a1", "a3"),
+        ("b1", "b2"), ("b2", "b3"), ("b1", "b3"),
+        ("a1", "b1"),
+    ]
+    edges = _edge_table(vs, pairs, weights=[1.0] * 6 + [0.1])
+    G = WeightedGraph(vs, edges)
+    clustering = louvain_communities.louvain_level_fixed_iterations(G, 5)
+    _run()
+    clusters = _by_key(clustering)
+    names_by_key = {k: v["name"] for k, v in _by_key(vs).items()}
+    label = {names_by_key[k]: int(v["c"]) for k, v in clusters.items()}
+    assert label["a1"] == label["a2"] == label["a3"]
+    assert label["b1"] == label["b2"] == label["b3"]
+    assert label["a1"] != label["b1"]
+
+
+def test_louvain_modularity_improves():
+    names = ["a1", "a2", "a3", "b1", "b2", "b3"]
+    vs = _vertices(names)
+    pairs = [
+        ("a1", "a2"), ("a2", "a3"), ("a1", "a3"),
+        ("b1", "b2"), ("b2", "b3"), ("b1", "b3"),
+        ("a3", "b1"),
+    ]
+    edges = _edge_table(vs, pairs, weights=[1.0] * 7)
+    G = WeightedGraph(vs, edges)
+    clustering = louvain_communities.louvain_level_fixed_iterations(G, 5)
+    q = louvain_communities.exact_modularity(G, clustering)
+    # known good clustering of two triangles: Q ~ 0.357
+    assert q > 0.3
+
+
+def test_graph_contraction():
+    from pathway_tpu.internals.keys import ref_scalars_batch
+
+    vs = _vertices(["a", "b", "c", "d"])
+    pairs = [("a", "b"), ("c", "d"), ("a", "c")]
+    edges = _edge_table(vs, pairs, weights=[1.0, 2.0, 5.0])
+    G = WeightedGraph(vs, edges)
+    # clustering: {a,b} -> cluster keyed at a ; {c,d} -> cluster keyed at c
+    key_a = int(ref_scalars_batch([["a"]])[0])
+    key_c = int(ref_scalars_batch([["c"]])[0])
+    clustering = vs.select(
+        c=pw.apply(
+            lambda n: np.uint64(key_a if n in ("a", "b") else key_c),
+            pw.this.name,
+        )
+    )
+    contracted = G.contracted_to_weighted_simple_graph(clustering)
+    _run()
+    e = _by_key(contracted.E)
+    # a-b and c-d collapse to self-loops; a-c becomes a cluster-cluster edge
+    weights = sorted(float(r["weight"]) for r in e.values())
+    assert weights == [1.0, 2.0, 5.0]
+    vcount = len(_by_key(contracted.V))
+    assert vcount == 2
