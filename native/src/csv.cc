@@ -55,7 +55,11 @@ void scan(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
       // start of a cell
       if (i < len && buf[i] == quote) {
         // quoted field: body excludes outer quotes; "" stays in the extent
-        // (flagged for unescape)
+        // (flagged for unescape).  Text between the closing quote and the
+        // delimiter is kept VERBATIM (python csv module semantics:
+        // '"Smith" Jr.' -> 'Smith Jr.'), so a cell with such a tail spans
+        // body + closing quote + tail and unescape switches to verbatim
+        // copying at the lone closing quote.
         int64_t start = ++i;
         while (i < len) {
           if (buf[i] == quote) {
@@ -67,12 +71,16 @@ void scan(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
           }
           ++i;
         }
-        sink.cell(start, i - start, true);
+        int64_t body_end = i;
         if (i < len) ++i;  // skip closing quote
-        // consume until delim / newline / EOF (junk after quote is dropped,
-        // matching the python csv module's lenient behavior)
+        int64_t tail_start = i;
         while (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r')
           ++i;
+        if (i == tail_start) {
+          sink.cell(start, body_end - start, true);  // no tail: body only
+        } else {
+          sink.cell(start, i - start, true);  // body + closing quote + tail
+        }
       } else {
         int64_t start = i;
         while (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r')
@@ -130,10 +138,21 @@ int pn_csv_scan(const uint8_t* buf, int64_t len, uint8_t delim, uint8_t quote,
 
 int64_t pn_csv_unescape(const uint8_t* src, int64_t len, uint8_t quote,
                         uint8_t* dst) {
+  // Quoted-body mode: "" collapses to "; a lone quote is the closing quote —
+  // drop it and copy the remaining tail verbatim (python csv semantics).
   int64_t o = 0;
+  bool in_quotes = true;
   for (int64_t i = 0; i < len; ++i) {
-    dst[o++] = src[i];
-    if (src[i] == quote && i + 1 < len && src[i + 1] == quote) ++i;
+    if (in_quotes && src[i] == quote) {
+      if (i + 1 < len && src[i + 1] == quote) {
+        dst[o++] = quote;
+        ++i;
+      } else {
+        in_quotes = false;
+      }
+    } else {
+      dst[o++] = src[i];
+    }
   }
   return o;
 }

@@ -151,10 +151,52 @@ class Delta:
         return Delta(keys=keys, diffs=diffs, columns=columns)
 
     def consolidated(self) -> "Delta":
-        """Order retractions before insertions (stable), drop nothing."""
+        """Cancel exact insert/retract pairs per key, then order retractions
+        before insertions (stable).
+
+        Cancellation makes the ordering safe: ``RowStore.apply`` replays a
+        delta positionally, so an uncancelled (−new, +new) pair from a
+        delete-after-update transient, re-sorted retractions-first, would
+        resurrect the deleted row.  Removing equal-and-opposite pairs
+        preserves the multiset sum (aggregates unaffected) and leaves at most
+        one retraction + one insertion per key in well-formed streams."""
         if self.n <= 1:
             return self
-        order = np.argsort(self.diffs, kind="stable")
+        keys = self.keys
+        diffs = self.diffs
+        keep = np.ones(self.n, dtype=bool)
+        # cancellation needed only for keys carrying both polarities
+        uniq, inv = np.unique(keys, return_inverse=True)
+        if len(uniq) < self.n:
+            names = self.column_names
+            cols = [self.columns[c] for c in names]
+            groups: Dict[int, List[int]] = {}
+            for i, g in enumerate(inv):
+                groups.setdefault(int(g), []).append(i)
+            for idxs in groups.values():
+                if len(idxs) < 2:
+                    continue
+                pos = [i for i in idxs if diffs[i] > 0]
+                neg = [i for i in idxs if diffs[i] < 0]
+                if not pos or not neg:
+                    continue
+                for ni in neg:
+                    nrow = tuple(c[ni] for c in cols)
+                    for pj, pi in enumerate(pos):
+                        if pi is None:
+                            continue
+                        if rows_equal(tuple(c[pi] for c in cols), nrow):
+                            keep[ni] = False
+                            keep[pi] = False
+                            pos[pj] = None
+                            break
+            if not keep.all():
+                sub = self.select_rows(keep)
+                if sub.n <= 1:
+                    return sub
+                order = np.argsort(sub.diffs, kind="stable")
+                return sub.select_rows(order)
+        order = np.argsort(diffs, kind="stable")
         if np.all(order == np.arange(self.n)):
             return self
         return self.select_rows(order)

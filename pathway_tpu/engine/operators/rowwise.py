@@ -209,7 +209,16 @@ class FilterOperator(EngineOperator):
                     prev = local[key]
                     if prev is not None:
                         out_rows.append((key, -1, prev))
-                    local[key] = None
+                        local[key] = None
+                    else:
+                        # second retraction of the key (delete-after-update
+                        # chains): cancel the in-flight insert if this row
+                        # would have passed the filter — emitting nothing here
+                        # would leave a phantom row downstream
+                        if self._eval_mask(delta.select_rows(np.array([i])))[0]:
+                            out_rows.append(
+                                (key, -1, tuple(c[i] for c in cols))
+                            )
                 else:
                     stored = self.output.store.get(key)
                     if stored is not None:

@@ -45,9 +45,19 @@ def write(
     def flush(ts=None):
         if not buffer:
             return
-        errors = client.insert_rows_json(table_ref, list(buffer))
+        batch = list(buffer)
+        errors = client.insert_rows_json(table_ref, batch)
         if errors:
-            # keep the batch buffered so a later flush can retry it
+            # insert_rows_json reports per-row failures; rows not listed were
+            # inserted, so keep ONLY the failed rows for the retry — leaving
+            # the whole batch buffered would re-insert the successful rows.
+            # If any error entry lacks a usable row index (request-level
+            # errors), fall back to retrying the whole batch: duplicates beat
+            # silent loss (at-least-once).
+            idxs = [e.get("index") for e in errors]
+            if all(isinstance(i, int) and 0 <= i < len(batch) for i in idxs):
+                failed_idx = sorted(set(idxs))
+                buffer[:] = [batch[i] for i in failed_idx]
             raise RuntimeError(f"BigQuery insert errors: {errors}")
         del buffer[:]
 

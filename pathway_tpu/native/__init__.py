@@ -189,10 +189,31 @@ def csv_scan(
     return rcs, off, ln, quoted
 
 
+def _py_csv_unescape(cell: bytes, qb: bytes) -> bytes:
+    """Mirror of pn_csv_unescape: '""' -> '"' inside the quoted body; the lone
+    closing quote is dropped and the tail after it is copied verbatim."""
+    out = bytearray()
+    in_quotes = True
+    i, n = 0, len(cell)
+    while i < n:
+        c = cell[i : i + 1]
+        if in_quotes and c == qb:
+            if cell[i + 1 : i + 2] == qb:
+                out += qb
+                i += 2
+                continue
+            in_quotes = False
+            i += 1
+        else:
+            out += c
+            i += 1
+    return bytes(out)
+
+
 def csv_unescape(cell: bytes, quote: str = '"') -> bytes:
     dll = lib()
     if dll is None:
-        return cell.replace((quote * 2).encode(), quote.encode())
+        return _py_csv_unescape(cell, quote.encode())
     out = ctypes.create_string_buffer(len(cell))
     n = dll.pn_csv_unescape(
         _as_u8_ptr(cell), len(cell), ord(quote), ctypes.cast(out, _p_u8)
@@ -203,7 +224,7 @@ def csv_unescape(cell: bytes, quote: str = '"') -> bytes:
 def csv_rows(data: bytes, delim: str = ",", quote: str = '"') -> List[List[str]]:
     """Decode a CSV buffer into rows of str (skipping zero-cell rows)."""
     rcs, off, ln, quoted = csv_scan(data, delim, quote)
-    qbytes = (quote * 2).encode()
+    qb = quote.encode()
     rows: List[List[str]] = []
     for r in range(len(rcs) - 1):
         lo, hi = rcs[r], rcs[r + 1]
@@ -212,8 +233,8 @@ def csv_rows(data: bytes, delim: str = ",", quote: str = '"') -> List[List[str]]
         row = []
         for c in range(lo, hi):
             cell = data[off[c] : off[c] + ln[c]]
-            if quoted[c] and qbytes in cell:
-                cell = cell.replace(qbytes, quote.encode())
+            if quoted[c] and qb in cell:
+                cell = _py_csv_unescape(cell, qb)
             row.append(cell.decode("utf-8", errors="replace"))
         rows.append(row)
     return rows

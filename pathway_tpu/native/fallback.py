@@ -40,13 +40,17 @@ def csv_scan(data: bytes, delim: str = ",", quote: str = '"'):
                             continue
                         break
                     i += 1
-                off.append(start)
-                ln.append(i - start)
-                quoted.append(1)
+                body_end = i
                 if i < n:
                     i += 1
+                tail_start = i
                 while i < n and data[i : i + 1] not in (d, b"\n", b"\r"):
                     i += 1
+                # post-quote tail kept verbatim (python csv semantics): the
+                # extent then spans body + closing quote + tail
+                off.append(start)
+                ln.append((body_end - start) if i == tail_start else (i - start))
+                quoted.append(1)
             else:
                 start = i
                 while i < n and data[i : i + 1] not in (d, b"\n", b"\r"):

@@ -69,28 +69,76 @@ class SourcePersistence:
         with self._lock:
             self._buffer.append(event)
 
+    #: chunk format marker; bump when the framing/payload encoding changes so
+    #: old snapshots are recognized instead of being misread as corruption
+    CHUNK_MAGIC = b"PWC1"
+
     def replay_events(self) -> List[Event]:
         """Replay recorded events; each chunk is a CRC-framed record log, so a
         torn/corrupt tail truncates replay at the last intact record rather
         than failing (the reference's rewind-to-common-frontier behavior,
-        docs/.../10.worker-architecture.md:58-61)."""
+        docs/.../10.worker-architecture.md:58-61).
+
+        On a corrupt tail the log is REWRITTEN at the truncation point: the
+        torn chunk is replaced by its intact prefix and later chunks are
+        deleted, so subsequent flushes append consistently — otherwise every
+        future replay would re-hit the torn chunk and silently drop
+        everything recorded after the first recovery."""
         events: List[Event] = []
-        for seq in range(self._meta.get("chunks", 0)):
-            blob = self.backend.get(f"sources/{self.pid}/chunk-{seq:08d}")
+        n_chunks = self._meta.get("chunks", 0)
+        for seq in range(n_chunks):
+            key = f"sources/{self.pid}/chunk-{seq:08d}"
+            blob = self.backend.get(key)
             if not blob:
                 continue
+            if blob.startswith(self.CHUNK_MAGIC):
+                blob = blob[len(self.CHUNK_MAGIC):]
             payloads, intact = scan(blob)
             for p in payloads:
                 events.append(pickle.loads(p))
             if not intact:
                 logger.warning(
                     "snapshot chunk %s/%08d has a corrupt tail; replay "
-                    "truncated at the last intact record",
+                    "truncated at the last intact record%s",
                     self.pid,
                     seq,
+                    " and the log rewound to this point"
+                    if self.record_enabled
+                    else "",
                 )
+                if self.record_enabled:
+                    # about to append new events: rewind the on-disk log so
+                    # future flushes stay reachable.  In replay-only mode
+                    # (SnapshotAccess.REPLAY) never mutate the backend —
+                    # truncation is in-memory and the data stays recoverable.
+                    self._truncate_log_at(seq, payloads)
                 break
         return events
+
+    def _truncate_log_at(self, seq: int, intact_payloads: List[bytes]) -> None:
+        """Rewrite chunk ``seq`` with its intact prefix, drop later chunks,
+        and rewind the chunk counter so new flushes continue from here."""
+        key = f"sources/{self.pid}/chunk-{seq:08d}"
+        if intact_payloads:
+            self.backend.put(
+                key,
+                self.CHUNK_MAGIC + b"".join(frame(p) for p in intact_payloads),
+            )
+            self._meta["chunks"] = seq + 1
+        else:
+            self.backend.delete(key)
+            self._meta["chunks"] = seq
+        # sweep every chunk file at/after the new counter (incl. torn runs)
+        for k in self.backend.list_keys(f"sources/{self.pid}/"):
+            name = k.rsplit("/", 1)[-1]
+            if name.startswith("chunk-"):
+                try:
+                    s = int(name[len("chunk-"):])
+                except ValueError:
+                    continue
+                if s >= self._meta["chunks"]:
+                    self.backend.delete(f"sources/{self.pid}/chunk-{s:08d}")
+        self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
 
     def flush(self, frontier: int) -> None:
         with self._lock:
@@ -98,7 +146,9 @@ class SourcePersistence:
             offsets = self._offsets
         if buffer:
             seq = self._meta["chunks"]
-            chunk = b"".join(frame(pickle.dumps(event)) for event in buffer)
+            chunk = self.CHUNK_MAGIC + b"".join(
+                frame(pickle.dumps(event)) for event in buffer
+            )
             self.backend.put(f"sources/{self.pid}/chunk-{seq:08d}", chunk)
             self._meta["chunks"] = seq + 1
         self._meta["offsets"] = offsets

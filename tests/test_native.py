@@ -55,9 +55,24 @@ def test_csv_rows_against_csv_module():
     assert got == want
 
 
+def test_csv_rows_post_quote_tail_matches_csv_module():
+    """Text between a closing quote and the delimiter is kept verbatim,
+    exactly like the python csv module."""
+    import csv
+    import io
+
+    data = b'"Smith" Jr.,x\n"a" "b""c",2\n"q"tail"more",w\n"x" ,y\n'
+    want = list(csv.reader(io.StringIO(data.decode())))
+    got = native.csv_rows(data)
+    assert got == want
+
+
 def test_csv_unescape():
     assert native.csv_unescape(b'a""b""') == b'a"b"'
     assert native.csv_unescape(b"plain") == b"plain"
+    # lone closing quote: drop it, tail verbatim
+    assert native.csv_unescape(b'Smith" Jr.') == b"Smith Jr."
+    assert native.csv_unescape(b'q"tail"more"') == b'qtail"more"'
 
 
 def test_parse_int64_matches_fallback():

@@ -135,3 +135,38 @@ def _final_counts(events, base=None):
         elif diff == -1 and counts.get(k) == total:
             del counts[k]
     return counts
+
+
+def test_corrupt_chunk_rewinds_log_for_future_flushes():
+    """A torn chunk truncates replay AND rewinds the log, so chunks flushed
+    after the recovery stay reachable on every later replay (the counter must
+    not keep pointing past the corruption)."""
+    import pickle
+
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.persistence.engine_state import SourcePersistence
+
+    backend = MemoryBackend()
+    sp = SourcePersistence(backend, "pid")
+    sp.record((1, 1, ("a",)))
+    sp.flush(2)
+    sp.record((2, 1, ("b",)))
+    sp.flush(4)
+
+    # tear chunk 1 mid-record
+    key = "sources/pid/chunk-00000001"
+    blob = backend.get(key)
+    backend.put(key, blob[: len(blob) - 3])
+
+    # restart 1: replay truncates at the tear and rewinds
+    sp2 = SourcePersistence(backend, "pid")
+    events = sp2.replay_events()
+    assert events == [(1, 1, ("a",))]
+    # new events recorded after recovery
+    sp2.record((3, 1, ("c",)))
+    sp2.flush(6)
+
+    # restart 2: everything recorded after the recovery is still replayed
+    sp3 = SourcePersistence(backend, "pid")
+    events = sp3.replay_events()
+    assert events == [(1, 1, ("a",)), (3, 1, ("c",))]

@@ -401,3 +401,47 @@ def test_groupby_expression_of_group_col():
         twice=pw.this.k * 2, s=pw.reducers.sum(pw.this.v)
     )
     assert_rows(out, [{"twice": 4, "s": 3}, {"twice": 8, "s": 5}])
+
+
+def test_consolidated_cancels_insert_retract_pairs():
+    """A delete-after-update transient [-old, +new, -new] must not resurrect
+    the row once retractions are re-ordered first (RowStore.apply replays
+    positionally)."""
+    from pathway_tpu.engine.delta import Delta, RowStore
+
+    d = Delta.from_rows(
+        ["v"], [(7, -1, ("old",)), (7, 1, ("new",)), (7, -1, ("new",))]
+    )
+    c = d.consolidated()
+    assert c.n == 1
+    assert int(c.diffs[0]) == -1 and c.columns["v"][0] == "old"
+    store = RowStore(["v"])
+    store.apply(Delta.from_rows(["v"], [(7, 1, ("old",))]))
+    store.apply(c)
+    assert store.get(7) is None, "deleted row resurrected"
+
+
+def test_filter_delete_after_update_transient():
+    """End-to-end: upsert then delete within one tick leaves no phantom row
+    after a filter + select chain."""
+    import time
+
+    import pathway_tpu as pw
+
+    class KV(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            self.next(k="a", v=2)   # upsert
+            self.delete(k="a", v=2)  # delete, same tick
+            self.next(k="b", v=9)
+
+    t = pw.io.python.read(Subj(), schema=KV)
+    out = t.filter(pw.this.v > 0).select(v2=pw.this.v * 2)
+    pw.run(monitoring_level=None)
+    keys, cols = out._materialize()
+    vals = sorted(int(x) for x in cols["v2"])
+    assert vals == [18], f"phantom rows: {vals}"
