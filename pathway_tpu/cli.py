@@ -1,0 +1,178 @@
+"""``pathway-tpu`` command line — multi-process launcher
+(reference: python/pathway/cli.py:53-260 — ``pathway spawn`` /
+``pathway replay`` / ``pathway spawn-from-env``).
+
+The reference spawns N engine processes that form a timely cluster over
+TCP (PATHWAY_PROCESS_ID / PATHWAY_PROCESSES / PATHWAY_FIRST_PORT).  The
+TPU-native analog launches the same user program once per host process and
+exports both the PATHWAY_* topology variables and the jax.distributed
+coordinates (process 0 is the coordinator), so ``pw.parallel`` can
+initialize a multi-host mesh over ICI/DCN instead of a socket cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["main", "spawn_program"]
+
+
+def _topology_env(
+    process_id: int,
+    processes: int,
+    first_port: int,
+    base: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    env = dict(os.environ if base is None else base)
+    env["PATHWAY_PROCESS_ID"] = str(process_id)
+    env["PATHWAY_PROCESSES"] = str(processes)
+    env["PATHWAY_FIRST_PORT"] = str(first_port)
+    # jax.distributed coordinates (multi-host mesh over DCN); process 0 hosts
+    # the coordinator service
+    env["PATHWAY_COORDINATOR_ADDRESS"] = f"127.0.0.1:{first_port}"
+    env["JAX_COORDINATOR_ADDRESS"] = env["PATHWAY_COORDINATOR_ADDRESS"]
+    env["JAX_NUM_PROCESSES"] = str(processes)
+    env["JAX_PROCESS_ID"] = str(process_id)
+    return env
+
+
+def spawn_program(
+    program: str,
+    arguments: Sequence[str],
+    *,
+    processes: int = 1,
+    first_port: int = 10000,
+    env_extra: Optional[Dict[str, str]] = None,
+) -> int:
+    """Launch ``processes`` copies of ``program``; returns the worst exit
+    code.  A failing process tears the others down (the reference's
+    all-pods-must-be-present model, SURVEY §5.3)."""
+    handles: List[subprocess.Popen] = []
+    try:
+        for pid in range(processes):
+            env = _topology_env(pid, processes, first_port)
+            if env_extra:
+                env.update(env_extra)
+            handles.append(
+                subprocess.Popen([program, *arguments], env=env)
+            )
+        # wait on ANY process: a crashed member must tear the others down
+        # immediately, even while lower-index members are still running
+        import time as _time
+
+        exit_code = 0
+        live = list(handles)
+        terminated = False
+        while live:
+            progressed = False
+            for h in list(live):
+                rc = h.poll()
+                if rc is None:
+                    continue
+                live.remove(h)
+                progressed = True
+                if rc != 0 and not terminated:
+                    exit_code = rc
+                    terminated = True
+                    for other in live:
+                        if other.poll() is None:
+                            other.send_signal(signal.SIGTERM)
+            if live and not progressed:
+                _time.sleep(0.05)
+        return exit_code
+    except KeyboardInterrupt:
+        for h in handles:
+            if h.poll() is None:
+                h.send_signal(signal.SIGINT)
+        for h in handles:
+            h.wait()
+        return 130
+
+
+def _persistence_env(args) -> Dict[str, str]:
+    env: Dict[str, str] = {}
+    if getattr(args, "record", False) or getattr(args, "mode", None):
+        path = getattr(args, "record_path", None) or "./record"
+        env["PATHWAY_PERSISTENT_STORAGE"] = path
+    if getattr(args, "mode", None):
+        env["PATHWAY_PERSISTENCE_MODE"] = args.mode.upper()
+    return env
+
+
+def _add_spawn_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "-n",
+        "--processes",
+        type=int,
+        default=1,
+        help="number of host processes to launch",
+    )
+    p.add_argument(
+        "--first-port",
+        type=int,
+        default=10000,
+        help="coordinator port (process i uses first_port+i)",
+    )
+    p.add_argument("program")
+    p.add_argument("arguments", nargs=argparse.REMAINDER)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="pathway-tpu", description=__doc__.splitlines()[0]
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("spawn", help="run a program on N coordinated processes")
+    _add_spawn_args(sp)
+    sp.add_argument(
+        "--record", action="store_true", help="record input connector data"
+    )
+    sp.add_argument(
+        "--record-path", default=None, help="snapshot storage location"
+    )
+
+    rp = sub.add_parser("replay", help="re-run a program from recorded data")
+    _add_spawn_args(rp)
+    rp.add_argument(
+        "--record-path", default="./record", help="snapshot storage location"
+    )
+    rp.add_argument(
+        "--mode",
+        choices=["batch", "speedrun"],
+        default="batch",
+        help="replay timing: batch (collapse) or speedrun (original pacing)",
+    )
+
+    se = sub.add_parser(
+        "spawn-from-env",
+        help="spawn with arguments taken from $PATHWAY_SPAWN_ARGS",
+    )
+    se.add_argument("program", nargs="?", default=None)
+    se.add_argument("arguments", nargs=argparse.REMAINDER)
+
+    args = parser.parse_args(argv)
+
+    if args.command == "spawn-from-env":
+        spawn_args = shlex.split(os.environ.get("PATHWAY_SPAWN_ARGS", ""))
+        extra = [args.program] if args.program else []
+        return main(["spawn", *spawn_args, *extra, *args.arguments])
+
+    env_extra = _persistence_env(args)
+    return spawn_program(
+        args.program,
+        args.arguments,
+        processes=args.processes,
+        first_port=args.first_port,
+        env_extra=env_extra,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,21 +165,22 @@ class Delta:
         keys = self.keys
         diffs = self.diffs
         keep = np.ones(self.n, dtype=bool)
-        # cancellation needed only for keys carrying both polarities
+        # cancellation needed only for keys carrying both polarities —
+        # find those rows vectorised so the common single-upsert-in-a-bulk
+        # delta never enters a python loop
         uniq, inv = np.unique(keys, return_inverse=True)
         if len(uniq) < self.n:
+            has_pos = np.bincount(inv, weights=(diffs > 0)) > 0
+            has_neg = np.bincount(inv, weights=(diffs < 0)) > 0
+            mixed_rows = np.flatnonzero(has_pos[inv] & has_neg[inv])
             names = self.column_names
             cols = [self.columns[c] for c in names]
             groups: Dict[int, List[int]] = {}
-            for i, g in enumerate(inv):
-                groups.setdefault(int(g), []).append(i)
+            for i in mixed_rows:
+                groups.setdefault(int(inv[i]), []).append(int(i))
             for idxs in groups.values():
-                if len(idxs) < 2:
-                    continue
                 pos = [i for i in idxs if diffs[i] > 0]
                 neg = [i for i in idxs if diffs[i] < 0]
-                if not pos or not neg:
-                    continue
                 for ni in neg:
                     nrow = tuple(c[ni] for c in cols)
                     for pj, pi in enumerate(pos):

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -72,6 +73,10 @@ class EngineOperator:
         self.output = output
         self.topo_index: int = -1
         self.trace: Any = None  # user stack frame (internals/trace.py)
+        # scrape-time observability (internals/metrics.py /metrics endpoint)
+        self.rows_in: int = 0
+        self.rows_out: int = 0
+        self.process_ns: int = 0
         for port, table in enumerate(self.inputs):
             table.consumers.append((self, port))
         if output is not None:
@@ -174,9 +179,13 @@ class EngineGraph:
             _, _, op, port, delta = heapq.heappop(heap)
             if delta.n == 0 and port >= 0:
                 continue
+            t0 = _time.perf_counter_ns()
             out = op.process(port, delta, ts)
+            op.process_ns += _time.perf_counter_ns() - t0
+            op.rows_in += delta.n
             if out is not None and out.n > 0 and op.output is not None:
                 out = out.consolidated()
+                op.rows_out += out.n
                 op.output.store.apply(out)
                 for consumer, cport in op.output.consumers:
                     heapq.heappush(

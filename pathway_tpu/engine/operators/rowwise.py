@@ -205,31 +205,26 @@ class FilterOperator(EngineOperator):
                     local[key] = None
                 ins_ptr += 1
             else:
+                def cancel_in_flight(idx: int, k: int) -> None:
+                    # no prior emission to pair with (second retraction of a
+                    # delete-after-update chain, or never-materialised row):
+                    # cancel the in-flight insert if the row passes the filter
+                    if self._eval_mask(delta.select_rows(np.array([idx])))[0]:
+                        out_rows.append((k, -1, tuple(c[idx] for c in cols)))
+
                 if key in local:
                     prev = local[key]
                     if prev is not None:
                         out_rows.append((key, -1, prev))
                         local[key] = None
                     else:
-                        # second retraction of the key (delete-after-update
-                        # chains): cancel the in-flight insert if this row
-                        # would have passed the filter — emitting nothing here
-                        # would leave a phantom row downstream
-                        if self._eval_mask(delta.select_rows(np.array([i])))[0]:
-                            out_rows.append(
-                                (key, -1, tuple(c[i] for c in cols))
-                            )
+                        cancel_in_flight(i, key)
                 else:
                     stored = self.output.store.get(key)
                     if stored is not None:
                         out_rows.append((key, -1, stored))
                     else:
-                        # never materialised: cancel the in-flight insert if
-                        # this row would have passed the filter
-                        if self._eval_mask(delta.select_rows(np.array([i])))[0]:
-                            out_rows.append(
-                                (key, -1, tuple(c[i] for c in cols))
-                            )
+                        cancel_in_flight(i, key)
                     local[key] = None
         if not out_rows:
             return None

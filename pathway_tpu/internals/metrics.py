@@ -1,0 +1,124 @@
+"""Prometheus/OpenMetrics scrape endpoint
+(reference: src/engine/http_server.rs:21-130 — per-process metrics server on
+port 20000+process_id exposing connector latencies and input/output stats).
+
+Serves ``GET /metrics`` (and ``/status`` JSON) from a daemon thread; gauges
+and counters are computed at scrape time from the live engine graph, so
+there is no per-tick bookkeeping beyond the rows_in/rows_out/process_ns
+counters the scheduler already maintains.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .config import get_config
+
+__all__ = ["start_metrics_server", "render_metrics", "MetricsServer"]
+
+_started_at = time.time()
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def render_metrics(graph) -> str:
+    """Render the engine graph's state in Prometheus text exposition format."""
+    lines = [
+        "# TYPE pathway_uptime_seconds gauge",
+        f"pathway_uptime_seconds {time.time() - _started_at:.3f}",
+        "# TYPE pathway_operators gauge",
+        f"pathway_operators {len(graph.operators)}",
+        "# TYPE pathway_resident_rows gauge",
+        "# TYPE pathway_operator_rows_in_total counter",
+        "# TYPE pathway_operator_rows_out_total counter",
+        "# TYPE pathway_operator_process_seconds_total counter",
+    ]
+    total_rows = 0
+    for table in graph.tables:
+        total_rows += len(table.store)
+    lines.insert(5, f"pathway_resident_rows {total_rows}")
+    for op in graph.operators:
+        label = f'operator="{_sanitize(op.name)}",id="{op.id}"'
+        lines.append(f"pathway_operator_rows_in_total{{{label}}} {op.rows_in}")
+        lines.append(f"pathway_operator_rows_out_total{{{label}}} {op.rows_out}")
+        lines.append(
+            f"pathway_operator_process_seconds_total{{{label}}} "
+            f"{op.process_ns / 1e9:.6f}"
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+class MetricsServer:
+    def __init__(self, graph, port: Optional[int] = None):
+        cfg = get_config()
+        self.graph = graph
+        self.port = (
+            port
+            if port is not None
+            else cfg.metrics_port + cfg.process_id
+        )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsServer":
+        graph = self.graph
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/metrics"):
+                    body = render_metrics(graph).encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/status"):
+                    body = json.dumps(
+                        {
+                            "operators": len(graph.operators),
+                            "resident_rows": sum(
+                                len(t.store) for t in graph.tables
+                            ),
+                            "uptime_s": time.time() - _started_at,
+                        }
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # pragma: no cover
+                pass
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="pw-metrics"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+
+_server: Optional[MetricsServer] = None
+
+
+def start_metrics_server(graph, port: Optional[int] = None) -> MetricsServer:
+    global _server
+    if _server is not None:
+        _server.stop()
+    _server = MetricsServer(graph, port).start()
+    return _server

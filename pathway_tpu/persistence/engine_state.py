@@ -117,7 +117,11 @@ class SourcePersistence:
 
     def _truncate_log_at(self, seq: int, intact_payloads: List[bytes]) -> None:
         """Rewrite chunk ``seq`` with its intact prefix, drop later chunks,
-        and rewind the chunk counter so new flushes continue from here."""
+        rewind the chunk counter AND the saved source offsets so the next run
+        re-reads from before the tear (at-least-once: later chunks' events
+        come back from the source instead of being lost — the committed
+        frontier/offsets would otherwise seek past data that no longer
+        exists on disk)."""
         key = f"sources/{self.pid}/chunk-{seq:08d}"
         if intact_payloads:
             self.backend.put(
@@ -138,6 +142,16 @@ class SourcePersistence:
                     continue
                 if s >= self._meta["chunks"]:
                     self.backend.delete(f"sources/{self.pid}/chunk-{s:08d}")
+        # rewind offsets to the snapshot taken at the last surviving chunk
+        chunk_offsets = self._meta.get("chunk_offsets", [])
+        # offsets as of the chunk BEFORE the tear: chunk seq's own snapshot
+        # also covers its lost tail, so it must not be trusted
+        rewind_to = seq - 1
+        self._offsets = (
+            chunk_offsets[rewind_to] if 0 <= rewind_to < len(chunk_offsets) else None
+        )
+        self._meta["offsets"] = self._offsets
+        self._meta["chunk_offsets"] = chunk_offsets[: max(rewind_to + 1, 0)]
         self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
 
     def flush(self, frontier: int) -> None:
@@ -151,6 +165,10 @@ class SourcePersistence:
             )
             self.backend.put(f"sources/{self.pid}/chunk-{seq:08d}", chunk)
             self._meta["chunks"] = seq + 1
+            # per-chunk offsets snapshot: lets corrupt-tail recovery rewind
+            # the source position together with the log
+            chunk_offsets = self._meta.setdefault("chunk_offsets", [])
+            chunk_offsets[seq:] = [offsets]
         self._meta["offsets"] = offsets
         self._meta["frontier"] = frontier
         self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))

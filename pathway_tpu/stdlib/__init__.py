@@ -1,6 +1,6 @@
 """pw.stdlib (reference: python/pathway/stdlib/ — SURVEY.md §2.9)."""
 
-from . import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils
+from . import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils, viz
 
 __all__ = [
     "graphs",
@@ -11,4 +11,5 @@ __all__ = [
     "statistical",
     "temporal",
     "utils",
+    "viz",
 ]

@@ -1,18 +1,31 @@
 """pw.ml (reference: python/pathway/stdlib/ml/ — KNNIndex, LSH, classifiers,
-smart_table_ops).  Populated by the index milestone (index.py, _knn_lsh.py,
-classifiers.py)."""
+smart_table_ops fuzzy joins)."""
 
 from __future__ import annotations
 
-try:
-    from . import index
-    from .index import KNNIndex
-except ImportError:  # pragma: no cover - during incremental build
-    pass
+from . import classifiers, hmm, index, smart_table_ops
+from .hmm import create_hmm_reducer
+from .index import KNNIndex
+from .smart_table_ops import (
+    FuzzyJoinFeatureGeneration,
+    FuzzyJoinNormalization,
+    fuzzy_match,
+    fuzzy_match_tables,
+    fuzzy_self_match,
+    smart_fuzzy_match,
+)
 
-try:
-    from . import classifiers
-except ImportError:  # pragma: no cover
-    pass
-
-__all__ = ["index", "KNNIndex", "classifiers"]
+__all__ = [
+    "index",
+    "KNNIndex",
+    "classifiers",
+    "hmm",
+    "create_hmm_reducer",
+    "smart_table_ops",
+    "FuzzyJoinFeatureGeneration",
+    "FuzzyJoinNormalization",
+    "fuzzy_match",
+    "fuzzy_match_tables",
+    "fuzzy_self_match",
+    "smart_fuzzy_match",
+]

@@ -1,0 +1,102 @@
+"""pw.viz — live table visualization
+(reference: python/pathway/stdlib/viz/ — bokeh/panel streaming plots wired
+to the update stream, plus Table._repr_mimebundle_ for notebooks).
+
+bokeh/panel are not bundled in this image, so the plotting surface is
+gated: ``plot``/``show`` fall back to a text snapshot (and matplotlib for
+``plot`` when available), keeping notebook and script code importable
+either way."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["plot", "show", "table_snapshot"]
+
+
+def table_snapshot(table, limit: int = 20):
+    """Current rows of a table as a list of dicts (post-run)."""
+    keys, cols = table._materialize()
+    names = list(cols)
+    out = []
+    for i, k in enumerate(keys[:limit]):
+        row = {"id": int(k)}
+        row.update({n: cols[n][i] for n in names})
+        out.append(row)
+    return out
+
+
+def show(table, include_id: bool = True, limit: int = 20) -> None:
+    """Print a snapshot of the table (reference: pw.Table.show / viz.show;
+    with panel installed the reference renders a live widget — here a text
+    table, which is what a headless TPU host can always do)."""
+    rows = table_snapshot(table, limit)
+    if not rows:
+        print("<empty table>")
+        return
+    names = [n for n in rows[0] if include_id or n != "id"]
+    widths = {
+        n: max(len(str(n)), *(len(str(r[n])) for r in rows)) for n in names
+    }
+    header = " | ".join(str(n).ljust(widths[n]) for n in names)
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print(" | ".join(str(r[n]).ljust(widths[n]) for n in names))
+
+
+def plot(
+    table,
+    plotting_function: Optional[Callable[..., Any]] = None,
+    *,
+    x: Optional[str] = None,
+    y: Optional[str] = None,
+    sorting_col: Optional[str] = None,
+):
+    """Plot a table column pair (reference: viz.plot wires a bokeh figure to
+    the live update stream).  Uses bokeh when importable, else matplotlib
+    (static snapshot), else raises with guidance."""
+    rows = None
+    try:
+        import bokeh.plotting  # type: ignore  # pragma: no cover - not bundled
+
+        rows = table_snapshot(table, limit=10**6)
+        if sorting_col:
+            rows.sort(key=lambda r: r[sorting_col])
+        fig = bokeh.plotting.figure()
+        if plotting_function is not None:
+            import pandas as pd
+
+            from bokeh.models import ColumnDataSource
+
+            return plotting_function(
+                ColumnDataSource(pd.DataFrame(rows))
+            )
+        names = [n for n in (rows[0] if rows else {}) if n != "id"]
+        xcol = x or (names[0] if names else None)
+        ycol = y or (names[1] if len(names) > 1 else xcol)
+        if rows and xcol is not None:
+            fig.scatter([r[xcol] for r in rows], [r[ycol] for r in rows])
+        return fig
+    except ImportError:
+        pass
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "pw.viz.plot needs bokeh (live) or matplotlib (snapshot); "
+            "neither is installed"
+        ) from e
+    if rows is None:
+        rows = table_snapshot(table, limit=10**6)
+        if sorting_col:
+            rows.sort(key=lambda r: r[sorting_col])
+    names = [n for n in (rows[0] if rows else {}) if n != "id"]
+    xcol = x or (names[0] if names else None)
+    ycol = y or (names[1] if len(names) > 1 else xcol)
+    fig, ax = plt.subplots()
+    if rows and xcol is not None:
+        ax.plot([r[xcol] for r in rows], [r[ycol] for r in rows], marker="o")
+        ax.set_xlabel(xcol)
+        ax.set_ylabel(ycol)
+    return fig

@@ -1,0 +1,145 @@
+"""Fuzzy join tests (reference: smart_table_ops tests)."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml import (
+    FuzzyJoinFeatureGeneration,
+    fuzzy_match_tables,
+    fuzzy_self_match,
+    smart_fuzzy_match,
+)
+
+
+def _run():
+    pw.run(monitoring_level=None)
+
+
+def _rows(table):
+    keys, cols = table._materialize()
+    return [
+        {n: cols[n][i] for n in table.column_names} for i in range(len(keys))
+    ]
+
+
+def _col_by_key(table, col):
+    keys, cols = table._materialize()
+    return {int(k): cols[col][i] for i, k in enumerate(keys)}
+
+
+def test_fuzzy_match_tables_pairs_up_similar_rows():
+    left = pw.Table.from_rows(
+        [
+            {"name": "John Smith", "city": "Warsaw"},
+            {"name": "Alice Jones", "city": "Paris"},
+            {"name": "Bob Unmatched Entirely", "city": "Xyzzy"},
+        ],
+        name="left",
+    )
+    right = pw.Table.from_rows(
+        [
+            {"fullname": "Smith John", "town": "Warsaw"},
+            {"fullname": "Jones Alice", "town": "Paris"},
+        ],
+        name="right",
+    )
+    matches = fuzzy_match_tables(left, right)
+    _run()
+    lnames = _col_by_key(left, "name")
+    rnames = _col_by_key(right, "fullname")
+    got = {
+        (lnames[int(m["left"])], rnames[int(m["right"])]) for m in _rows(matches)
+    }
+    assert ("John Smith", "Smith John") in got
+    assert ("Alice Jones", "Jones Alice") in got
+    # every left appears at most once
+    lefts = [lnames[int(m["left"])] for m in _rows(matches)]
+    assert len(lefts) == len(set(lefts))
+
+
+def test_smart_fuzzy_match_letters():
+    l = pw.Table.from_rows([{"v": "kitten"}, {"v": "zzzzz"}], name="l")
+    r = pw.Table.from_rows([{"v": "sitting"}, {"v": "qqqqq"}], name="r")
+    m = smart_fuzzy_match(
+        l.v, r.v, feature_generation=FuzzyJoinFeatureGeneration.LETTERS
+    )
+    _run()
+    lnames = _col_by_key(l, "v")
+    rnames = _col_by_key(r, "v")
+    got = {(lnames[int(x["left"])], rnames[int(x["right"])]) for x in _rows(m)}
+    assert ("kitten", "sitting") in got  # shared letters i,t,n
+    assert ("zzzzz", "qqqqq") not in got  # nothing shared
+
+
+def test_fuzzy_self_match():
+    t = pw.Table.from_rows(
+        [
+            {"v": "the quick brown fox"},
+            {"v": "the quick brown foxes"},
+            {"v": "completely different words here"},
+        ],
+        name="t",
+    )
+    m = fuzzy_self_match(t.v)
+    _run()
+    names = _col_by_key(t, "v")
+    got = {(names[int(x["left"])], names[int(x["right"])]) for x in _rows(m)}
+    pair = ("the quick brown fox", "the quick brown foxes")
+    assert pair in got or tuple(reversed(pair)) in got
+    for l, r in got:
+        assert l != r
+
+
+def test_fuzzy_match_incremental_update():
+    """A row added after the first run matches live."""
+    left = pw.Table.from_rows([{"name": "aaa bbb"}], name="l2")
+    right = pw.Table.from_rows(
+        [{"name": "aaa bbb"}, {"name": "ccc ddd"}], name="r2"
+    )
+    m = fuzzy_match_tables(left, right)
+    _run()
+    assert len(_rows(m)) == 1
+
+
+def test_hmm_reducer_viterbi():
+    """Two-state HMM: observations force a rain->sun switch."""
+    import networkx as nx
+
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.ml import create_hmm_reducer
+
+    def emission(state):
+        def calc(obs):
+            import math
+            p = 0.9 if obs == state else 0.1
+            return math.log(p)
+        return calc
+
+    g = nx.DiGraph()
+    for s in ("rain", "sun"):
+        g.add_node(s, calc_emission_log_ppb=emission(s))
+    import math
+    for a in ("rain", "sun"):
+        for b in ("rain", "sun"):
+            g.add_edge(a, b, log_transition_ppb=math.log(0.8 if a == b else 0.2))
+    g.graph["start_nodes"] = ["rain", "sun"]
+
+    hmm = create_hmm_reducer(g)
+    t = pw.Table.from_rows(
+        [{"g": 1, "obs": o} for o in ["rain", "rain", "sun", "sun"]], name="obs"
+    )
+    out = t.groupby(pw.this.g).reduce(path=hmm(pw.this.obs))
+    pw.run(monitoring_level=None)
+    keys, cols = out._materialize()
+    assert tuple(cols["path"][0]) == ("rain", "rain", "sun", "sun")
+
+
+def test_viz_show_and_snapshot(capsys):
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib import viz
+
+    t = pw.Table.from_rows([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}], name="vz")
+    pw.run(monitoring_level=None)
+    snap = viz.table_snapshot(t)
+    assert {r["a"] for r in snap} == {1, 2}
+    viz.show(t, include_id=False)
+    out = capsys.readouterr().out
+    assert "a" in out and "x" in out
