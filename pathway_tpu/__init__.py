@@ -136,6 +136,15 @@ def table_transformer(fn=None, **kwargs):
 
 
 from .internals.iterate import iterate, iterate_universe  # noqa: E402
+from .internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 
 
 # Heavy subpackages (flax model zoo, LLM xpack, device kernels) load lazily

@@ -326,9 +326,14 @@ class LatestReducer(EarliestReducer):
 
 
 class StatefulReducer(Reducer):
-    """User combine function folded over the group's multiset
-    (reference: stateful reducers, reduce.rs:StatefulReducer &
-    stateful_reduce.rs).  Retraction-safe because we re-fold on read."""
+    """User combine function folded over the group's multiset IN ARRIVAL
+    ORDER (reference: stateful reducers, reduce.rs:StatefulReducer &
+    stateful_reduce.rs).  Retraction-safe because we re-fold on read.
+
+    Each insertion records a per-group sequence number so interleaved
+    duplicate values keep their positions (order-sensitive folds like the
+    HMM/Viterbi reducer depend on it); a retraction of a value cancels its
+    most recent surviving occurrence."""
 
     name = "stateful"
 
@@ -336,24 +341,35 @@ class StatefulReducer(Reducer):
         self.combine = combine
 
     def init_state(self):
-        return {}
+        return {"n": 0, "items": {}}
 
     def update(self, state, value, diff, key, ts):
+        items = state["items"]
         h = _hashable(value)
-        entry = state.get(h)
+        entry = items.get(h)
         if entry is None:
-            entry = [0, value]
-            state[h] = entry
+            entry = [0, value, []]  # count, value, surviving arrival seqs
+            items[h] = entry
         entry[0] += diff
+        if diff > 0:
+            entry[2].append(state["n"])
+            state["n"] += 1
+        elif entry[2]:
+            entry[2].pop()
         # == 0, not <= 0: within one consolidated batch a retraction may be
         # processed before its matching insertion; negative counts must
         # persist so the insertion can cancel them
         if entry[0] == 0:
-            del state[h]
+            del items[h]
         return state
 
     def result(self, state):
-        rows: List[Any] = []
-        for count, value in state.values():
-            rows.extend([value] * max(count, 0))
-        return self.combine(None, rows) if rows else None
+        ordered: List[Tuple[int, Any]] = []
+        for count, value, seqs in state["items"].values():
+            n = min(max(count, 0), len(seqs))
+            for s in seqs[-n:] if n else []:
+                ordered.append((s, value))
+        if not ordered:
+            return None
+        ordered.sort(key=lambda p: p[0])
+        return self.combine(None, [v for _, v in ordered])

@@ -56,6 +56,8 @@ def run(
                     t.producer is None or t.producer.id in G.ran_ops
                 ) and len(t.store):
                     bootstrap.append((op, port, t.store.to_delta()))
+    if persistence_config is None:
+        persistence_config = _persistence_config_from_env()
     G.ran = True
     executor = Executor(G.engine_graph, commit_duration_ms)
     with _executor_lock:
@@ -90,13 +92,23 @@ def run(
             start_metrics_server(G.engine_graph)
         except Exception:
             pass
+    from .telemetry import maybe_telemetry
+
+    telemetry = maybe_telemetry()
+    telemetry.attach(G.engine_graph)
     for hook in G.pre_run_hooks[G.hooks_started :]:
         hook()
     G.hooks_started = len(G.pre_run_hooks)
     try:
-        executor.run(bootstrap=bootstrap)
+        with telemetry.span(
+            "pathway.run",
+            operators=len(G.engine_graph.operators),
+            tables=len(G.engine_graph.tables),
+        ):
+            executor.run(bootstrap=bootstrap)
         G.ran_ops.update(op.id for op in G.engine_graph.operators)
     finally:
+        telemetry.shutdown()
         if manager is not None:
             try:
                 manager.finalize(executor.current_ts)
@@ -114,6 +126,36 @@ def run(
                 pass
         with _executor_lock:
             _current_executor = None
+
+
+def _persistence_config_from_env():
+    """PATHWAY_PERSISTENT_STORAGE / PATHWAY_PERSISTENCE_MODE — set by
+    ``pathway-tpu spawn --record`` / ``replay`` (reference: env-first
+    PathwayConfig, internals/config.py:58-80)."""
+    from .config import get_config
+
+    cfg = get_config()
+    if not cfg.persistent_storage:
+        return None
+    from .. import persistence as pp
+
+    mode = pp.PersistenceMode.PERSISTING
+    raw = (cfg.persistence_mode or "").strip().lower()
+    if raw:
+        aliases = {
+            "batch": pp.PersistenceMode.BATCH,
+            "speedrun": pp.PersistenceMode.SPEEDRUN_REPLAY,
+            "speedrun_replay": pp.PersistenceMode.SPEEDRUN_REPLAY,
+            "realtime_replay": pp.PersistenceMode.REALTIME_REPLAY,
+            "persisting": pp.PersistenceMode.PERSISTING,
+            "operator_persisting": pp.PersistenceMode.OPERATOR_PERSISTING,
+        }
+        mode = aliases.get(raw, pp.PersistenceMode.PERSISTING)
+    return pp.Config.simple_config(
+        pp.Backend.filesystem(cfg.persistent_storage),
+        persistence_mode=mode,
+        snapshot_interval_ms=cfg.snapshot_interval_ms,
+    )
 
 
 def run_all(**kwargs) -> None:

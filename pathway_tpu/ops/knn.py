@@ -170,6 +170,34 @@ class DeviceKnnIndex:
                 self.slot_to_key[slot] = int(key)
             self._scatter(slots, vectors, True)
 
+    def add_from_device(self, keys: Sequence[int], vectors) -> None:
+        """Ingest vectors that already live on device (e.g. encoder output) —
+        no host round trip of the matrix rows; only the per-row norms (for
+        l2sq ranking) come back, as one small async fetch."""
+        with self._lock:
+            if len(keys) == 0:
+                return
+            vectors = vectors.reshape(len(keys), self.dimension)
+            existing = [k for k in keys if int(k) in self.key_to_slot]
+            if existing:
+                self.remove(existing)
+            if len(self._free) < len(keys):
+                self._grow(len(keys) - len(self._free))
+            slots = np.array([self._free.pop() for _ in keys], dtype=np.int32)
+            norms_dev = jnp.linalg.norm(vectors.astype(jnp.float32), axis=1)
+            if self.metric == "cos":
+                safe = jnp.where(norms_dev == 0, 1.0, norms_dev)
+                vectors = (vectors.astype(jnp.float32) / safe[:, None]).astype(
+                    self.dtype
+                )
+            if hasattr(norms_dev, "copy_to_host_async"):
+                norms_dev.copy_to_host_async()
+            for key, slot in zip(keys, slots):
+                self.key_to_slot[int(key)] = int(slot)
+                self.slot_to_key[slot] = int(key)
+            self._scatter(slots, vectors, True)
+            self._norms[slots] = np.asarray(norms_dev)
+
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:
             slots = []
@@ -183,15 +211,20 @@ class DeviceKnnIndex:
             slots = np.array(slots, dtype=np.int32)
             self._scatter(slots, np.zeros((len(slots), self.dimension), np.float32), False)
 
-    def _scatter(self, slots: np.ndarray, vectors: np.ndarray, valid: bool) -> None:
+    def _scatter(self, slots: np.ndarray, vectors, valid: bool) -> None:
         """Batched scatter, padded to a bucket to bound recompiles (pad rows
-        repeat the first row — idempotent writes)."""
+        repeat the first row — idempotent writes).  ``vectors`` may be a host
+        numpy array or a device array (add_from_device path)."""
         n = len(slots)
         b = _bucket(n)
+        on_device = isinstance(vectors, jax.Array)
         if b > n:
             slots = np.concatenate([slots, np.full(b - n, slots[0], np.int32)])
-            vectors = np.concatenate([vectors, np.repeat(vectors[:1], b - n, 0)])
-        self._matrix = _scatter_rows(self._matrix, jnp.asarray(slots), jnp.asarray(vectors, dtype=self.dtype))
+            xp = jnp if on_device else np
+            vectors = xp.concatenate([vectors, xp.repeat(vectors[:1], b - n, 0)])
+        if not on_device:
+            vectors = jnp.asarray(vectors, dtype=self.dtype)
+        self._matrix = _scatter_rows(self._matrix, jnp.asarray(slots), vectors)
         self._valid = _scatter_flags(self._valid, jnp.asarray(slots), valid)
         if self.mesh is not None:
             self._matrix = jax.device_put(self._matrix, self._sharding(True))
@@ -224,6 +257,11 @@ class DeviceKnnIndex:
                 )
             q = jnp.asarray(queries, dtype=self.dtype)
             scores, idx = self._run_search(q, k_eff)
+            # overlap the two d2h copies (each sync fetch costs a full RTT on
+            # tunneled TPUs — see ops/serving.py)
+            for a in (scores, idx):
+                if hasattr(a, "copy_to_host_async"):
+                    a.copy_to_host_async()
             scores = np.asarray(scores)[:nq]
             idx = np.asarray(idx)[:nq]
             out: List[List[Tuple[int, float]]] = []

@@ -142,16 +142,18 @@ class SourcePersistence:
                     continue
                 if s >= self._meta["chunks"]:
                     self.backend.delete(f"sources/{self.pid}/chunk-{s:08d}")
-        # rewind offsets to the snapshot taken at the last surviving chunk
-        chunk_offsets = self._meta.get("chunk_offsets", [])
-        # offsets as of the chunk BEFORE the tear: chunk seq's own snapshot
-        # also covers its lost tail, so it must not be trusted
+        # rewind offsets to the snapshot taken at the last surviving chunk;
+        # keyed by seq (not list position) so truncation never desynchronizes
+        # the mapping.  chunk seq's own snapshot also covers its lost tail,
+        # so the chunk BEFORE the tear is the newest trustworthy position;
+        # a missing entry (legacy metadata) degrades to None = re-read all.
+        chunk_offsets = dict(self._meta.get("chunk_offsets") or {})
         rewind_to = seq - 1
-        self._offsets = (
-            chunk_offsets[rewind_to] if 0 <= rewind_to < len(chunk_offsets) else None
-        )
+        self._offsets = chunk_offsets.get(rewind_to)
         self._meta["offsets"] = self._offsets
-        self._meta["chunk_offsets"] = chunk_offsets[: max(rewind_to + 1, 0)]
+        self._meta["chunk_offsets"] = {
+            s: o for s, o in chunk_offsets.items() if s <= rewind_to
+        }
         self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
 
     def flush(self, frontier: int) -> None:
@@ -165,10 +167,13 @@ class SourcePersistence:
             )
             self.backend.put(f"sources/{self.pid}/chunk-{seq:08d}", chunk)
             self._meta["chunks"] = seq + 1
-            # per-chunk offsets snapshot: lets corrupt-tail recovery rewind
-            # the source position together with the log
-            chunk_offsets = self._meta.setdefault("chunk_offsets", [])
-            chunk_offsets[seq:] = [offsets]
+            # per-chunk offsets snapshot (keyed by seq): lets corrupt-tail
+            # recovery rewind the source position together with the log
+            chunk_offsets = self._meta.get("chunk_offsets")
+            if not isinstance(chunk_offsets, dict):
+                chunk_offsets = {}
+                self._meta["chunk_offsets"] = chunk_offsets
+            chunk_offsets[seq] = offsets
         self._meta["offsets"] = offsets
         self._meta["frontier"] = frontier
         self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))

@@ -60,26 +60,28 @@ def plot(
     try:
         import bokeh.plotting  # type: ignore  # pragma: no cover - not bundled
 
+        have_bokeh = True
+    except ImportError:
+        have_bokeh = False
+    if have_bokeh:  # pragma: no cover - bokeh not bundled in this image
         rows = table_snapshot(table, limit=10**6)
         if sorting_col:
             rows.sort(key=lambda r: r[sorting_col])
-        fig = bokeh.plotting.figure()
         if plotting_function is not None:
+            # errors here (e.g. pandas missing) must surface — silently
+            # dropping the user's plotting_function would be worse
             import pandas as pd
 
             from bokeh.models import ColumnDataSource
 
-            return plotting_function(
-                ColumnDataSource(pd.DataFrame(rows))
-            )
+            return plotting_function(ColumnDataSource(pd.DataFrame(rows)))
+        fig = bokeh.plotting.figure()
         names = [n for n in (rows[0] if rows else {}) if n != "id"]
         xcol = x or (names[0] if names else None)
         ycol = y or (names[1] if len(names) > 1 else xcol)
         if rows and xcol is not None:
             fig.scatter([r[xcol] for r in rows], [r[ycol] for r in rows])
         return fig
-    except ImportError:
-        pass
     try:
         import matplotlib.pyplot as plt
     except ImportError as e:  # pragma: no cover

@@ -143,3 +143,24 @@ def test_viz_show_and_snapshot(capsys):
     viz.show(t, include_id=False)
     out = capsys.readouterr().out
     assert "a" in out and "x" in out
+
+
+def test_stateful_reducer_preserves_interleaved_order():
+    """Order-sensitive folds see observations in arrival order even with
+    interleaved duplicate values."""
+    import pathway_tpu as pw
+
+    seen = {}
+
+    @pw.reducers.stateful_many
+    def record_order(state, rows):
+        return tuple(r[0] for r in rows)
+
+    t = pw.Table.from_rows(
+        [{"g": 1, "v": v} for v in ["sun", "rain", "sun", "fog", "rain"]],
+        name="obs_order",
+    )
+    out = t.groupby(pw.this.g).reduce(seq=record_order(pw.this.v))
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert tuple(cols["seq"][0]) == ("sun", "rain", "sun", "fog", "rain")
