@@ -162,6 +162,12 @@ def __getattr__(name: str):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-# Type aliases exposed like reference pw.*
+# Type aliases exposed like reference pw.* (DateTime*/Duration are plain
+# datetime types — engine columns hold them natively, dtype.py:107-109)
+import datetime as _datetime  # noqa: E402
+
 Json = dt.JSON
 Pointer_ = Pointer
+DateTimeNaive = _datetime.datetime
+DateTimeUtc = _datetime.datetime
+Duration = _datetime.timedelta

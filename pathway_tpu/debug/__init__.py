@@ -27,6 +27,7 @@ __all__ = [
     "compute_and_print",
     "compute_and_print_update_stream",
     "parse_to_table",
+    "StreamGenerator",
 ]
 
 
@@ -60,14 +61,8 @@ def _parse_value(text: str) -> Any:
     return text
 
 
-def table_from_markdown(
-    txt: str,
-    *,
-    schema: Optional[Type[Schema]] = None,
-    unsafe_trusted_ids: bool = False,
-    **kwargs,
-) -> Table:
-    """Parse a markdown-ish table (reference: debug/__init__.py:429).
+def _parse_markdown_rows(txt: str) -> Tuple[List[Dict[str, Any]], Optional[List[int]]]:
+    """Shared markdown grammar: returns (rows, explicit_keys_or_None).
 
     First unnamed column (before the first ``|``) is the row id if present."""
     lines = [l for l in txt.strip().splitlines() if l.strip()]
@@ -92,6 +87,18 @@ def table_from_markdown(
             vals.append(None)
         rows.append(dict(zip(col_names, vals)))
     keys = explicit_keys if has_id and len(explicit_keys) == len(rows) else None
+    return rows, keys
+
+
+def table_from_markdown(
+    txt: str,
+    *,
+    schema: Optional[Type[Schema]] = None,
+    unsafe_trusted_ids: bool = False,
+    **kwargs,
+) -> Table:
+    """Parse a markdown-ish table (reference: debug/__init__.py:429)."""
+    rows, keys = _parse_markdown_rows(txt)
     return Table.from_rows(rows, schema, keys=keys, name="markdown")
 
 
@@ -168,6 +175,103 @@ def compute_and_print(
     print(" | ".join(h.ljust(w) for h, w in zip(header, widths)))
     for r in rows:
         print(" | ".join(v.ljust(w) for v, w in zip(r, widths)))
+
+
+class StreamGenerator:
+    """Builds artificial streams with controlled batch boundaries for tests
+    (reference: debug/__init__.py:496 StreamGenerator — snapshot-event
+    replay per worker).  The TPU engine is single-host SPMD, so per-worker
+    splits collapse into batch order; each batch is sealed atomically
+    (``subject.commit()`` → InputSession.mark_batch) and gets its own commit
+    tick structurally — no timing dependence."""
+
+    def table_from_list_of_batches(
+        self, batches: Sequence[Sequence[Mapping[str, Any]]], schema: Type[Schema]
+    ) -> Table:
+        from ..io.python import ConnectorSubject, read
+
+        class _Gen(ConnectorSubject):
+            def run(self) -> None:
+                for batch in batches:
+                    for row in batch:
+                        self.next(**row)
+                    self.commit()
+
+        return read(
+            _Gen(),
+            schema=schema,
+            name="debug.stream-generator",
+            atomic_batches=True,
+        )
+
+    def table_from_list_of_batches_by_workers(
+        self,
+        batches: Sequence[Mapping[int, Sequence[Mapping[str, Any]]]],
+        schema: Type[Schema],
+    ) -> Table:
+        flattened = [
+            [row for worker in sorted(batch) for row in batch[worker]]
+            for batch in batches
+        ]
+        return self.table_from_list_of_batches(flattened, schema)
+
+    def table_from_pandas(
+        self, df, *, schema: Optional[Type[Schema]] = None, **kwargs
+    ) -> Table:
+        """``_time`` column splits rows into batches; ``_diff`` of -1 emits a
+        deletion; ``_worker`` is accepted and ignored (single-host)."""
+        from ..io.python import ConnectorSubject, read
+
+        records = df.to_dict("records")
+        value_cols = [
+            c for c in df.columns if c not in ("_time", "_diff", "_worker")
+        ]
+        if schema is None:
+            sample = records[0] if records else {}
+            schema = schema_from_types(
+                **{c: type(sample.get(c, "")) for c in value_cols}
+            )
+        def time_of(rec) -> int:
+            t = rec.get("_time", 2)
+            try:
+                import math
+
+                if t is None or (isinstance(t, float) and math.isnan(t)):
+                    return 2
+            except TypeError:
+                pass
+            return int(t)
+
+        by_time: Dict[int, List[Mapping[str, Any]]] = {}
+        for rec in records:
+            by_time.setdefault(time_of(rec), []).append(rec)
+
+        class _Gen(ConnectorSubject):
+            def run(self) -> None:
+                for t in sorted(by_time):
+                    for rec in by_time[t]:
+                        values = {c: rec[c] for c in value_cols}
+                        if int(rec.get("_diff", 1)) >= 0:
+                            self.next(**values)
+                        else:
+                            self.delete(**values)
+                    self.commit()
+
+        return read(
+            _Gen(),
+            schema=schema,
+            name="debug.stream-generator",
+            atomic_batches=True,
+        )
+
+    def table_from_markdown(self, table: str, **kwargs) -> Table:
+        """Markdown rows with optional ``_time``/``_diff`` columns become a
+        stream with those batch boundaries (same grammar as the module-level
+        ``table_from_markdown``)."""
+        import pandas as pd
+
+        rows, _keys = _parse_markdown_rows(table)
+        return self.table_from_pandas(pd.DataFrame(rows), **kwargs)
 
 
 def compute_and_print_update_stream(table: Table, **kwargs) -> None:

@@ -25,15 +25,26 @@ _INSERT = 0
 _REMOVE = 1
 _UPSERT = 2
 _DELETE_BY_KEY = 3
+_BATCH_MARK = 4
 
 
 class InputSession:
-    """Thread-safe buffer of input events pushed by connector threads."""
+    """Thread-safe buffer of input events pushed by connector threads.
 
-    def __init__(self, upsert: bool = False):
+    ``mark_batch()`` seals the events pushed so far into an atomic batch:
+    each drain returns at most one sealed batch, so marked batches land at
+    distinct commit ticks REGARDLESS of thread/scheduler timing (the
+    structural analog of the reference's per-commit timestamp advancement,
+    src/connectors/mod.rs commit_duration ticks)."""
+
+    def __init__(self, upsert: bool = False, atomic_batches: bool = False):
         self._lock = threading.Lock()
         self._events: List[Tuple[int, int, Optional[Tuple[Any, ...]]]] = []
+        self._since_mark = 0
         self.upsert = upsert
+        # atomic mode: unsealed rows are invisible to drains until
+        # mark_batch() (or close) — a mid-batch poll can never split a batch
+        self.atomic_batches = atomic_batches
         self.finished = False
         # persistence hook: called with each raw event as it is appended
         # (persistence/engine_state.py SourcePersistence.record); replayed
@@ -44,6 +55,7 @@ class InputSession:
         event = (_UPSERT if self.upsert else _INSERT, key, row)
         with self._lock:
             self._events.append(event)
+            self._since_mark += 1
         if self.recorder is not None:
             self.recorder(event)
 
@@ -51,6 +63,21 @@ class InputSession:
         event = (_DELETE_BY_KEY if row is None else _REMOVE, key, row)
         with self._lock:
             self._events.append(event)
+            self._since_mark += 1
+        if self.recorder is not None:
+            self.recorder(event)
+
+    def mark_batch(self) -> None:
+        """Seal events pushed since the previous marker into one batch."""
+        event = (_BATCH_MARK, 0, None)
+        with self._lock:
+            if not self._since_mark:
+                return
+            self._events.append(event)
+            self._since_mark = 0
+        # markers persist with the event log so replayed atomic sources
+        # reproduce their batch boundaries (and drain at all — an atomic
+        # session never releases unsealed rows)
         if self.recorder is not None:
             self.recorder(event)
 
@@ -59,18 +86,37 @@ class InputSession:
             self.finished = True
 
     def drain(self) -> List[Tuple[int, int, Optional[Tuple[Any, ...]]]]:
+        """Take the next sealed batch, or (non-atomic / finished) the
+        unsealed tail."""
         with self._lock:
+            for i, (kind, _k, _r) in enumerate(self._events):
+                if kind == _BATCH_MARK:
+                    events = self._events[:i]
+                    self._events = self._events[i + 1 :]
+                    return events
+            if self.atomic_batches and not self.finished:
+                return []
             events, self._events = self._events, []
+            self._since_mark = 0
             return events
 
     def push_raw(self, events: List[Tuple[int, int, Optional[Tuple[Any, ...]]]]) -> None:
         """Inject raw events verbatim (persistence replay path)."""
         with self._lock:
             self._events.extend(events)
+            # count the unsealed tail so a later mark_batch() can seal it
+            self._since_mark = 0
+            for kind, _k, _r in self._events:
+                if kind == _BATCH_MARK:
+                    self._since_mark = 0
+                else:
+                    self._since_mark += 1
 
     @property
     def has_pending(self) -> bool:
         with self._lock:
+            if self.atomic_batches and not self.finished:
+                return any(kind == _BATCH_MARK for kind, _k, _r in self._events)
             return bool(self._events)
 
 

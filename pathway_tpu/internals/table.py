@@ -889,6 +889,15 @@ class _EngineIdExpr(ColumnExpression):
         return ctx.keys
 
 
+class _ConstKeyExpr(ColumnExpression):
+    """Internal: a constant join key for every row — ``join()`` with no
+    conditions is a cross join (reference join semantics), so both sides
+    land in one bucket."""
+
+    def _eval(self, ctx):
+        return np.zeros(len(ctx.keys), dtype=np.uint64)
+
+
 class JoinResult:
     """Result of table.join(...) pending a select
     (reference: internals/joins.py:1422)."""
@@ -955,8 +964,8 @@ class JoinResult:
             left._engine_table,
             right._engine_table,
             et,
-            left_key_exprs=left_exprs or [_EngineIdExpr()],
-            right_key_exprs=right_exprs or [_EngineIdExpr()],
+            left_key_exprs=left_exprs or [_ConstKeyExpr()],
+            right_key_exprs=right_exprs or [_ConstKeyExpr()],
             left_ctx_cols=left._ctx_cols(placeholders=[left_placeholder, this_placeholder]),
             right_ctx_cols=right._ctx_cols(placeholders=[right_placeholder]),
             kind=mode,

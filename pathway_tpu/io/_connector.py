@@ -50,6 +50,7 @@ class SessionWriter:
         primary_key: Optional[Sequence[str]],
         dtypes: Mapping[str, dt.DType],
         salt: int = 0,
+        track_value_deletions: bool = False,
     ):
         self.session = session
         self.column_names = list(column_names)
@@ -58,6 +59,14 @@ class SessionWriter:
         self._counter = 0
         self._salt = salt
         self._lock = threading.Lock()
+        # Without a primary key, deletions identify rows BY VALUE.  Keys are
+        # DERIVED as hash(row-value-hash, occurrence-index), so matching a
+        # deletion to its insert needs only a per-value LIVE COUNT — memory
+        # is bounded by live distinct values (which the engine stores
+        # anyway), not by ingest history, and keys are deterministic across
+        # replays.  remove() cancels the most recent occurrence (LIFO).
+        self.track_value_deletions = bool(track_value_deletions) and not self.primary_key
+        self._live_counts: Dict[int, int] = {}
         # set by the PersistenceManager when a persistence config is active
         # (persistence/engine_state.py SourcePersistence)
         self.persistence = None
@@ -70,17 +79,49 @@ class SessionWriter:
             self._counter += 1
         return int(sequential_keys(i, 1, salt=self._salt)[0])
 
+    def _value_id(self, row: tuple) -> int:
+        return int(ref_scalar(*row))
+
     def insert(self, values: Mapping[str, Any], key: Optional[int] = None) -> None:
         values = coerce_row_types(values, self.dtypes)
-        if key is None:
-            key = self.key_of(values)
         row = tuple(values.get(c) for c in self.column_names)
+        if key is None:
+            if self.track_value_deletions:
+                vid = self._value_id(row)
+                with self._lock:
+                    n = self._live_counts.get(vid, 0)
+                    self._live_counts[vid] = n + 1
+                key = int(ref_scalar(np.uint64(vid), n))
+            else:
+                key = self.key_of(values)
         self.session.insert(key, row)
 
     def remove(self, values: Mapping[str, Any], key: Optional[int] = None) -> None:
         values = coerce_row_types(values, self.dtypes)
         if key is None:
-            key = self.key_of(values)
+            if self.primary_key:
+                key = self.key_of(values)
+            elif self.track_value_deletions:
+                row = tuple(values.get(c) for c in self.column_names)
+                vid = self._value_id(row)
+                with self._lock:
+                    n = self._live_counts.get(vid, 0)
+                    if n == 0:
+                        raise KeyError(
+                            f"remove: no live row matches {values!r} "
+                            "(schema has no primary key; deletions match "
+                            "previously inserted values)"
+                        )
+                    if n == 1:
+                        del self._live_counts[vid]
+                    else:
+                        self._live_counts[vid] = n - 1
+                key = int(ref_scalar(np.uint64(vid), n - 1))
+            else:
+                raise KeyError(
+                    "remove: source does not track value deletions and the "
+                    "schema has no primary key"
+                )
         self.session.remove(key)
 
     def close(self) -> None:
@@ -121,6 +162,8 @@ def register_source(
     upsert: bool = False,
     name: str = "source",
     persistent_id: Optional[str] = None,
+    track_value_deletions: bool = False,
+    atomic_batches: bool = False,
 ) -> Table:
     """Create the engine source + api table and schedule ``runner`` to feed it.
 
@@ -131,9 +174,17 @@ def register_source(
     dtypes = schema.typehints()
     _source_counter[0] += 1
     salt = _source_counter[0]
-    session = InputSession(upsert=upsert or schema.primary_key_columns() is not None)
+    session = InputSession(
+        upsert=upsert or schema.primary_key_columns() is not None,
+        atomic_batches=atomic_batches,
+    )
     writer = SessionWriter(
-        session, column_names, schema.primary_key_columns(), dtypes, salt=salt
+        session,
+        column_names,
+        schema.primary_key_columns(),
+        dtypes,
+        salt=salt,
+        track_value_deletions=track_value_deletions,
     )
     et = G.engine_graph.add_table(column_names, name)
     op = G.engine_graph.add_operator(

@@ -114,7 +114,12 @@ def read(
                 _time.sleep(0.2)
 
         return register_source(
-            schema, runner, mode=mode, name=name, persistent_id=persistent_id
+            schema,
+            runner,
+            mode=mode,
+            name=name,
+            persistent_id=persistent_id,
+            track_value_deletions=True,  # CDC update/delete envelopes
         )
 
     if topic_name is None:
@@ -127,5 +132,10 @@ def read(
             apply_message(writer, raw)
 
     return register_source(
-        schema, runner, mode="streaming", name=name, persistent_id=persistent_id
+        schema,
+        runner,
+        mode="streaming",
+        name=name,
+        persistent_id=persistent_id,
+        track_value_deletions=True,  # CDC update/delete envelopes
     )

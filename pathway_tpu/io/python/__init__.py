@@ -50,7 +50,10 @@ class ConnectorSubject:
         self._writer.remove(kwargs)
 
     def commit(self) -> None:
-        pass
+        """Seal rows pushed so far into one atomic batch with its own commit
+        tick (InputSession.mark_batch)."""
+        if self._writer is not None:
+            self._writer.session.mark_batch()
 
     def close(self) -> None:
         pass
@@ -68,10 +71,22 @@ def read(
     schema: Type[Schema],
     autocommit_duration_ms: int = 100,
     name: str = "python",
+    atomic_batches: bool = False,
     **kwargs,
 ) -> Table:
+    """``autocommit_duration_ms`` is accepted for reference parity; batch
+    boundaries are structural here — ``subject.commit()`` seals a batch and
+    the engine assigns it its own commit tick (InputSession.mark_batch)."""
+
     def runner(writer: SessionWriter):
         subject._writer = writer
         subject.start()
 
-    return register_source(schema, runner, mode="streaming", name=name)
+    return register_source(
+        schema,
+        runner,
+        mode="streaming",
+        name=name,
+        track_value_deletions=True,
+        atomic_batches=atomic_batches,
+    )

@@ -696,3 +696,7 @@ def window_join_inner(left, right, left_time, right_time, window, *on):
 
 def window_join_left(left, right, left_time, right_time, window, *on):
     return WindowJoinResult(left, right, left_time, right_time, window, on, JoinMode.LEFT)
+
+
+from . import time_utils  # noqa: E402
+from .time_utils import inactivity_detection, utc_now  # noqa: E402

@@ -170,3 +170,76 @@ def test_corrupt_chunk_rewinds_log_for_future_flushes():
     sp3 = SourcePersistence(backend, "pid")
     events = sp3.replay_events()
     assert events == [(1, 1, ("a",)), (3, 1, ("c",))]
+
+
+def test_atomic_batch_source_replays_with_markers():
+    """Batch markers persist with the event log, so an atomic source replays
+    (and preserves batch boundaries) instead of never draining."""
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        v: int
+
+    backend = pw.persistence.Backend.mock()
+
+    def build():
+        class Subj(pw.io.python.ConnectorSubject):
+            def run(self):
+                self.next(v=1)
+                self.next(v=2)
+                self.commit()
+                self.next(v=3)
+                self.commit()
+
+        t = pw.io.python.read(
+            Subj(), schema=S, atomic_batches=True
+        )
+        # persistent_id set at the source operator level
+        for op in pw.G.engine_graph.operators:
+            if getattr(op, "writer", None) is not None:
+                op.persistent_id = "atomic1"
+        events = []
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: events.append(
+                (time, row["v"])
+            ),
+        )
+        pw.run(
+            persistence_config=pw.persistence.Config.simple_config(
+                backend, snapshot_interval_ms=1
+            )
+        )
+        return events
+
+    e1 = build()
+    assert sorted(v for _, v in e1) == [1, 2, 3]
+
+    pw.reset()
+
+    # second run: replace the subject with an empty one; rows must replay
+    def build_replay():
+        class Empty(pw.io.python.ConnectorSubject):
+            def run(self):
+                pass
+
+        t = pw.io.python.read(Empty(), schema=S, atomic_batches=True)
+        for op in pw.G.engine_graph.operators:
+            if getattr(op, "writer", None) is not None:
+                op.persistent_id = "atomic1"
+        events = []
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: events.append(
+                (time, row["v"])
+            ),
+        )
+        pw.run(
+            persistence_config=pw.persistence.Config.simple_config(
+                backend, snapshot_interval_ms=1
+            )
+        )
+        return events
+
+    e2 = build_replay()
+    assert sorted(v for _, v in e2) == [1, 2, 3]

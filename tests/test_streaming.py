@@ -215,3 +215,110 @@ def test_streaming_equals_batch_randomized():
     # column order is alphabetical: k, mx, s, vs
     got_norm = sorted((r[0], r[2], r[1], r[3]) for r in got)
     assert got_norm == exp_rows
+
+
+def test_stream_generator_batches():
+    """StreamGenerator batches land at distinct engine timestamps."""
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        v: int
+
+    gen = pw.debug.StreamGenerator()
+    t = gen.table_from_list_of_batches([[{"v": 1}, {"v": 2}], [{"v": 3}]], S)
+    events = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: events.append((time, row["v"]))
+    )
+    pw.run(monitoring_level=None)
+    assert sorted(v for _, v in events) == [1, 2, 3]
+    t_of = {v: ts for ts, v in events}
+    assert t_of[1] == t_of[2], "same batch must share a timestamp"
+    assert t_of[3] > t_of[1], "later batch must have a later timestamp"
+
+
+def test_stream_generator_from_pandas_with_diff():
+    import pandas as pd
+
+    import pathway_tpu as pw
+
+    df = pd.DataFrame(
+        [
+            {"k": "a", "v": 1, "_time": 2, "_diff": 1},
+            {"k": "a", "v": 1, "_time": 4, "_diff": -1},
+            {"k": "b", "v": 9, "_time": 4, "_diff": 1},
+        ]
+    )
+    gen = pw.debug.StreamGenerator()
+    t = gen.table_from_pandas(df)
+    pw.run(monitoring_level=None)
+    keys, cols = t._materialize()
+    assert [int(x) for x in cols["v"]] == [9]
+
+
+def test_inactivity_detection_with_injected_clock():
+    """Deterministic: events and clock driven by manual sessions, one
+    executor step per logical instant — no thread timing involved."""
+    import datetime
+
+    import numpy as np
+
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.temporal import inactivity_detection
+
+    base = datetime.datetime(2026, 1, 1)
+
+    events, esession = make_stream_table(t=datetime.datetime)
+    clock, csession = make_stream_table(timestamp_utc=datetime.datetime)
+    inact, resumed = inactivity_detection(
+        events.t,
+        allowed_inactivity_period=datetime.timedelta(seconds=30),
+        _now_table=clock,
+    )
+    ex = make_executor()
+
+    def at(seconds):
+        return base + datetime.timedelta(seconds=seconds)
+
+    esession.insert(int(ref_scalar(1)), (at(0),))
+    esession.insert(int(ref_scalar(2)), (at(5),))
+    ex.step()
+    csession.insert(int(ref_scalar(100)), (at(65),))  # 60s of silence
+    ex.step()
+    esession.insert(int(ref_scalar(3)), (at(120),))   # activity resumes
+    ex.step()
+    csession.insert(int(ref_scalar(101)), (at(125),))
+    ex.step()
+
+    def as64(dt_):
+        return np.datetime64(dt_)
+
+    _, icols = inact._materialize()
+    assert len(icols["inactive_t"]) >= 1
+    assert as64(at(5)) in list(icols["inactive_t"])
+    _, rcols = resumed._materialize()
+    assert as64(at(120)) in list(rcols["resumed_t"])
+
+
+def test_stream_generator_markdown_and_commit_batches():
+    """Markdown _time batches are atomic and get distinct ticks even with a
+    slow executor cadence (structural batch markers, not timing)."""
+    import pathway_tpu as pw
+
+    gen = pw.debug.StreamGenerator()
+    t = gen.table_from_markdown(
+        """
+        | v | _time
+        | 1 | 2
+        | 2 | 2
+        | 3 | 4
+        """
+    )
+    events = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: events.append((time, row["v"]))
+    )
+    pw.run(monitoring_level=None, commit_duration_ms=400)
+    t_of = {v: ts for ts, v in events}
+    assert t_of[1] == t_of[2]
+    assert t_of[3] > t_of[1]
