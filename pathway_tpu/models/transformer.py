@@ -38,6 +38,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     pool: str = "mean"  # mean | cls | none
     causal: bool = False
+    # long-context: shard the sequence dim over this mesh axis and attend
+    # via ring attention (ops/ring_attention.py) — O(L/n) activation memory
+    # per device, K/V rotated over ICI neighbor links
+    mesh: Any = None
+    sequence_axis: Optional[str] = None
 
 
 class MlpBlock(nn.Module):
@@ -88,6 +93,21 @@ class SelfAttention(nn.Module):
         q = q.reshape(B, L, cfg.n_heads, head_dim)
         k = k.reshape(B, L, cfg.n_heads, head_dim)
         v = v.reshape(B, L, cfg.n_heads, head_dim)
+        if cfg.sequence_axis is not None and cfg.mesh is not None:
+            from ..ops.ring_attention import ring_attention_sharded
+
+            positions = jnp.broadcast_to(jnp.arange(L)[None, :], (B, L))
+            out = ring_attention_sharded(
+                cfg.mesh,
+                q,
+                k,
+                v,
+                mask.astype(bool),
+                positions,
+                axis=cfg.sequence_axis,
+                causal=cfg.causal,
+            ).reshape(B, L, cfg.d_model)
+            return proj("out", ("heads", "embed"))(out)
         scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(head_dim)
         big_neg = jnp.finfo(jnp.float32).min
         attn_mask = mask[:, None, None, :]  # [B,1,1,L] key mask
