@@ -56,16 +56,35 @@ class InputSession:
         with self._lock:
             self._events.append(event)
             self._since_mark += 1
-        if self.recorder is not None:
-            self.recorder(event)
+            # record under the lock: with concurrent producers the persisted
+            # event order must match the in-memory order, or upsert replay
+            # could resolve a key to a different last-writer
+            if self.recorder is not None:
+                self.recorder(event)
+
+    def insert_batch(self, keys, rows) -> None:
+        """Bulk insert: one lock acquisition and one list extend for the whole
+        batch (connector readers hand over rows thousands at a time; per-row
+        ``insert`` calls would serialize on the lock)."""
+        kind = _UPSERT if self.upsert else _INSERT
+        events = [(kind, int(k), tuple(r)) for k, r in zip(keys, rows)]
+        with self._lock:
+            self._events.extend(events)
+            self._since_mark += len(events)
+            # record under the lock: with concurrent producers the persisted
+            # event order must match the in-memory order, or upsert replay
+            # could resolve a key to a different last-writer
+            if self.recorder is not None:
+                for event in events:
+                    self.recorder(event)
 
     def remove(self, key: int, row: Optional[Tuple[Any, ...]] = None) -> None:
         event = (_DELETE_BY_KEY if row is None else _REMOVE, key, row)
         with self._lock:
             self._events.append(event)
             self._since_mark += 1
-        if self.recorder is not None:
-            self.recorder(event)
+            if self.recorder is not None:
+                self.recorder(event)
 
     def mark_batch(self) -> None:
         """Seal events pushed since the previous marker into one batch."""
@@ -75,11 +94,11 @@ class InputSession:
                 return
             self._events.append(event)
             self._since_mark = 0
-        # markers persist with the event log so replayed atomic sources
-        # reproduce their batch boundaries (and drain at all — an atomic
-        # session never releases unsealed rows)
-        if self.recorder is not None:
-            self.recorder(event)
+            # markers persist with the event log so replayed atomic sources
+            # reproduce their batch boundaries (and drain at all — an atomic
+            # session never releases unsealed rows)
+            if self.recorder is not None:
+                self.recorder(event)
 
     def close(self) -> None:
         with self._lock:

@@ -73,11 +73,22 @@ class SessionWriter:
 
     def key_of(self, values: Mapping[str, Any]) -> int:
         if self.primary_key:
-            return int(ref_scalar(*(values[c] for c in self.primary_key)))
+            return self._pk_key(values)
         with self._lock:
             i = self._counter
             self._counter += 1
         return int(sequential_keys(i, 1, salt=self._salt)[0])
+
+    def _pk_key(self, values: Mapping[str, Any]) -> int:
+        return int(ref_scalar(*(values[c] for c in self.primary_key)))
+
+    def _tracked_key(self, row: tuple) -> int:
+        """Key for value-tracked rows: hash(value-hash, occurrence-index)."""
+        vid = self._value_id(row)
+        with self._lock:
+            n = self._live_counts.get(vid, 0)
+            self._live_counts[vid] = n + 1
+        return int(ref_scalar(np.uint64(vid), n))
 
     def _value_id(self, row: tuple) -> int:
         return int(ref_scalar(*row))
@@ -87,14 +98,37 @@ class SessionWriter:
         row = tuple(values.get(c) for c in self.column_names)
         if key is None:
             if self.track_value_deletions:
-                vid = self._value_id(row)
-                with self._lock:
-                    n = self._live_counts.get(vid, 0)
-                    self._live_counts[vid] = n + 1
-                key = int(ref_scalar(np.uint64(vid), n))
+                key = self._tracked_key(row)
             else:
                 key = self.key_of(values)
         self.session.insert(key, row)
+
+    def insert_rows(self, rows_values: Sequence[Mapping[str, Any]]) -> None:
+        """Bulk insert: coerce + key a whole chunk, then hand it to the
+        session in ONE ``insert_batch`` call — one session-lock acquisition
+        per chunk instead of per row (the fs/csv readers parse thousands of
+        rows per file; see InputSession.insert_batch)."""
+        keys: List[Optional[int]] = []
+        rows: List[tuple] = []
+        for values in rows_values:
+            values = coerce_row_types(values, self.dtypes)
+            row = tuple(values.get(c) for c in self.column_names)
+            if self.track_value_deletions:
+                key: Optional[int] = self._tracked_key(row)
+            elif self.primary_key:
+                key = self._pk_key(values)
+            else:
+                key = None  # sequential, assigned in one counter bump below
+            keys.append(key)
+            rows.append(row)
+        n_auto = sum(1 for k in keys if k is None)
+        if n_auto:
+            with self._lock:
+                start = self._counter
+                self._counter += n_auto
+            auto = iter(sequential_keys(start, n_auto, salt=self._salt))
+            keys = [int(next(auto)) if k is None else k for k in keys]
+        self.session.insert_batch(keys, rows)
 
     def remove(self, values: Mapping[str, Any], key: Optional[int] = None) -> None:
         values = coerce_row_types(values, self.dtypes)

@@ -54,10 +54,33 @@ def _parse_into(
             "seen_at": int(time.time()),
         }
 
+    # rows buffer into chunked bulk inserts (SessionWriter.insert_rows):
+    # one session-lock acquisition per chunk, not per row
+    _buf: List[Dict[str, Any]] = []
+
     def emit(values: Dict[str, Any]):
         if with_metadata:
             values = {**values, "_metadata": meta}
-        writer.insert(values)
+        _buf.append(values)
+        if len(_buf) >= 8192:
+            writer.insert_rows(_buf)
+            _buf.clear()
+
+    def flush():
+        if _buf:
+            writer.insert_rows(_buf)
+            _buf.clear()
+
+    try:
+        _dispatch_format(fpath, format, columns, emit)
+    finally:
+        # flush even when a malformed row raises mid-file, so every
+        # successfully parsed row reaches the session (the pre-buffering
+        # behavior); the exception still propagates to the caller
+        flush()
+
+
+def _dispatch_format(fpath, format, columns, emit) -> None:
 
     if format == "csv":
         # native C++ scanner (native/src/csv.cc) — columnar extents, one str
