@@ -65,6 +65,14 @@ int64_t pn_serialize_rows(int64_t n_rows, int32_t n_cols,
                           uint8_t* out, int64_t out_cap,
                           int64_t* row_offsets);
 
+/* ---- row key hashing ----
+ * xxh3-64 of each row slice [offsets[i], offsets[i+1]) of buf (the layout
+ * pn_serialize_rows produces) into out[n_rows].  Returns 0, or -1 when the
+ * library was built without an xxhash implementation (caller falls back to
+ * hashing in Python; see native/src/hash.cc). */
+int32_t pn_hash_rows(const uint8_t* buf, int64_t buf_len,
+                     const int64_t* offsets, int64_t n_rows, uint64_t* out);
+
 /* ---- CRC32 (IEEE, zlib-compatible) and snapshot frame scanning ----
  * Frame format: [u32 LE payload_len][u32 LE crc32(payload)][payload].
  * pn_frame_scan walks buf, validating frames; fills offsets/lengths of up to

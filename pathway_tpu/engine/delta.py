@@ -181,6 +181,41 @@ class Delta:
             for idxs in groups.values():
                 pos = [i for i in idxs if diffs[i] > 0]
                 neg = [i for i in idxs if diffs[i] < 0]
+                if len(pos) > 4 and len(neg) > 4:
+                    # large group: match exact insert/retract pairs by
+                    # serialized bytes first (linear), leaving only unmatched
+                    # leftovers for the quadratic rows_equal scan — pickle
+                    # equality implies value equality, but not vice versa
+                    # (int vs np.int64), so leftovers still need the scan
+                    import pickle
+
+                    buckets: Dict[bytes, List[int]] = {}
+                    unbucketed_pos: List[int] = []
+                    for pi in pos:
+                        try:
+                            b = pickle.dumps(tuple(c[pi] for c in cols), 4)
+                        except Exception:
+                            unbucketed_pos.append(pi)
+                            continue
+                        buckets.setdefault(b, []).append(pi)
+                    leftover_neg: List[int] = []
+                    for ni in neg:
+                        try:
+                            b = pickle.dumps(tuple(c[ni] for c in cols), 4)
+                        except Exception:
+                            leftover_neg.append(ni)
+                            continue
+                        lst = buckets.get(b)
+                        if lst:
+                            pi = lst.pop()
+                            keep[ni] = False
+                            keep[pi] = False
+                        else:
+                            leftover_neg.append(ni)
+                    pos = unbucketed_pos + [
+                        pi for lst in buckets.values() for pi in lst
+                    ]
+                    neg = leftover_neg
                 for ni in neg:
                     nrow = tuple(c[ni] for c in cols)
                     for pj, pi in enumerate(pos):
@@ -240,50 +275,78 @@ class RowStore:
         return np.fromiter(self._rows.keys(), dtype=KEY_DTYPE, count=len(self._rows))
 
     def apply(self, delta: Delta) -> None:
+        """Replay a delta into the state — columnar: the common shapes
+        (all-insert, or retractions-then-insertions as ``consolidated()``
+        emits) run as C-level zip/update/pop bulk ops, never a per-row
+        Python tuple build.  ``list(col)`` (not ``col.tolist()``) keeps
+        np scalar types intact — np.uint64 cells are pointers
+        (internals/keys.py:53) and must not decay to plain ints."""
+        n = delta.n
+        if n == 0:
+            return
         names = self.column_names
         cols = [delta.columns[c] for c in names]
-        for i in range(delta.n):
-            key = int(delta.keys[i])
-            if delta.diffs[i] > 0:
-                self._rows[key] = tuple(c[i] for c in cols)
+        diffs = delta.diffs
+        rows = self._rows
+        neg = int(np.searchsorted(diffs, 0))  # first non-negative diff
+        if neg == 0 or not (diffs[:neg] < 0).all() or not (diffs[neg:] > 0).all():
+            if (diffs > 0).all():
+                neg = 0
             else:
-                self._rows.pop(key, None)
+                # unsorted mixed delta: positional replay (rare — only
+                # un-consolidated callers)
+                for i in range(n):
+                    key = int(delta.keys[i])
+                    if diffs[i] > 0:
+                        rows[key] = tuple(c[i] for c in cols)
+                    else:
+                        rows.pop(key, None)
+                return
+        keys = delta.keys.tolist()
+        if neg:
+            for key in keys[:neg]:
+                rows.pop(key, None)
+        if neg < n:
+            ins_keys = keys[neg:]
+            if cols:
+                ins_rows = zip(*(list(c[neg:]) for c in cols))
+            else:
+                ins_rows = iter([()] * len(ins_keys))
+            rows.update(zip(ins_keys, ins_rows))
+
+    def _columns_of(self, rows: List[Tuple[Any, ...]]) -> Dict[str, np.ndarray]:
+        """Transpose row tuples into object columns (C-level zip)."""
+        if rows:
+            transposed = list(zip(*rows))
+        else:
+            transposed = [()] * len(self.column_names)
+        return {
+            name: _object_array(transposed[ci])
+            for ci, name in enumerate(self.column_names)
+        }
 
     def lookup_delta(self, keys: np.ndarray, diff: int = -1) -> Delta:
         """Build a delta of current rows for the given keys (used to retract)."""
-        found_keys: List[int] = []
-        found_rows: List[Tuple[Any, ...]] = []
-        for key in keys:
-            row = self._rows.get(int(key))
-            if row is not None:
-                found_keys.append(int(key))
-                found_rows.append(row)
-        columns = {}
-        for ci, name in enumerate(self.column_names):
-            columns[name] = _object_array([r[ci] for r in found_rows])
+        get = self._rows.get
+        pairs = [
+            (key, row)
+            for key in np.asarray(keys, dtype=KEY_DTYPE).tolist()
+            if (row := get(key)) is not None
+        ]
+        found_keys = [p[0] for p in pairs]
         return Delta(
             keys=np.array(found_keys, dtype=KEY_DTYPE),
             diffs=np.full(len(found_keys), diff, dtype=np.int64),
-            columns=columns,
+            columns=self._columns_of([p[1] for p in pairs]),
         )
 
     def to_delta(self, diff: int = 1) -> Delta:
         """Snapshot the entire state as one insertion delta."""
-        keys = self.keys_array()
-        rows = [self._rows[int(k)] for k in keys]
-        columns = {}
-        for ci, name in enumerate(self.column_names):
-            columns[name] = _object_array([r[ci] for r in rows])
         return Delta(
-            keys=keys,
-            diffs=np.full(len(keys), diff, dtype=np.int64),
-            columns=columns,
+            keys=self.keys_array(),
+            diffs=np.full(len(self._rows), diff, dtype=np.int64),
+            columns=self._columns_of(list(self._rows.values())),
         )
 
     def to_columns(self) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
-        keys = self.keys_array()
-        rows = [self._rows[int(k)] for k in keys]
-        columns = {}
-        for ci, name in enumerate(self.column_names):
-            columns[name] = _object_array([r[ci] for r in rows])
-        return keys, columns
+        return self.keys_array(), self._columns_of(list(self._rows.values()))

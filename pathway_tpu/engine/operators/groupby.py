@@ -81,6 +81,54 @@ class GroupByOperator(EngineOperator):
         for spec in self.reducer_specs:
             arg_arrays.append([np.asarray(e._eval(ctx)) for e in spec.arg_expressions])
 
+        touched = self._update_groups_batch(delta, gkeys, gvals, arg_arrays)
+        if touched is None:
+            touched = self._update_groups_rowwise(
+                delta, gkeys, gvals, arg_arrays, ts
+            )
+        return self._emit(touched, group_names)
+
+    def _update_groups_batch(self, delta, gkeys, gvals, arg_arrays):
+        """Vectorised state update: collapse the delta to one contribution
+        per (group, reducer) via the additive-reducer batch interface, then
+        visit only the touched groups in Python — rows never enter a Python
+        loop.  Returns None when any reducer/dtype can't vectorise."""
+        for spec in self.reducer_specs:
+            if spec.include_key:
+                return None
+        uniq, first_idx, inv = np.unique(
+            gkeys, return_index=True, return_inverse=True
+        )
+        n_groups = len(uniq)
+        contribs: List[Any] = []
+        for spec, args in zip(self.reducer_specs, arg_arrays):
+            c = spec.reducer.batch_contribs(args, delta.diffs, inv, n_groups)
+            if c is None:
+                return None
+            contribs.append(c)
+        count_delta = np.bincount(
+            inv, weights=delta.diffs, minlength=n_groups
+        ).astype(np.int64)
+        touched: Dict[int, None] = {}
+        uniq_list = uniq.tolist()
+        for j, gk in enumerate(uniq_list):
+            entry = self._groups.get(gk)
+            if entry is None:
+                i = int(first_idx[j])
+                entry = [
+                    0,
+                    tuple(gv[i] for gv in gvals),
+                    [spec.reducer.init_state() for spec in self.reducer_specs],
+                ]
+                self._groups[gk] = entry
+            entry[0] += int(count_delta[j])
+            states = entry[2]
+            for si, spec in enumerate(self.reducer_specs):
+                states[si] = spec.reducer.merge_contrib(states[si], contribs[si][j])
+            touched[gk] = None
+        return touched
+
+    def _update_groups_rowwise(self, delta, gkeys, gvals, arg_arrays, ts):
         touched: Dict[int, None] = {}
         for i in range(delta.n):
             gk = int(gkeys[i])
@@ -107,7 +155,9 @@ class GroupByOperator(EngineOperator):
                     value = (value, rkey) if not isinstance(value, tuple) else value
                 entry[2][si] = spec.reducer.update(entry[2][si], value, diff, rkey, ts)
             touched[gk] = None
+        return touched
 
+    def _emit(self, touched, group_names) -> Optional[Delta]:
         out_names = self.output.column_names
         out_rows: List[Tuple[int, int, Tuple[Any, ...]]] = []
         for gk in touched:

@@ -163,6 +163,23 @@ class SourceOperator(EngineOperator):
             return None
         names = self.output.column_names
         store = self.output.store
+        if all(e[0] == _INSERT for e in events):
+            # pure-insert batch (the bulk-ingest shape): no upsert chains to
+            # resolve — build the delta columnar without the per-event loop
+            columns = {}
+            if names:
+                transposed = list(zip(*(e[2] for e in events)))
+                for ci, name in enumerate(names):
+                    columns[name] = as_column(
+                        list(transposed[ci]), self.dtypes.get(name)
+                    )
+            return Delta(
+                keys=np.fromiter(
+                    (e[1] for e in events), dtype=KEY_DTYPE, count=len(events)
+                ),
+                diffs=np.ones(len(events), dtype=np.int64),
+                columns=columns,
+            )
         keys: List[int] = []
         diffs: List[int] = []
         rows: List[Tuple[Any, ...]] = []

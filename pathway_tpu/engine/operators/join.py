@@ -19,7 +19,7 @@ import numpy as np
 
 from ...internals.expression import ColumnExpression
 from ...internals.keys import KEY_DTYPE, ref_scalars_batch
-from ..delta import Delta
+from ..delta import Delta, _object_array
 from ..graph import EngineOperator, EngineTable
 from .rowwise import build_eval_context
 
@@ -49,16 +49,6 @@ def _normalize_pointer_array(arr: np.ndarray) -> np.ndarray:
     ):
         return arr.astype(np.uint64)
     return arr
-
-
-def _out_key(lkey: Optional[int], rkey: Optional[int], assign_id_from: Optional[str]) -> int:
-    if assign_id_from == "left" and lkey is not None:
-        return lkey
-    if assign_id_from == "right" and rkey is not None:
-        return rkey
-    a = lkey if lkey is not None else _LPAD
-    b = rkey if rkey is not None else _RPAD
-    return int(ref_scalars_batch([[a], [b]])[0])
 
 
 class JoinOperator(EngineOperator):
@@ -115,6 +105,74 @@ class JoinOperator(EngineOperator):
         r = rrow if rrow is not None else (None,) * len(self.right_names)
         return tuple(l) + tuple(r)
 
+    # -- columnar output assembly -----------------------------------------
+    def _out_keys_batch(
+        self, lkeys: List[Optional[int]], rkeys: List[Optional[int]]
+    ) -> np.ndarray:
+        """Batched ``_out_key`` — one ref_scalars_batch call for the whole
+        output instead of one per emitted row.  Row keys hash as
+        pointer-tagged uint64 columns so the batch always takes the fully
+        native serialize+hash path (plain python ints ≥ 2^63 would knock the
+        whole column onto the per-value fallback)."""
+        a = np.fromiter(
+            (k if k is not None else _LPAD for k in lkeys),
+            dtype=np.uint64,
+            count=len(lkeys),
+        )
+        b = np.fromiter(
+            (k if k is not None else _RPAD for k in rkeys),
+            dtype=np.uint64,
+            count=len(rkeys),
+        )
+        hashed = ref_scalars_batch([a, b])
+        if self.assign_id_from == "left":
+            return np.array(
+                [
+                    lk if lk is not None else h
+                    for lk, h in zip(lkeys, hashed.tolist())
+                ],
+                dtype=KEY_DTYPE,
+            )
+        if self.assign_id_from == "right":
+            return np.array(
+                [
+                    rk if rk is not None else h
+                    for rk, h in zip(rkeys, hashed.tolist())
+                ],
+                dtype=KEY_DTYPE,
+            )
+        return hashed
+
+    def _assemble(
+        self,
+        lkeys: List[Optional[int]],
+        rkeys: List[Optional[int]],
+        lrows: List[Optional[Tuple]],
+        rrows: List[Optional[Tuple]],
+        diffs: List[int],
+    ) -> Delta:
+        none_l = (None,) * len(self.left_names)
+        none_r = (None,) * len(self.right_names)
+        lt = (
+            list(zip(*(r if r is not None else none_l for r in lrows)))
+            if self.left_names
+            else []
+        )
+        rt = (
+            list(zip(*(r if r is not None else none_r for r in rrows)))
+            if self.right_names
+            else []
+        )
+        nl = len(self.left_names)
+        columns = {}
+        for ci, name in enumerate(self.output.column_names):
+            columns[name] = _object_array(lt[ci] if ci < nl else rt[ci - nl])
+        return Delta(
+            keys=self._out_keys_batch(lkeys, rkeys),
+            diffs=np.asarray(diffs, dtype=np.int64),
+            columns=columns,
+        )
+
     # -- processing --------------------------------------------------------
     def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
         if delta.n == 0:
@@ -123,7 +181,6 @@ class JoinOperator(EngineOperator):
         jks = self._join_keys(delta, port)
         in_names = self.left_names if port == 0 else self.right_names
         cols = [delta.columns[c] for c in in_names]
-        out: List[Tuple[int, int, Tuple[Any, ...]]] = []
         own = self._left if port == 0 else self._right
         other = self._right if port == 0 else self._left
         pad_own = self.kind in (
@@ -132,83 +189,79 @@ class JoinOperator(EngineOperator):
         pad_other = self.kind in (
             (JoinKind.RIGHT, JoinKind.OUTER) if port == 0 else (JoinKind.LEFT, JoinKind.OUTER)
         )
+        left_port = port == 0
 
-        for i in range(delta.n):
-            jk = int(jks[i])
-            key = int(delta.keys[i])
-            row = tuple(c[i] for c in cols)
-            diff = int(delta.diffs[i])
+        # parallel accumulators; output columns are assembled columnar at the
+        # end (C-level zip) and out keys hashed in ONE batched call — per
+        # emitted row this loop only does list extends/appends
+        acc_l: List[Optional[int]] = []
+        acc_r: List[Optional[int]] = []
+        acc_lrow: List[Optional[Tuple]] = []
+        acc_rrow: List[Optional[Tuple]] = []
+        acc_diff: List[int] = []
+
+        def emit_bucket(bucket: Dict[int, Tuple], key, row, d: int) -> None:
+            """All (own row × other-bucket) pairs with diff d; ``key``/``row``
+            None emits the padded form of the other side's rows."""
+            m = len(bucket)
+            if left_port:
+                acc_l.extend([key] * m)
+                acc_lrow.extend([row] * m)
+                acc_r.extend(bucket.keys())
+                acc_rrow.extend(bucket.values())
+            else:
+                acc_l.extend(bucket.keys())
+                acc_lrow.extend(bucket.values())
+                acc_r.extend([key] * m)
+                acc_rrow.extend([row] * m)
+            acc_diff.extend([d] * m)
+
+        def emit_pad_own(key, row, d: int) -> None:
+            if left_port:
+                acc_l.append(key)
+                acc_lrow.append(row)
+                acc_r.append(None)
+                acc_rrow.append(None)
+            else:
+                acc_l.append(None)
+                acc_lrow.append(None)
+                acc_r.append(key)
+                acc_rrow.append(row)
+            acc_diff.append(d)
+
+        row_iter = (
+            zip(*(list(c) for c in cols)) if cols else iter([()] * delta.n)
+        )
+        for jk, key, diff, row in zip(
+            jks.tolist(), delta.keys.tolist(), delta.diffs.tolist(), row_iter
+        ):
             own_bucket = own.setdefault(jk, {})
             other_bucket = other.get(jk) or {}
             own_before = len(own_bucket)
 
             if diff > 0:
-                for okey, orow in other_bucket.items():
-                    if port == 0:
-                        out.append(
-                            (_out_key(key, okey, self.assign_id_from), 1, self._row(row, orow))
-                        )
-                    else:
-                        out.append(
-                            (_out_key(okey, key, self.assign_id_from), 1, self._row(orow, row))
-                        )
-                if pad_other and own_before == 0 and other_bucket:
-                    # other side's rows were padded; retract their padded forms
-                    for okey, orow in other_bucket.items():
-                        if port == 0:
-                            out.append(
-                                (_out_key(None, okey, self.assign_id_from), -1, self._row(None, orow))
-                            )
-                        else:
-                            out.append(
-                                (_out_key(okey, None, self.assign_id_from), -1, self._row(orow, None))
-                            )
-                if pad_own and not other_bucket:
-                    if port == 0:
-                        out.append(
-                            (_out_key(key, None, self.assign_id_from), 1, self._row(row, None))
-                        )
-                    else:
-                        out.append(
-                            (_out_key(None, key, self.assign_id_from), 1, self._row(None, row))
-                        )
+                if other_bucket:
+                    emit_bucket(other_bucket, key, row, 1)
+                    if pad_other and own_before == 0:
+                        # other side's rows were padded; retract padded forms
+                        emit_bucket(other_bucket, None, None, -1)
+                elif pad_own:
+                    emit_pad_own(key, row, 1)
                 own_bucket[key] = row
             else:
                 own_bucket.pop(key, None)
                 own_after = len(own_bucket)
-                for okey, orow in other_bucket.items():
-                    if port == 0:
-                        out.append(
-                            (_out_key(key, okey, self.assign_id_from), -1, self._row(row, orow))
-                        )
-                    else:
-                        out.append(
-                            (_out_key(okey, key, self.assign_id_from), -1, self._row(orow, row))
-                        )
-                if pad_own and not other_bucket:
-                    if port == 0:
-                        out.append(
-                            (_out_key(key, None, self.assign_id_from), -1, self._row(row, None))
-                        )
-                    else:
-                        out.append(
-                            (_out_key(None, key, self.assign_id_from), -1, self._row(None, row))
-                        )
-                if pad_other and own_after == 0 and own_before > 0 and other_bucket:
-                    for okey, orow in other_bucket.items():
-                        if port == 0:
-                            out.append(
-                                (_out_key(None, okey, self.assign_id_from), 1, self._row(None, orow))
-                            )
-                        else:
-                            out.append(
-                                (_out_key(okey, None, self.assign_id_from), 1, self._row(orow, None))
-                            )
+                if other_bucket:
+                    emit_bucket(other_bucket, key, row, -1)
+                    if pad_other and own_after == 0 and own_before > 0:
+                        emit_bucket(other_bucket, None, None, 1)
+                elif pad_own:
+                    emit_pad_own(key, row, -1)
                 if not own_bucket:
                     own.pop(jk, None)
-        if not out:
+        if not acc_diff:
             return None
-        return Delta.from_rows(self.output.column_names, out)
+        return self._assemble(acc_l, acc_r, acc_lrow, acc_rrow, acc_diff)
 
 
 class AsofNowJoinOperator(JoinOperator):
@@ -245,31 +298,87 @@ class AsofNowJoinOperator(JoinOperator):
         delta = delta.consolidated()
         jks = self._join_keys(delta, 0)
         cols = [delta.columns[c] for c in self.left_names]
-        out: List[Tuple[int, int, Tuple[Any, ...]]] = []
         pad_left = self.kind in (JoinKind.LEFT, JoinKind.OUTER)
-        for i in range(delta.n):
-            jk = int(jks[i])
-            key = int(delta.keys[i])
-            diff = int(delta.diffs[i])
+
+        # retractions replay previously emitted rows verbatim; insertions
+        # accumulate columnar (same scheme as JoinOperator.process) — the
+        # per-left-key _emitted bookkeeping is filled in after the one
+        # batched out-key hash
+        ret_keys: List[int] = []
+        ret_rows: List[Tuple[Any, ...]] = []
+        acc_l: List[int] = []
+        acc_r: List[Optional[int]] = []
+        acc_lrow: List[Tuple] = []
+        acc_rrow: List[Optional[Tuple]] = []
+        emit_spans: List[Tuple[int, int, int]] = []  # (left key, start, stop)
+        row_iter = (
+            zip(*(list(c) for c in cols)) if cols else iter([()] * delta.n)
+        )
+        for jk, key, diff, row in zip(
+            jks.tolist(), delta.keys.tolist(), delta.diffs.tolist(), row_iter
+        ):
             if diff < 0:
                 for out_key, out_row in self._emitted.pop(key, []):
-                    out.append((out_key, -1, out_row))
+                    ret_keys.append(out_key)
+                    ret_rows.append(out_row)
                 continue
-            row = tuple(c[i] for c in cols)
-            emitted: List[Tuple[int, Tuple[Any, ...]]] = []
+            start = len(acc_l)
             bucket = self._right.get(jk) or {}
             if bucket:
-                for rkey, rrow in bucket.items():
-                    ok = _out_key(key, rkey, self.assign_id_from)
-                    orow = self._row(row, rrow)
-                    out.append((ok, 1, orow))
-                    emitted.append((ok, orow))
+                m = len(bucket)
+                acc_l.extend([key] * m)
+                acc_lrow.extend([row] * m)
+                acc_r.extend(bucket.keys())
+                acc_rrow.extend(bucket.values())
             elif pad_left:
-                ok = _out_key(key, None, self.assign_id_from)
-                orow = self._row(row, None)
-                out.append((ok, 1, orow))
-                emitted.append((ok, orow))
-            self._emitted[key] = emitted
-        if not out:
+                acc_l.append(key)
+                acc_lrow.append(row)
+                acc_r.append(None)
+                acc_rrow.append(None)
+            emit_spans.append((key, start, len(acc_l)))
+        if not acc_l and not ret_keys:
+            if emit_spans:
+                # inner-join queries that matched nothing still reset their
+                # emitted bookkeeping
+                for key, _s, _e in emit_spans:
+                    self._emitted[key] = []
             return None
-        return Delta.from_rows(self.output.column_names, out)
+        ins = (
+            self._assemble(acc_l, acc_r, acc_lrow, acc_rrow, [1] * len(acc_l))
+            if acc_l
+            else None
+        )
+        if ins is not None:
+            ins_keys = ins.keys.tolist()
+            ins_rows = list(
+                zip(*(ins.columns[c] for c in self.output.column_names))
+            )
+            for key, start, stop in emit_spans:
+                self._emitted[key] = list(
+                    zip(ins_keys[start:stop], ins_rows[start:stop])
+                )
+        else:
+            for key, _s, _e in emit_spans:
+                self._emitted[key] = []
+        rets = (
+            Delta(
+                keys=np.asarray(ret_keys, dtype=KEY_DTYPE),
+                diffs=np.full(len(ret_keys), -1, dtype=np.int64),
+                columns={
+                    name: _object_array(col)
+                    for name, col in zip(
+                        self.output.column_names,
+                        zip(*ret_rows)
+                        if ret_rows
+                        else [[]] * len(self.output.column_names),
+                    )
+                },
+            )
+            if ret_keys
+            else None
+        )
+        if ins is None:
+            return rets
+        if rets is None:
+            return ins
+        return Delta.concat([rets, ins], self.output.column_names)

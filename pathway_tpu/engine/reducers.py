@@ -43,7 +43,15 @@ def _hashable(v: Any) -> Any:
 
 
 class Reducer:
-    """Interface: state = update(state, value, diff, key, ts); result(state)."""
+    """Interface: state = update(state, value, diff, key, ts); result(state).
+
+    Additive reducers (count/sum/avg) additionally implement the vectorised
+    pair ``batch_contribs``/``merge_contrib``: a whole delta collapses to one
+    per-group contribution array (np.bincount over the group inverse index),
+    and only *touched groups* are visited in Python — the groupby hot path
+    (engine/operators/groupby.py) uses this to stay columnar per tick, the
+    micro-batch analog of the reference's count-free semigroup reducers
+    (src/engine/reduce.rs:40-101)."""
 
     name = "reducer"
     n_args = 1
@@ -55,6 +63,21 @@ class Reducer:
         raise NotImplementedError
 
     def result(self, state: Any) -> Any:
+        raise NotImplementedError
+
+    def batch_contribs(
+        self,
+        args: List[np.ndarray],
+        diffs: np.ndarray,
+        inv: np.ndarray,
+        n_groups: int,
+    ) -> Any:
+        """Per-group aggregated contribution for one delta (group j's value
+        at index j), or None when this reducer/dtype cannot vectorise —
+        order-sensitive reducers return None and take the per-row path."""
+        return None
+
+    def merge_contrib(self, state: Any, contrib: Any) -> Any:
         raise NotImplementedError
 
 
@@ -71,6 +94,14 @@ class CountReducer(Reducer):
     def result(self, state):
         return state
 
+    def batch_contribs(self, args, diffs, inv, n_groups):
+        return np.bincount(inv, weights=diffs, minlength=n_groups).astype(
+            np.int64
+        )
+
+    def merge_contrib(self, state, contrib):
+        return state + int(contrib)
+
 
 class SumReducer(Reducer):
     name = "sum"
@@ -84,6 +115,22 @@ class SumReducer(Reducer):
 
     def result(self, state):
         return state
+
+    def batch_contribs(self, args, diffs, inv, n_groups):
+        v = args[0]
+        if not isinstance(v, np.ndarray) or v.ndim != 1 or v.dtype == object:
+            return None
+        if v.dtype != np.uint64 and np.issubdtype(v.dtype, np.integer):
+            acc = np.zeros(n_groups, dtype=np.int64)
+            # add.at (not bincount) keeps int64 arithmetic exact
+            np.add.at(acc, inv, v.astype(np.int64) * diffs)
+            return acc
+        if np.issubdtype(v.dtype, np.floating):
+            return np.bincount(inv, weights=v * diffs, minlength=n_groups)
+        return None
+
+    def merge_contrib(self, state, contrib):
+        return contrib if state is None else state + contrib
 
 
 class NdarraySumReducer(Reducer):
@@ -286,6 +333,28 @@ class AvgReducer(Reducer):
     def result(self, state):
         s, c = state
         return s / c if c else None
+
+    def batch_contribs(self, args, diffs, inv, n_groups):
+        v = args[0]
+        if not isinstance(v, np.ndarray) or v.ndim != 1 or v.dtype == object:
+            return None
+        if v.dtype == np.uint64 or not (
+            np.issubdtype(v.dtype, np.integer)
+            or np.issubdtype(v.dtype, np.floating)
+        ):
+            return None
+        sums = np.bincount(
+            inv, weights=v.astype(np.float64) * diffs, minlength=n_groups
+        )
+        counts = np.bincount(inv, weights=diffs, minlength=n_groups).astype(
+            np.int64
+        )
+        return list(zip(sums, counts))
+
+    def merge_contrib(self, state, contrib):
+        s, c = state
+        ds, dc = contrib
+        return (s + ds, c + int(dc))
 
 
 class EarliestReducer(Reducer):

@@ -93,6 +93,77 @@ def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
     return Pointer(xxhash.xxh3_64_intdigest(bytes(buf)))
 
 
+# exact-type → kind for the object-column scan: a dict hit is one hash lookup
+# per value instead of a 6-deep isinstance chain (this scan runs over every
+# id value of every delta — it must stay close to C speed)
+_KIND_BY_TYPE = {
+    bool: "bool",
+    np.bool_: "bool",
+    Pointer: "ptr",
+    np.uint64: "ptr",
+    int: "int",
+    np.int64: "int",
+    np.int32: "int",
+    np.int16: "int",
+    np.int8: "int",
+    np.uint32: "int",
+    np.uint16: "int",
+    np.uint8: "int",
+    float: "float",
+    np.float64: "float",
+    np.float32: "float",
+    str: "str",
+    bytes: "bytes",
+}
+
+
+def _as_object_array(values) -> np.ndarray:
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def _kind_of_type_slow(t: type) -> "str | None":
+    """issubclass fallback for subclasses / exotic numeric types (ordering
+    matters: bool<int, Pointer<int, np.uint64<np.integer)."""
+    if issubclass(t, (bool, np.bool_)):
+        return "bool"
+    if issubclass(t, (Pointer, np.uint64)):
+        return "ptr"
+    if issubclass(t, (int, np.integer)):
+        return "int"
+    if issubclass(t, (float, np.floating)):
+        return "float"
+    if issubclass(t, str):
+        return "str"
+    if issubclass(t, bytes):
+        return "bytes"
+    return None
+
+
+def _str_col_layout(col, n: int, kind: str):
+    """(blob, offsets) for a null-free str/bytes column, vectorised: one big
+    join + one encode (ascii fast path) instead of per-value appends."""
+    if kind == "bytes":
+        parts = list(col)
+        lens = np.fromiter(map(len, parts), dtype=np.int64, count=n)
+        blob = b"".join(parts)
+    else:
+        joined = "".join(col)
+        if joined.isascii():
+            lens = np.fromiter(map(len, col), dtype=np.int64, count=n)
+            blob = joined.encode()
+        else:
+            parts = [v.encode() for v in col]
+            lens = np.fromiter(map(len, parts), dtype=np.int64, count=n)
+            blob = b"".join(parts)
+    offsets = np.empty(n + 1, dtype=np.int64)
+    offsets[0] = 0
+    np.cumsum(lens, out=offsets[1:])
+    return blob, offsets
+
+
 def _native_col_spec(col, n: int):
     """Map one id column onto the native serializer's typed layout
     (pathway_tpu.native.serialize_rows); None if the column needs the generic
@@ -111,28 +182,20 @@ def _native_col_spec(col, n: int):
             return _native.COL_FLOAT64, col.astype(np.float64), None
         if col.dtype != object:
             return None
-    nulls = None
+    # one C-level pass collects the distinct cell types; kind resolution then
+    # runs over the handful of types, not the n values
+    type_set = set(map(type, col))
+    nulls = type(None) in type_set
+    if nulls:
+        type_set.discard(type(None))
     kinds = set()
-    for v in col:
-        if v is None:
-            nulls = True
-            continue
-        if isinstance(v, (bool, np.bool_)):
-            kinds.add("bool")
-        elif isinstance(v, (Pointer, np.uint64)):
-            kinds.add("ptr")
-        elif isinstance(v, (int, np.integer)):
-            if not -(1 << 63) <= int(v) < (1 << 63):
+    for t in type_set:
+        k = _KIND_BY_TYPE.get(t)
+        if k is None:
+            k = _kind_of_type_slow(t)
+            if k is None:
                 return None
-            kinds.add("int")
-        elif isinstance(v, (float, np.floating)):
-            kinds.add("float")
-        elif isinstance(v, str):
-            kinds.add("str")
-        elif isinstance(v, bytes):
-            kinds.add("bytes")
-        else:
-            return None
+        kinds.add(k)
         if len(kinds) > 1:
             return None
     mask = None
@@ -141,8 +204,10 @@ def _native_col_spec(col, n: int):
     if not kinds:  # all null
         return _native.COL_NONE, None, mask
     kind = kinds.pop()
-    fill = {"bool": False, "ptr": 0, "int": 0, "float": 0.0}.get(kind)
     if kind in ("str", "bytes"):
+        tag = _native.COL_STR if kind == "str" else _native.COL_BYTES
+        if not nulls:
+            return tag, _str_col_layout(col, n, kind), mask
         offsets = np.empty(n + 1, dtype=np.int64)
         offsets[0] = 0
         parts = []
@@ -150,20 +215,24 @@ def _native_col_spec(col, n: int):
             b = b"" if v is None else (v.encode() if kind == "str" else v)
             parts.append(b)
             offsets[i + 1] = offsets[i] + len(b)
-        tag = _native.COL_STR if kind == "str" else _native.COL_BYTES
         return tag, (b"".join(parts), offsets), mask
-    vals = [fill if v is None else v for v in col]
-    if kind == "bool":
-        return _native.COL_BOOL, np.asarray(vals, dtype=np.uint8), mask
-    if kind == "ptr":
-        return _native.COL_POINTER, np.asarray(
-            [int(v) for v in vals], dtype=np.uint64
-        ), mask
-    if kind == "int":
-        return _native.COL_INT64, np.asarray(
-            [int(v) for v in vals], dtype=np.int64
-        ), mask
-    return _native.COL_FLOAT64, np.asarray(vals, dtype=np.float64), mask
+    fill = {"bool": False, "ptr": 0, "int": 0, "float": 0.0}[kind]
+    if not isinstance(col, np.ndarray):
+        col = _as_object_array(col)
+    if nulls:
+        col = np.where(mask.astype(bool), fill, col)
+    try:
+        if kind == "bool":
+            return _native.COL_BOOL, col.astype(np.uint8), mask
+        if kind == "ptr":
+            return _native.COL_POINTER, col.astype(np.uint64), mask
+        if kind == "int":
+            # astype(object->int64) raises on > 64-bit ints, which need the
+            # generic Python tagging path (\x0A wide-int tag)
+            return _native.COL_INT64, col.astype(np.int64), mask
+        return _native.COL_FLOAT64, col.astype(np.float64), mask
+    except (OverflowError, TypeError, ValueError):
+        return None
 
 
 def ref_scalars_batch(columns: Sequence[Sequence[Any]]) -> np.ndarray:
@@ -190,6 +259,9 @@ def ref_scalars_batch(columns: Sequence[Sequence[Any]]) -> np.ndarray:
             [s[1] for s in specs],
             [s[2] for s in specs],
         )
+        hashed = _native.hash_rows(buf, row_offsets)
+        if hashed is not None:
+            return hashed
         out = np.empty(n, dtype=KEY_DTYPE)
         view = memoryview(buf)
         digest = xxhash.xxh3_64_intdigest

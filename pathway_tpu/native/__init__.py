@@ -29,6 +29,7 @@ __all__ = [
     "parse_int64",
     "parse_float64",
     "serialize_rows",
+    "hash_rows",
     "crc32",
     "frame_scan",
     "shard_rows",
@@ -118,6 +119,11 @@ def _declare(dll: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_void_p),
         _p_u8, _i64, _p_i64,
     ]
+    try:
+        dll.pn_hash_rows.restype = _i32
+        dll.pn_hash_rows.argtypes = [_p_u8, _i64, _p_i64, _i64, _p_u64]
+    except AttributeError:
+        pass  # stale .so without the hashing entry point
     dll.pn_crc32.restype = _u32
     dll.pn_crc32.argtypes = [_p_u8, _i64, _u32]
     dll.pn_frame_scan.restype = _i64
@@ -347,6 +353,24 @@ def serialize_rows(
         ctypes.cast(out, _p_u8), needed, _np_ptr(row_offsets, _i64),
     )
     return out.raw[:needed], row_offsets
+
+
+def hash_rows(buf: bytes, row_offsets: np.ndarray) -> Optional[np.ndarray]:
+    """xxh3-64 of each serialized row slice (the pn_serialize_rows layout);
+    None when the library is absent or was built without xxhash — callers
+    hash row-by-row in Python instead (internals/keys.ref_scalars_batch)."""
+    dll = lib()
+    if dll is None or not hasattr(dll, "pn_hash_rows"):
+        return None
+    n = len(row_offsets) - 1
+    offs = np.ascontiguousarray(row_offsets, dtype=np.int64)
+    out = np.empty(n, dtype=np.uint64)
+    rc = dll.pn_hash_rows(
+        _as_u8_ptr(buf), len(buf), _np_ptr(offs, _i64), n, _np_ptr(out, _u64)
+    )
+    if rc != 0:
+        return None
+    return out
 
 
 # ---------------------------------------------------------------- crc / frames

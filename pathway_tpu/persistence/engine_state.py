@@ -179,6 +179,13 @@ class SourcePersistence:
         self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
 
 
+# Operator/table snapshots embed derived row keys (join output keys, group
+# keys); bump this whenever key derivation changes so stale snapshots are
+# rejected loudly and the run falls back to input-event replay (which
+# re-derives every key) instead of silently mixing key formats.
+SNAPSHOT_FORMAT = 2
+
+
 class PersistenceManager:
     """Wires a Config into a built engine graph: replays input snapshots
     before the run, records new events, and (in OPERATOR_PERSISTING mode)
@@ -236,6 +243,16 @@ class PersistenceManager:
     def _restore_operators(self) -> bool:
         if not self._commit or not self._commit.get("ops"):
             return False
+        if self._commit.get("format") != SNAPSHOT_FORMAT:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "operator snapshot format %s != current %s; ignoring operator "
+                "snapshots and replaying input events instead",
+                self._commit.get("format"),
+                SNAPSHOT_FORMAT,
+            )
+            return False
         restored = 0
         for stable_id, op in self._stable_ids():
             blob = self.backend.get(f"operators/{stable_id}")
@@ -283,7 +300,14 @@ class PersistenceManager:
             sp.flush(ts)
         ops_saved = self.operator_mode and self._snapshot_operators()
         self.backend.put(
-            "COMMIT", pickle.dumps({"frontier": ts, "ops": bool(ops_saved)})
+            "COMMIT",
+            pickle.dumps(
+                {
+                    "frontier": ts,
+                    "ops": bool(ops_saved),
+                    "format": SNAPSHOT_FORMAT,
+                }
+            ),
         )
 
     def finalize(self, ts: int) -> None:
