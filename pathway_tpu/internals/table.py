@@ -335,6 +335,49 @@ class Table:
             et, dict(self._dtypes), self._universe.subuniverse(), column_mapping=mapping
         )
 
+    def _time_gate(
+        self,
+        time_expr: ColumnExpression,
+        release_expr: Optional[ColumnExpression] = None,
+        expire_expr: Optional[ColumnExpression] = None,
+        clock=None,
+    ) -> Tuple["Table", Any]:
+        """Route this table through a TimeGateOperator (delay buffering /
+        late-data cutoff, reference time_column.rs:380,677); returns the
+        gated table and the operator (for sweep-hook registration by the
+        temporal layer).  Not public API — pw.temporal wires it from
+        behaviors."""
+        from ..engine.operators.time_gate import TimeGateOperator
+
+        exprs = [
+            e for e in (time_expr, release_expr, expire_expr) if e is not None
+        ]
+        input_table, ctx, env = self._with_siblings(exprs)
+        et = _new_engine_table(input_table.column_names, "time_gate")
+        op = TimeGateOperator(
+            input_table,
+            et,
+            time_expr,
+            release_expr,
+            expire_expr,
+            ctx,
+            clock=clock,
+            name="time_gate",
+        )
+        _add_op(op)
+        mapping = {
+            api: eng for (tid, api), eng in ctx.items() if tid == id(self)
+        }
+        return (
+            Table(
+                et,
+                dict(self._dtypes),
+                self._universe.subuniverse(),
+                column_mapping=mapping,
+            ),
+            op,
+        )
+
     def with_columns(self, *args, **kwargs) -> "Table":
         expressions = self._resolve_expressions(args, kwargs)
         all_exprs: Dict[str, ColumnExpression] = {

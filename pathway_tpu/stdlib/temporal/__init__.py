@@ -136,6 +136,99 @@ def intervals_over(*, at, lower_bound, upper_bound, is_outer: bool = True) -> In
     return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)
 
 
+def _apply_behavior(flat, behavior):
+    """Desugar a window behavior into a time gate on the flattened
+    (window-assigned) rows (reference lowering: _window.py behaviors →
+    buffer/forget engine ops, time_column.rs:380,677):
+
+    - ``CommonBehavior.delay d``   — hold each row until the stream clock
+      passes ``window_start + d`` (postpone).
+    - ``CommonBehavior.cutoff c``  — drop rows once the clock passed
+      ``window_end + c`` (ignore_late); the paired sweeper forgets group
+      state past the same threshold, and with ``keep_results=False`` also
+      retracts the frozen result rows.
+    - ``ExactlyOnceBehavior(shift s)`` — release == expire ==
+      ``window_end + s``: every window emits exactly once, then freezes.
+
+    Returns (gated_table, gate_operator | None, expire_of(group_values) | None).
+    """
+    if behavior is None:
+        return flat, None, None
+    if isinstance(behavior, ExactlyOnceBehavior):
+        shift = _num(behavior.shift) if behavior.shift is not None else 0.0
+        thr = ApplyExpression(
+            lambda e, s=shift: e + s, dt.FLOAT, args=(this._pw_window_end,)
+        )
+        gated, gate = flat._time_gate(this._pw_time, thr, thr)
+        return gated, gate, (lambda end, s=shift: end + s)
+    if isinstance(behavior, CommonBehavior):
+        release = expire = expire_of = None
+        if behavior.delay is not None:
+            d = _num(behavior.delay)
+            release = ApplyExpression(
+                lambda st, d=d: st + d, dt.FLOAT, args=(this._pw_window_start,)
+            )
+        if behavior.cutoff is not None:
+            c = _num(behavior.cutoff)
+            expire = ApplyExpression(
+                lambda e, c=c: e + c, dt.FLOAT, args=(this._pw_window_end,)
+            )
+            expire_of = lambda end, c=c: end + c  # noqa: E731
+        if release is None and expire is None:
+            return flat, None, None
+        gated, gate = flat._time_gate(this._pw_time, release, expire)
+        return gated, gate, expire_of
+    raise TypeError(f"unsupported window behavior: {behavior!r}")
+
+
+def _window_end_index(gop) -> int:
+    """Position of the window-end value inside the groupby's group-values
+    tuple — the reduce lowering may fold/rename grouping columns, so locate
+    it by the underlying column reference, not by position."""
+    from ...internals.expression import ColumnReference
+
+    for i, (name, e) in enumerate(gop.grouping_expressions.items()):
+        if name == "_pw_window_end" or (
+            isinstance(e, ColumnReference) and e.name == "_pw_window_end"
+        ):
+            return i
+    raise RuntimeError(
+        "windowed groupby lost its _pw_window_end grouping column"
+    )
+
+
+def _groupby_sweeper(gop, expire_of, retract: bool):
+    """Sweep hook forgetting expired window groups (reference
+    Graph::forget/freeze, src/engine/graph.rs:776-812).  State for windows
+    whose expiry the (lagged) clock passed is dropped — streaming state stays
+    bounded — and with ``retract`` the frozen results are withdrawn too
+    (keep_results=False)."""
+    from ...engine.delta import Delta
+
+    end_idx = _window_end_index(gop)
+
+    def sweep(clock):
+        expired = [
+            gk
+            for gk, entry in gop._groups.items()
+            if expire_of(entry[1][end_idx]) <= clock
+        ]
+        if not expired:
+            return None
+        rows = []
+        for gk in expired:
+            del gop._groups[gk]
+            if retract:
+                old = gop.output.store.get(gk)
+                if old is not None:
+                    rows.append((gk, -1, old))
+        if not rows:
+            return None
+        return (gop.output, Delta.from_rows(gop.output.column_names, rows))
+
+    return sweep
+
+
 class WindowedTable:
     """Result of windowby(): a GroupedTable whose group key includes the
     window instance; exposes _pw_window_start/_pw_window_end columns."""
@@ -153,7 +246,8 @@ class WindowedTable:
             flat = self.table.with_columns(
                 _pw_window=ApplyExpression(
                     win.assign, dt.ANY, args=(self.key_expr,)
-                )
+                ),
+                _pw_time=self.key_expr,
             ).flatten(this._pw_window)
             flat = flat.with_columns(
                 _pw_window_start=ApplyExpression(
@@ -163,13 +257,24 @@ class WindowedTable:
                     lambda w: w[1], dt.FLOAT, args=(this._pw_window,)
                 ),
             )
+            flat, gate, expire_of = _apply_behavior(flat, self.behavior)
             grouping = [flat._pw_window_start, flat._pw_window_end]
             if self.instance is not None:
                 inst = self.instance
                 if isinstance(inst, ColumnExpression):
                     grouping.append(inst)
             grouped = flat.groupby(*grouping)
-            return grouped.reduce(*args, **kwargs)
+            out = grouped.reduce(*args, **kwargs)
+            if gate is not None and expire_of is not None:
+                gate.sweep_hooks.append(
+                    _groupby_sweeper(
+                        out._engine_table.producer,
+                        expire_of,
+                        retract=isinstance(self.behavior, CommonBehavior)
+                        and not self.behavior.keep_results,
+                    )
+                )
+            return out
         if isinstance(win, SessionWindow):
             return self._reduce_session(*args, **kwargs)
         if isinstance(win, IntervalsOverWindow):
@@ -177,6 +282,13 @@ class WindowedTable:
         raise NotImplementedError(type(win))
 
     def _reduce_session(self, *args, **kwargs) -> Table:
+        if self.behavior is not None:
+            # loud failure beats the silently-ignored kwarg this used to be;
+            # session windows merge/split so their buffers need dedicated
+            # handling (reference: _window.py session + behavior lowering)
+            raise NotImplementedError(
+                "behaviors on session windows are not supported yet"
+            )
         from .session_windows import reduce_session
 
         return reduce_session(self, *args, **kwargs)
@@ -283,8 +395,11 @@ def _interval_join_impl(
     itv: Interval,
     *on,
     how: str = JoinMode.INNER,
+    behavior: Optional[Behavior] = None,
 ) -> "IntervalJoinResult":
-    return IntervalJoinResult(left, right, left_time, right_time, itv, on, how)
+    return IntervalJoinResult(
+        left, right, left_time, right_time, itv, on, how, behavior=behavior
+    )
 
 
 class IntervalJoinResult:
@@ -294,7 +409,7 @@ class IntervalJoinResult:
     unmatched rows with None via key-difference against the matched set
     (reference: stdlib/temporal/_interval_join.py)."""
 
-    def __init__(self, left, right, left_time, right_time, itv, on, how):
+    def __init__(self, left, right, left_time, right_time, itv, on, how, behavior=None):
         from ...internals.expression import IdExpression
 
         lb, ub = _num(itv.lower_bound), _num(itv.upper_bound)
@@ -321,6 +436,11 @@ class IntervalJoinResult:
             _pw_rt=smart_coerce(right_time),
             _pw_rid=IdExpression(None),
         )
+        self._gated = False
+        if behavior is not None:
+            gated_pair = self._gate_sides(lflat, rtab, behavior, lb, ub)
+            self._gated = gated_pair != (lflat, rtab)
+            lflat, rtab = gated_pair
         conds = [lflat._pw_lbuckets == rtab._pw_rbucket]
         for cond in on:
             lref, rref = cond._left, cond._right
@@ -332,6 +452,51 @@ class IntervalJoinResult:
         self._right = right
         self._lb, self._ub = lb, ub
         self._how = how
+
+    @staticmethod
+    def _gate_sides(lflat, rtab, behavior, lb, ub):
+        """Behavior on an interval join: both inputs share one clock
+        (reference: the global input frontier); ``delay`` holds a row until
+        clock >= t + delay, ``cutoff`` drops a row once it can no longer
+        match any on-time opposite row — left expires at t + ub + cutoff,
+        right at t - lb + cutoff (reference _interval_join.py behavior
+        thresholds over time_column.rs buffers)."""
+        if not isinstance(behavior, CommonBehavior):
+            raise TypeError(
+                f"interval_join supports common_behavior only, got {behavior!r}"
+            )
+        from ...engine.operators.time_gate import SharedClock
+
+        d = _num(behavior.delay) if behavior.delay is not None else None
+        c = _num(behavior.cutoff) if behavior.cutoff is not None else None
+        if d is None and c is None:
+            return lflat, rtab
+        clock = SharedClock()
+
+        def gate(tab, tref, expire_offset):
+            time_e = ApplyExpression(lambda t: _num(t), dt.FLOAT, args=(tref,))
+            rel = (
+                ApplyExpression(
+                    lambda t, d=d: _num(t) + d, dt.FLOAT, args=(tref,)
+                )
+                if d is not None
+                else None
+            )
+            exp = (
+                ApplyExpression(
+                    lambda t, o=expire_offset: _num(t) + o,
+                    dt.FLOAT,
+                    args=(tref,),
+                )
+                if c is not None
+                else None
+            )
+            gated, _op = tab._time_gate(time_e, rel, exp, clock=clock)
+            return gated
+
+        lflat = gate(lflat, this._pw_lt, (ub + c) if c is not None else None)
+        rtab = gate(rtab, this._pw_rt, (c - lb) if c is not None else None)
+        return lflat, rtab
 
     def select(self, *args, **kwargs) -> Table:
         lb, ub = self._lb, self._ub
@@ -366,7 +531,15 @@ class IntervalJoinResult:
             matched_left_keys = matched.select(_pw_m=this._pw_lid2).with_id(
                 this._pw_m
             )
-            unmatched = self._left.difference(matched_left_keys)
+            # pad only rows that SURVIVED the behavior gate: a cutoff-dropped
+            # or still-buffered row must not leak out as an unmatched pad
+            left_alive = self._left
+            if self._gated:
+                gated_ids = self._lflat.select(_pw_m=this._pw_lid).with_id(
+                    this._pw_m
+                )
+                left_alive = self._left.intersect(gated_ids)
+            unmatched = left_alive.difference(matched_left_keys)
             parts.append(
                 unmatched.select(
                     **{
@@ -383,7 +556,13 @@ class IntervalJoinResult:
             matched_right_keys = matched.select(_pw_m=this._pw_rid2).with_id(
                 this._pw_m
             )
-            unmatched = self._right.difference(matched_right_keys)
+            right_alive = self._right
+            if self._gated:
+                gated_rids = self._rtab.select(_pw_m=this._pw_rid).with_id(
+                    this._pw_m
+                )
+                right_alive = self._right.intersect(gated_rids)
+            unmatched = right_alive.difference(matched_right_keys)
             parts.append(
                 unmatched.select(
                     **{
@@ -445,23 +624,37 @@ def _remap(expr, table_map, null_tables=None):
 
 
 def interval_join(left, right, left_time, right_time, itv, *on, behavior=None, how=JoinMode.INNER):
-    return _interval_join_impl(left, right, left_time, right_time, itv, *on, how=how)
+    return _interval_join_impl(
+        left, right, left_time, right_time, itv, *on, how=how, behavior=behavior
+    )
 
 
-def interval_join_inner(left, right, left_time, right_time, itv, *on, **kw):
-    return _interval_join_impl(left, right, left_time, right_time, itv, *on, how=JoinMode.INNER)
+def interval_join_inner(left, right, left_time, right_time, itv, *on, behavior=None, **kw):
+    return _interval_join_impl(
+        left, right, left_time, right_time, itv, *on,
+        how=JoinMode.INNER, behavior=behavior,
+    )
 
 
-def interval_join_left(left, right, left_time, right_time, itv, *on, **kw):
-    return _interval_join_impl(left, right, left_time, right_time, itv, *on, how=JoinMode.LEFT)
+def interval_join_left(left, right, left_time, right_time, itv, *on, behavior=None, **kw):
+    return _interval_join_impl(
+        left, right, left_time, right_time, itv, *on,
+        how=JoinMode.LEFT, behavior=behavior,
+    )
 
 
-def interval_join_right(left, right, left_time, right_time, itv, *on, **kw):
-    return _interval_join_impl(left, right, left_time, right_time, itv, *on, how=JoinMode.RIGHT)
+def interval_join_right(left, right, left_time, right_time, itv, *on, behavior=None, **kw):
+    return _interval_join_impl(
+        left, right, left_time, right_time, itv, *on,
+        how=JoinMode.RIGHT, behavior=behavior,
+    )
 
 
-def interval_join_outer(left, right, left_time, right_time, itv, *on, **kw):
-    return _interval_join_impl(left, right, left_time, right_time, itv, *on, how=JoinMode.OUTER)
+def interval_join_outer(left, right, left_time, right_time, itv, *on, behavior=None, **kw):
+    return _interval_join_impl(
+        left, right, left_time, right_time, itv, *on,
+        how=JoinMode.OUTER, behavior=behavior,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,303 @@
+"""Temporal behaviors: delay buffering, late-data cutoff, state forgetting,
+exactly-once windows — the scenarios of the reference's buffering/late-data
+suite (tests/integration/test_time_column.rs: postpone_core delays emission,
+ignore_late drops late rows, forget shrinks state, exactly-once emits one
+final result per window)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.engine.executor import Executor
+from pathway_tpu.engine.operators.io import InputSession, SourceOperator
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.keys import ref_scalar
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.stdlib.temporal import (
+    common_behavior,
+    exactly_once_behavior,
+    tumbling,
+    windowby,
+)
+
+
+def make_stream_table(**types):
+    names = list(types.keys())
+    dtypes = {k: dt.wrap(v) for k, v in types.items()}
+    session = InputSession(upsert=True)
+    et = pw.G.engine_graph.add_table(names, "stream")
+    pw.G.engine_graph.add_operator(SourceOperator(et, session, dtypes, name="stream"))
+    return Table(et, dtypes, Universe(), short_name="stream"), session
+
+
+def make_executor():
+    ex = Executor(pw.G.engine_graph)
+    pw.G.engine_graph.finalize()
+    return ex
+
+
+def rows_of(table):
+    keys, cols = table._materialize()
+    names = sorted(cols.keys())
+    return sorted(
+        tuple(cols[n][i] for n in names) for i in range(len(keys))
+    )
+
+
+def win_counts(table):
+    """[(window_start, count), ...] sorted."""
+    keys, cols = table._materialize()
+    return sorted(
+        (float(cols["start"][i]), int(cols["c"][i])) for i in range(len(keys))
+    )
+
+
+def test_delay_buffers_until_clock_passes():
+    t, session = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=tumbling(duration=10.0),
+        behavior=common_behavior(delay=5.0),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    ex = make_executor()
+
+    # t=3: release threshold = window_start(0) + 5 = 5 > clock(3) -> held
+    session.insert(int(ref_scalar(1)), (3.0,))
+    ex.step()
+    assert win_counts(out) == []
+
+    # t=6 advances the clock past 5: the held row and the new one both emit
+    session.insert(int(ref_scalar(2)), (6.0,))
+    ex.step()
+    assert win_counts(out) == [(0.0, 2)]
+
+
+def test_delay_flushes_on_stream_end():
+    t, session = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=tumbling(duration=10.0),
+        behavior=common_behavior(delay=100.0),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    ex = make_executor()
+    session.insert(int(ref_scalar(1)), (3.0,))
+    session.insert(int(ref_scalar(2)), (4.0,))
+    session.close()
+    ex.run()  # drains, then flush_end releases the buffer
+    assert win_counts(out) == [(0.0, 2)]
+
+
+def test_cutoff_drops_late_rows_and_shrinks_state():
+    t, session = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=tumbling(duration=10.0),
+        behavior=common_behavior(cutoff=2.0),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    gop = out._engine_table.producer
+    ex = make_executor()
+
+    session.insert(int(ref_scalar(1)), (5.0,))
+    ex.step()
+    assert win_counts(out) == [(0.0, 1)]
+
+    # clock jumps to 25: window [0,10) expired at 12
+    session.insert(int(ref_scalar(2)), (25.0,))
+    ex.step()
+    assert win_counts(out) == [(0.0, 1), (20.0, 1)]
+
+    # a late row for the expired window is dropped, result unchanged
+    session.insert(int(ref_scalar(3)), (5.5,))
+    ex.step()
+    assert win_counts(out) == [(0.0, 1), (20.0, 1)]
+
+    # state for the expired window was forgotten (sweep lags one tick)
+    ex.step()
+    ex.step()
+    assert len(gop._groups) == 1  # only window [20,30) retains state
+
+    # on-time rows for the live window still update it
+    session.insert(int(ref_scalar(4)), (26.0,))
+    ex.step()
+    assert win_counts(out) == [(0.0, 1), (20.0, 2)]
+
+
+def test_cutoff_keep_results_false_retracts_frozen_windows():
+    t, session = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=tumbling(duration=10.0),
+        behavior=common_behavior(cutoff=2.0, keep_results=False),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    ex = make_executor()
+
+    session.insert(int(ref_scalar(1)), (5.0,))
+    ex.step()
+    assert win_counts(out) == [(0.0, 1)]
+
+    session.insert(int(ref_scalar(2)), (25.0,))
+    ex.step()
+    ex.step()  # lagged sweep runs with clock=25
+    assert win_counts(out) == [(20.0, 1)]  # frozen window withdrawn
+
+
+def test_exactly_once_emits_one_final_result():
+    t, session = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=tumbling(duration=10.0),
+        behavior=exactly_once_behavior(),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    ex = make_executor()
+    emissions = []
+    orig = out._engine_table.store.apply
+
+    def spy(delta):
+        emissions.append(
+            [(int(d), float(s)) for d, s in zip(delta.diffs, delta.columns["start"])]
+        )
+        return orig(delta)
+
+    out._engine_table.store.apply = spy
+
+    session.insert(int(ref_scalar(1)), (1.0,))
+    ex.step()
+    session.insert(int(ref_scalar(2)), (5.0,))
+    ex.step()
+    assert win_counts(out) == []  # buffered: window not closed yet
+
+    session.insert(int(ref_scalar(3)), (11.0,))
+    ex.step()
+    assert win_counts(out) == [(0.0, 2)]
+
+    # late row arrives after the window closed: ignored, still exactly one
+    # emission for window 0
+    session.insert(int(ref_scalar(4)), (7.0,))
+    ex.step()
+    ex.step()
+    assert win_counts(out) == [(0.0, 2)]
+    win0 = [e for em in emissions for e in em if e[1] == 0.0]
+    assert win0 == [(1, 0.0)]  # one insertion, never retracted/reemitted
+
+
+def test_delay_with_updates_before_release():
+    """An upsert while the row is still buffered must not leak the old row."""
+    t, session = make_stream_table(t=float, v=int)
+    out = windowby(
+        t,
+        t.t,
+        window=tumbling(duration=10.0),
+        behavior=common_behavior(delay=5.0),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+        s=pw.reducers.sum(pw.this.v),
+    )
+    ex = make_executor()
+    session.insert(int(ref_scalar(1)), (3.0, 100))
+    ex.step()
+    session.insert(int(ref_scalar(1)), (3.0, 200))  # upsert while buffered
+    ex.step()
+    session.insert(int(ref_scalar(2)), (6.0, 1))
+    ex.step()
+    keys, cols = out._engine_table.store.to_columns()
+    assert len(keys) == 1
+    assert int(cols["c"][0]) == 2
+    assert int(cols["s"][0]) == 201  # 200 (updated) + 1, old 100 never counted
+
+
+def test_interval_join_cutoff_drops_late_rows():
+    from pathway_tpu.stdlib.temporal import interval, interval_join
+
+    lt_, ls = make_stream_table(t=float, a=str)
+    rt_, rs = make_stream_table(t=float, b=str)
+    out = interval_join(
+        lt_, rt_, lt_.t, rt_.t, interval(-2.0, 2.0),
+        behavior=common_behavior(cutoff=1.0),
+    ).select(a=lt_.a, b=rt_.b)
+    ex = make_executor()
+
+    ls.insert(int(ref_scalar("l1")), (10.0, "x"))
+    rs.insert(int(ref_scalar("r1")), (11.0, "p"))
+    ex.step()
+    assert rows_of(out) == [("x", "p")]
+
+    # clock advances far ahead on the right side (shared clock)
+    rs.insert(int(ref_scalar("r2")), (100.0, "q"))
+    ex.step()
+
+    # a late right row that would match l1 is dropped: l1 expired at
+    # t + ub + cutoff = 13 < 100
+    rs.insert(int(ref_scalar("r3")), (10.5, "late"))
+    ex.step()
+    assert rows_of(out) == [("x", "p")]
+
+
+def test_interval_join_delay_buffers():
+    from pathway_tpu.stdlib.temporal import interval, interval_join
+
+    lt_, ls = make_stream_table(t=float, a=str)
+    rt_, rs = make_stream_table(t=float, b=str)
+    out = interval_join(
+        lt_, rt_, lt_.t, rt_.t, interval(-2.0, 2.0),
+        behavior=common_behavior(delay=5.0),
+    ).select(a=lt_.a, b=rt_.b)
+    ex = make_executor()
+
+    ls.insert(int(ref_scalar("l1")), (10.0, "x"))
+    rs.insert(int(ref_scalar("r1")), (11.0, "p"))
+    ex.step()
+    assert rows_of(out) == []  # both held: release at t+5 > clock 11
+
+    rs.insert(int(ref_scalar("r2")), (16.0, "z"))
+    ex.step()
+    assert rows_of(out) == [("x", "p")]  # clock 16 releases both
+
+
+def test_session_window_behavior_raises():
+    import pytest as _pytest
+    from pathway_tpu.stdlib.temporal import session
+
+    t, _session = make_stream_table(t=float)
+    wt = windowby(
+        t, t.t, window=session(max_gap=1.0), behavior=common_behavior(cutoff=1.0)
+    )
+    with _pytest.raises(NotImplementedError):
+        wt.reduce(c=pw.reducers.count())
+
+
+def test_interval_join_left_cutoff_no_padded_leak():
+    """A cutoff-dropped late left row must not surface as an unmatched
+    padded output row (LEFT join pads against gate survivors only)."""
+    from pathway_tpu.internals.table import JoinMode
+    from pathway_tpu.stdlib.temporal import interval, interval_join
+
+    lt_, ls = make_stream_table(t=float, a=str)
+    rt_, rs = make_stream_table(t=float, b=str)
+    out = interval_join(
+        lt_, rt_, lt_.t, rt_.t, interval(-0.5, 0.5),
+        behavior=common_behavior(cutoff=1.0), how=JoinMode.LEFT,
+    ).select(lt_t=lt_.t, a=lt_.a, b=rt_.b)
+    ex = make_executor()
+
+    ls.insert(int(ref_scalar("l1")), (100.0, "x"))
+    rs.insert(int(ref_scalar("r1")), (100.0, "p"))
+    ex.step()
+    # rows_of orders columns alphabetically: (a, b, lt_t)
+    assert ("x", "p", 100.0) in rows_of(out)
+
+    # late left row far past its cutoff: no match AND no padded row
+    ls.insert(int(ref_scalar("l2")), (1.0, "late"))
+    ex.step()
+    ex.step()
+    assert all(r[0] != "late" for r in rows_of(out)), rows_of(out)
+
+    # an on-time unmatched left row still pads
+    ls.insert(int(ref_scalar("l3")), (101.0, "solo"))
+    ex.step()
+    assert any(r[0] == "solo" and r[1] is None for r in rows_of(out)), rows_of(out)
