@@ -4,10 +4,14 @@
 
 The reference spawns N engine processes that form a timely cluster over
 TCP (PATHWAY_PROCESS_ID / PATHWAY_PROCESSES / PATHWAY_FIRST_PORT).  The
-TPU-native analog launches the same user program once per host process and
-exports both the PATHWAY_* topology variables and the jax.distributed
-coordinates (process 0 is the coordinator), so ``pw.parallel`` can
-initialize a multi-host mesh over ICI/DCN instead of a socket cluster.
+TPU-native analog launches the same user program once per host process; each
+process's ``pw.run()`` consumes the exported topology via
+``pathway_tpu.parallel.distributed.maybe_initialize()`` — process 0 hosts
+the jax coordination service at PATHWAY_COORDINATOR_ADDRESS and the
+processes form ONE global device mesh (collectives over ICI/DCN, gloo on
+CPU) instead of a socket cluster.  See parallel/distributed.py for the
+execution model and tests/test_distributed.py for the 2-process parity
+tests.
 """
 
 from __future__ import annotations
@@ -33,12 +37,9 @@ def _topology_env(
     env["PATHWAY_PROCESS_ID"] = str(process_id)
     env["PATHWAY_PROCESSES"] = str(processes)
     env["PATHWAY_FIRST_PORT"] = str(first_port)
-    # jax.distributed coordinates (multi-host mesh over DCN); process 0 hosts
-    # the coordinator service
+    # consumed by parallel/distributed.maybe_initialize() (called from
+    # pw.run()): process 0 hosts the jax coordination service here
     env["PATHWAY_COORDINATOR_ADDRESS"] = f"127.0.0.1:{first_port}"
-    env["JAX_COORDINATOR_ADDRESS"] = env["PATHWAY_COORDINATOR_ADDRESS"]
-    env["JAX_NUM_PROCESSES"] = str(processes)
-    env["JAX_PROCESS_ID"] = str(process_id)
     return env
 
 
@@ -117,7 +118,7 @@ def _add_spawn_args(p: argparse.ArgumentParser) -> None:
         "--first-port",
         type=int,
         default=10000,
-        help="coordinator port (process i uses first_port+i)",
+        help="port of the coordination service hosted by process 0",
     )
     p.add_argument("program")
     p.add_argument("arguments", nargs=argparse.REMAINDER)

@@ -39,6 +39,13 @@ def run(
     **kwargs,
 ) -> None:
     global _current_executor
+    # Join the process cluster first (no-op unless PATHWAY_PROCESSES > 1, set
+    # by `pathway-tpu spawn` — the reference consumes the same topology vars
+    # in Config::from_env, src/engine/dataflow/config.rs:104-121); must happen
+    # before any jax backend touch so the mesh spans every host's devices.
+    from ..parallel import distributed
+
+    distributed.maybe_initialize()
     # Incremental-run support: operators added after a previous run() are
     # bootstrapped with snapshot deltas of their already-populated inputs
     # (the eager-building analog of the reference's tree-shaken re-runs,

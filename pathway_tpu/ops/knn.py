@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..internals.keys import KEY_DTYPE
+from ..parallel.mesh import global_zeros, host_to_global, is_multiprocess
 from .topk import local_score_topk, sharded_topk
 
 __all__ = ["DeviceKnnIndex", "normalize_metric"]
@@ -83,6 +84,10 @@ class DeviceKnnIndex:
         self.mesh = mesh
         self._lock = threading.RLock()
         self.n_shards = mesh.shape["data"] if mesh is not None else 1
+        # multi-host mesh: host-side device_put can't target non-addressable
+        # devices — all transfers go through host_to_global / jitted creation
+        # (SPMD replicas supply identical host data; see parallel/distributed)
+        self._multiproc = mesh is not None and is_multiprocess(mesh)
         cap = max(initial_capacity, self.n_shards * 8)
         cap = self._round_capacity(cap)
         self.capacity = cap
@@ -110,11 +115,29 @@ class DeviceKnnIndex:
 
     def _device_zeros(self, shape, dtype=None):
         dtype = dtype or self.dtype
-        arr = jnp.zeros(shape, dtype=dtype)
-        if self.mesh is not None:
-            spec = P("data", None) if len(shape) == 2 else P("data")
-            arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
-        return arr
+        if self.mesh is None:
+            return jnp.zeros(shape, dtype=dtype)
+        spec = P("data", None) if len(shape) == 2 else P("data")
+        return global_zeros(shape, dtype, self.mesh, spec)
+
+    def _to_mesh(self, value, spec=P()):
+        """Host (or local-device) data → array usable in jit on this index's
+        mesh; replicated by default.  No-op for data already on the mesh."""
+        if self.mesh is None:
+            return value if isinstance(value, jax.Array) else jnp.asarray(value)
+        if (
+            isinstance(value, jax.Array)
+            and getattr(value.sharding, "mesh", None) == self.mesh
+        ):
+            return value
+        if not self._multiproc and isinstance(value, jax.Array):
+            return value  # single-process: jit can reshard local arrays
+        if isinstance(value, jax.Array) and not value.is_fully_addressable:
+            raise ValueError(
+                "device array lives on a different multi-process mesh than "
+                "this index — re-shard it onto the index mesh first"
+            )
+        return host_to_global(np.asarray(value), self.mesh, spec)
 
     def __len__(self) -> int:
         return len(self.key_to_slot)
@@ -123,14 +146,31 @@ class DeviceKnnIndex:
     def _grow(self, needed: int) -> None:
         new_cap = self._round_capacity(max(self.capacity * 2, self.capacity + needed))
         old_cap = self.capacity
-        new_matrix = self._device_zeros((new_cap, self.dimension))
-        new_valid = self._device_zeros((new_cap,), dtype=jnp.bool_)
-        # copy rows (device-side concat keeps data in HBM)
-        new_matrix = jax.lax.dynamic_update_slice(new_matrix, self._matrix, (0, 0))
-        new_valid = jax.lax.dynamic_update_slice(new_valid, self._valid, (0,))
-        if self.mesh is not None:
-            new_matrix = jax.device_put(new_matrix, self._sharding(True))
-            new_valid = jax.device_put(new_valid, self._sharding(False))
+        dim = self.dimension
+        dtype = self.dtype
+        if self.mesh is None:
+            # device-side copy keeps data in HBM
+            new_matrix = jax.lax.dynamic_update_slice(
+                jnp.zeros((new_cap, dim), dtype), self._matrix, (0, 0)
+            )
+            new_valid = jax.lax.dynamic_update_slice(
+                jnp.zeros((new_cap,), jnp.bool_), self._valid, (0,)
+            )
+        else:
+            # jitted grow with explicit out_shardings: stays sharded, works on
+            # multi-process meshes where host-side device_put cannot re-pin
+            new_matrix = jax.jit(
+                lambda m: jax.lax.dynamic_update_slice(
+                    jnp.zeros((new_cap, dim), dtype), m, (0, 0)
+                ),
+                out_shardings=self._sharding(True),
+            )(self._matrix)
+            new_valid = jax.jit(
+                lambda v: jax.lax.dynamic_update_slice(
+                    jnp.zeros((new_cap,), jnp.bool_), v, (0,)
+                ),
+                out_shardings=self._sharding(False),
+            )(self._valid)
         self._matrix = new_matrix
         self._valid = new_valid
         self.slot_to_key = np.concatenate(
@@ -184,12 +224,34 @@ class DeviceKnnIndex:
             if len(self._free) < len(keys):
                 self._grow(len(keys) - len(self._free))
             slots = np.array([self._free.pop() for _ in keys], dtype=np.int32)
-            norms_dev = jnp.linalg.norm(vectors.astype(jnp.float32), axis=1)
-            if self.metric == "cos":
-                safe = jnp.where(norms_dev == 0, 1.0, norms_dev)
-                vectors = (vectors.astype(jnp.float32) / safe[:, None]).astype(
-                    self.dtype
+            # route through the mesh first (multi-process: norms must come out
+            # replicated or the host fetch below would span non-addressable
+            # devices), then compute norms/normalisation on device
+            vectors = self._to_mesh(vectors)
+            norm_fn = getattr(self, "_norm_fn_cache", None)
+            if norm_fn is None:
+                cos = self.metric == "cos"
+                dtype = self.dtype
+
+                def _norms_and_rows(v):
+                    norms = jnp.linalg.norm(v.astype(jnp.float32), axis=1)
+                    if cos:
+                        safe = jnp.where(norms == 0, 1.0, norms)
+                        v = (v.astype(jnp.float32) / safe[:, None]).astype(dtype)
+                    return norms, v
+
+                out_sh = (
+                    None
+                    if self.mesh is None
+                    else NamedSharding(self.mesh, P())
                 )
+                norm_fn = (
+                    jax.jit(_norms_and_rows)
+                    if out_sh is None
+                    else jax.jit(_norms_and_rows, out_shardings=(out_sh, out_sh))
+                )
+                self._norm_fn_cache = norm_fn
+            norms_dev, vectors = norm_fn(vectors)
             if hasattr(norms_dev, "copy_to_host_async"):
                 norms_dev.copy_to_host_async()
             for key, slot in zip(keys, slots):
@@ -223,12 +285,36 @@ class DeviceKnnIndex:
             xp = jnp if on_device else np
             vectors = xp.concatenate([vectors, xp.repeat(vectors[:1], b - n, 0)])
         if not on_device:
-            vectors = jnp.asarray(vectors, dtype=self.dtype)
-        self._matrix = _scatter_rows(self._matrix, jnp.asarray(slots), vectors)
-        self._valid = _scatter_flags(self._valid, jnp.asarray(slots), valid)
-        if self.mesh is not None:
-            self._matrix = jax.device_put(self._matrix, self._sharding(True))
-            self._valid = jax.device_put(self._valid, self._sharding(False))
+            vectors = np.asarray(vectors, dtype=self.dtype)
+        slots_dev = self._to_mesh(np.asarray(slots))
+        vectors_dev = self._to_mesh(vectors)
+        if self.mesh is None:
+            self._matrix = _scatter_rows(self._matrix, slots_dev, vectors_dev)
+            self._valid = _scatter_flags(self._valid, slots_dev, valid)
+        else:
+            row_fn, flag_fn = self._scatter_jits()
+            self._matrix = row_fn(self._matrix, slots_dev, vectors_dev)
+            self._valid = flag_fn(self._valid, slots_dev, valid)
+
+    def _scatter_jits(self):
+        """Scatter fns with explicit sharded out_shardings (keeps the matrix
+        pinned to the mesh without a host-side device_put re-pin — required
+        on multi-process meshes, cheaper on single-process ones)."""
+        fns = getattr(self, "_scatter_fn_cache", None)
+        if fns is None:
+            fns = (
+                jax.jit(
+                    lambda m, s, r: m.at[s].set(r.astype(m.dtype)),
+                    out_shardings=self._sharding(True),
+                ),
+                jax.jit(
+                    lambda v, s, f: v.at[s].set(f),
+                    static_argnums=2,
+                    out_shardings=self._sharding(False),
+                ),
+            )
+            self._scatter_fn_cache = fns
+        return fns
 
     # -- search ------------------------------------------------------------
     def search(
@@ -255,7 +341,7 @@ class DeviceKnnIndex:
                 queries = np.concatenate(
                     [queries, np.zeros((b - nq, self.dimension), np.float32)]
                 )
-            q = jnp.asarray(queries, dtype=self.dtype)
+            q = self._to_mesh(queries.astype(self.dtype, copy=False))
             scores, idx = self._run_search(q, k_eff)
             # overlap the two d2h copies (each sync fetch costs a full RTT on
             # tunneled TPUs — see ops/serving.py)

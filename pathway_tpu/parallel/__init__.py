@@ -8,10 +8,14 @@ data placement is ``NamedSharding``, and the exchange is XLA collectives over
 ICI (SURVEY.md §5.8).
 """
 
+from . import distributed
 from .mesh import (
     current_mesh,
     data_axis_size,
     device_count,
+    global_zeros,
+    host_to_global,
+    is_multiprocess,
     make_mesh,
     replicated,
     set_mesh,
@@ -20,6 +24,7 @@ from .mesh import (
 )
 
 __all__ = [
+    "distributed",
     "make_mesh",
     "current_mesh",
     "set_mesh",
@@ -28,4 +33,7 @@ __all__ = [
     "shard_rows",
     "shard_cols",
     "replicated",
+    "is_multiprocess",
+    "host_to_global",
+    "global_zeros",
 ]

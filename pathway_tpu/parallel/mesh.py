@@ -26,6 +26,9 @@ __all__ = [
     "shard_rows",
     "shard_cols",
     "replicated",
+    "is_multiprocess",
+    "host_to_global",
+    "global_zeros",
 ]
 
 _lock = threading.Lock()
@@ -94,3 +97,39 @@ def shard_cols(mesh: Optional[Mesh] = None) -> NamedSharding:
 def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
     mesh = mesh or current_mesh()
     return NamedSharding(mesh, P())
+
+
+def is_multiprocess(mesh: Mesh) -> bool:
+    """True when the mesh spans devices of more than one host process (the
+    multi-host path: jax.distributed initialized, devices not all
+    addressable)."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def host_to_global(value, mesh: Mesh, spec) -> jax.Array:
+    """Put host data onto a (possibly multi-process) mesh.
+
+    Single-process meshes use a plain device_put.  On a multi-process mesh
+    ``device_put`` cannot target non-addressable devices, so the global array
+    is assembled from per-process local data — SPMD replicas all hold the
+    full host value (see parallel/distributed.py execution model) and each
+    process contributes the shards it can address."""
+    sharding = NamedSharding(mesh, spec)
+    arr = np.asarray(value)
+    if not is_multiprocess(mesh):
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, arr, global_shape=arr.shape
+    )
+
+
+def global_zeros(shape, dtype, mesh: Mesh, spec) -> jax.Array:
+    """Allocate a zero-filled global array directly on the mesh (works on
+    multi-process meshes, where host-side device_put cannot)."""
+    sharding = NamedSharding(mesh, spec)
+    import jax.numpy as jnp
+
+    return jax.jit(
+        lambda: jnp.zeros(shape, dtype=dtype), out_shardings=sharding
+    )()

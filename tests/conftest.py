@@ -18,6 +18,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: spawns subprocess clusters / long-running"
+    )
+
+
 @pytest.fixture(autouse=True)
 def fresh_graph():
     import pathway_tpu as pw

@@ -1,0 +1,127 @@
+"""Subprocess entry point for multi-process tests.
+
+The reference runs its "multi-node" CI by forking N processes with
+PATHWAY_PROCESSES/PATHWAY_PROCESS_ID env vars and letting them form a timely
+TCP cluster (python/pathway/tests/utils.py:599-660).  The jax-native analog:
+each scenario here is launched N times by tests/test_distributed.py with the
+topology env set; ``distributed.maybe_initialize()`` joins them into one
+jax process cluster whose global mesh spans every process's (virtual CPU)
+devices, with gloo cross-process collectives.
+
+Usage: python -m tests.dist_worker <scenario>
+Topology comes from PATHWAY_* env vars.  Emits one `RESULT <json>` line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def knn_scenario(mesh) -> list:
+    """Shared index workload: grow + remove + upsert + search.  Run both by
+    the N-process cluster (global mesh) and in-process by the oracle (local
+    8-device mesh) — results must be identical."""
+    import numpy as np
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    rng = np.random.default_rng(7)
+    dim = 16
+    index = DeviceKnnIndex(
+        dimension=dim, metric="cos", initial_capacity=32, mesh=mesh
+    )
+    vectors = rng.normal(size=(100, dim)).astype(np.float32)
+    index.add(list(range(1, 101)), vectors)  # forces a grow past 64
+    index.remove(list(range(1, 11)))
+    index.add([5], vectors[:1] * 0.5)  # re-add after remove (upsert path)
+    queries = rng.normal(size=(7, dim)).astype(np.float32)
+    rows = index.search(queries, k=5)
+    return [[[int(k), round(float(s), 4)] for k, s in row] for row in rows]
+
+
+def scenario_knn() -> dict:
+    import jax
+
+    from pathway_tpu.parallel import distributed, make_mesh
+
+    distributed.maybe_initialize()
+    mesh = make_mesh()
+    result = knn_scenario(mesh)
+    distributed.barrier("knn_done")
+    return {
+        "proc": jax.process_index(),
+        "nproc": jax.process_count(),
+        "ndev": len(jax.devices()),
+        "res": result,
+    }
+
+
+def scenario_control_plane() -> dict:
+    """barrier + coordinator broadcast (the commit-tick control plane)."""
+    import jax
+
+    from pathway_tpu.parallel import distributed
+
+    distributed.maybe_initialize()
+    distributed.barrier("start")
+    payload = None
+    if distributed.is_coordinator():
+        payload = {"commit_ts": 123456, "mode": "persisting"}
+    payload = distributed.broadcast_obj(payload, name="tick0")
+    distributed.barrier("end")
+    return {"proc": jax.process_index(), "payload": payload}
+
+
+def scenario_engine() -> dict:
+    """A full pw pipeline under the cluster: pw.run() itself must join the
+    cluster (internals/run.py wiring) — SPMD host replicas computing the
+    identical wordcount result."""
+    import pathway_tpu as pw
+
+    table = pw.debug.table_from_markdown(
+        """
+        word  | cnt
+        alpha | 1
+        beta  | 2
+        alpha | 3
+        gamma | 4
+        beta  | 5
+        """
+    )
+    result = table.groupby(table.word).reduce(
+        table.word, total=pw.reducers.sum(table.cnt)
+    )
+    pw.run(monitoring_level=None)
+    import jax
+
+    keys, columns = result._materialize()
+    rows = sorted(
+        (str(columns["word"][i]), int(columns["total"][i]))
+        for i in range(len(keys))
+    )
+    from pathway_tpu.parallel import distributed
+
+    return {
+        "proc": jax.process_index(),
+        "nproc": jax.process_count(),
+        "rows": rows,
+    }
+
+
+SCENARIOS = {
+    "knn": scenario_knn,
+    "control_plane": scenario_control_plane,
+    "engine": scenario_engine,
+}
+
+
+def main() -> int:
+    scenario = sys.argv[1]
+    out = SCENARIOS[scenario]()
+    print("RESULT " + json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

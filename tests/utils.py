@@ -1,13 +1,86 @@
 """Test helpers (reference: python/pathway/tests/utils.py — T(),
-assert_table_equality[_wo_index], stream assertion helpers)."""
+assert_table_equality[_wo_index], stream assertion helpers, and the
+fork-based multi-process cluster harness at utils.py:599-660)."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import pathway_tpu as pw
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_cluster(
+    scenario: str,
+    processes: int = 2,
+    local_devices: int = 4,
+    timeout: float = 180.0,
+    env_extra: Optional[Dict[str, str]] = None,
+) -> List[dict]:
+    """Launch `processes` copies of tests/dist_worker.py forming one jax
+    process cluster on virtual CPU devices; returns each process's RESULT
+    payload (sorted by rank).  Mirrors the reference's fork-based
+    multi-process test pattern (tests/utils.py:599-660), with subprocess
+    spawn instead of fork — jax runtime threads do not survive fork."""
+    port = free_port()
+    procs = []
+    for pid in range(processes):
+        env = dict(os.environ)
+        env.pop("PYTEST_CURRENT_TEST", None)
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={local_devices}"
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PATHWAY_PROCESSES"] = str(processes)
+        env["PATHWAY_PROCESS_ID"] = str(pid)
+        env["PATHWAY_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        if env_extra:
+            env.update(env_extra)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "tests.dist_worker", scenario],
+                cwd=REPO_ROOT,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    failures = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        payload = None
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                payload = json.loads(line[len("RESULT ") :])
+        if proc.returncode != 0 or payload is None:
+            failures.append(
+                f"rank {pid} rc={proc.returncode}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
+            )
+        else:
+            results.append(payload)
+    assert not failures, "cluster workers failed:\n" + "\n---\n".join(failures)
+    return sorted(results, key=lambda r: r.get("proc", 0))
 
 
 def T(txt: str, **kwargs) -> pw.Table:
