@@ -50,10 +50,13 @@ def spawn_program(
     processes: int = 1,
     first_port: int = 10000,
     env_extra: Optional[Dict[str, str]] = None,
+    timeout: Optional[float] = None,
 ) -> int:
-    """Launch ``processes`` copies of ``program``; returns the worst exit
-    code.  A failing process tears the others down (the reference's
-    all-pods-must-be-present model, SURVEY §5.3)."""
+    """Launch ``processes`` copies of ``program``; returns the first
+    non-zero exit code observed (the teardown cause), or 0 if all succeed.
+    A failing process tears the others down (the reference's
+    all-pods-must-be-present model, SURVEY §5.3).  ``timeout`` (seconds):
+    kill the whole cluster and return 124 if it's still running then."""
     handles: List[subprocess.Popen] = []
     try:
         for pid in range(processes):
@@ -67,6 +70,7 @@ def spawn_program(
         # immediately, even while lower-index members are still running
         import time as _time
 
+        deadline = _time.time() + timeout if timeout else None
         exit_code = 0
         live = list(handles)
         terminated = False
@@ -84,6 +88,11 @@ def spawn_program(
                     for other in live:
                         if other.poll() is None:
                             other.send_signal(signal.SIGTERM)
+            if live and deadline is not None and _time.time() > deadline:
+                for h in live:
+                    if h.poll() is None:
+                        h.kill()
+                return 124
             if live and not progressed:
                 _time.sleep(0.05)
         return exit_code

@@ -67,9 +67,18 @@ class JoinOperator(EngineOperator):
         kind: str = JoinKind.INNER,
         assign_id_from: Optional[str] = None,
         exact_match: bool = False,
+        warn_unmatched_left: bool = False,
         name: str = "join",
     ):
         super().__init__([left, right], output, name)
+        # non-optional ix: the reference raises on an unresolved pointer; the
+        # incremental engine keeps the row out of the output (it may match
+        # later) but warns at tick end so lookup bugs stay loud (round-1
+        # advice).  Warning is deferred to on_tick_end because within a tick
+        # the left delta may simply be processed before the right one.
+        self.warn_unmatched_left = warn_unmatched_left
+        self._unres_left: set = set()
+        self._warned_unres: set = set()
         self.left_key_exprs = list(left_key_exprs)
         self.right_key_exprs = list(right_key_exprs)
         self.left_ctx_cols = dict(left_ctx_cols)
@@ -245,10 +254,17 @@ class JoinOperator(EngineOperator):
                     if pad_other and own_before == 0:
                         # other side's rows were padded; retract padded forms
                         emit_bucket(other_bucket, None, None, -1)
+                    if not left_port and self.warn_unmatched_left:
+                        # right insert resolved these left rows
+                        self._unres_left.difference_update(other_bucket.keys())
                 elif pad_own:
                     emit_pad_own(key, row, 1)
+                elif left_port and self.warn_unmatched_left:
+                    self._unres_left.add(key)
                 own_bucket[key] = row
             else:
+                if left_port and self.warn_unmatched_left:
+                    self._unres_left.discard(key)
                 own_bucket.pop(key, None)
                 own_after = len(own_bucket)
                 if other_bucket:
@@ -262,6 +278,22 @@ class JoinOperator(EngineOperator):
         if not acc_diff:
             return None
         return self._assemble(acc_l, acc_r, acc_lrow, acc_rrow, acc_diff)
+
+    def on_tick_end(self, ts: int):
+        if self.warn_unmatched_left and self._unres_left != self._warned_unres:
+            if self._unres_left:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s: %d row(s) currently have unresolved pointers and are "
+                    "absent from the output (non-optional ix promises every "
+                    "pointer resolves; pass optional=True to keep unmatched "
+                    "rows with None columns)",
+                    self.name,
+                    len(self._unres_left),
+                )
+            self._warned_unres = set(self._unres_left)
+        return None
 
 
 class AsofNowJoinOperator(JoinOperator):

@@ -37,6 +37,7 @@ class PathwayConfig:
     # observability
     monitoring_server: Optional[str] = os.environ.get("PATHWAY_MONITORING_SERVER")
     metrics_port: int = int(os.environ.get("PATHWAY_METRICS_PORT", "20000"))
+    metrics_host: str = os.environ.get("PATHWAY_METRICS_HOST", "127.0.0.1")
     # licensing: this framework is fully open — accepted and ignored
     license_key: Optional[str] = os.environ.get("PATHWAY_LICENSE_KEY")
 

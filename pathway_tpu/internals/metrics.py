@@ -56,9 +56,15 @@ def render_metrics(graph) -> str:
 
 
 class MetricsServer:
-    def __init__(self, graph, port: Optional[int] = None):
+    def __init__(
+        self, graph, port: Optional[int] = None, host: Optional[str] = None
+    ):
         cfg = get_config()
         self.graph = graph
+        # loopback by default (the reference binds 127.0.0.1 too,
+        # http_server.rs:98); set PATHWAY_METRICS_HOST=0.0.0.0 for external
+        # scraping
+        self.host = host or getattr(cfg, "metrics_host", "127.0.0.1")
         self.port = (
             port
             if port is not None
@@ -99,7 +105,7 @@ class MetricsServer:
             def log_message(self, *args):  # pragma: no cover
                 pass
 
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="pw-metrics"

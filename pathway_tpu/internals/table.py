@@ -570,6 +570,7 @@ class Table:
             right_ctx_cols={},
             kind=JoinKind.LEFT if optional else JoinKind.INNER,
             assign_id_from="left",
+            warn_unmatched_left=not optional,
             name="ix",
         )
         _add_op(op)

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .knn import _bucket
+
 __all__ = ["FusedEncodeSearch"]
 
 
@@ -88,6 +90,18 @@ class FusedEncodeSearch:
             ids, mask = self.encoder.tokenizer.encode_batch(texts)
             ids = np.asarray(ids)
             mask = np.asarray(mask)
+            n_real = ids.shape[0]
+            # pad the batch to a bucket so B in the compile key takes a
+            # handful of values (matches encoder.encode's padding; round-1
+            # advice: distinct len(texts) must not each recompile the fused fn)
+            b = _bucket(n_real)
+            if b > n_real:
+                ids = np.concatenate(
+                    [ids, np.zeros((b - n_real, ids.shape[1]), ids.dtype)]
+                )
+                mask = np.concatenate(
+                    [mask, np.zeros((b - n_real, mask.shape[1]), mask.dtype)]
+                )
             B, L = ids.shape
             fn = self._compiled(B, L, k_eff, index.capacity)
             out = fn(
@@ -95,7 +109,7 @@ class FusedEncodeSearch:
             )
             if hasattr(out, "copy_to_host_async"):
                 out.copy_to_host_async()
-            out = np.asarray(out)
+            out = np.asarray(out)[:n_real]
             scores = out[:, :k_eff]
             idx = np.ascontiguousarray(out[:, k_eff:]).view(np.int32)
             results: List[List[Tuple[int, float]]] = []

@@ -60,22 +60,32 @@ def spawn_cluster(
                 text=True,
             )
         )
+    import time
+
     results = []
     failures = []
+    deadline = time.time() + timeout
     for pid, proc in enumerate(procs):
+        timed_out = False
         try:
-            out, err = proc.communicate(timeout=timeout)
+            out, err = proc.communicate(timeout=max(1.0, deadline - time.time()))
         except subprocess.TimeoutExpired:
+            # one rank hanging (usually blocked on a crashed peer) — kill the
+            # whole cluster, then still collect every rank's output so the
+            # ORIGINAL crash traceback surfaces, not an opaque timeout
+            timed_out = True
             for p in procs:
-                p.kill()
-            raise
+                if p.poll() is None:
+                    p.kill()
+            out, err = proc.communicate()
         payload = None
         for line in out.splitlines():
             if line.startswith("RESULT "):
                 payload = json.loads(line[len("RESULT ") :])
-        if proc.returncode != 0 or payload is None:
+        if timed_out or proc.returncode != 0 or payload is None:
+            status = "TIMEOUT" if timed_out else f"rc={proc.returncode}"
             failures.append(
-                f"rank {pid} rc={proc.returncode}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
+                f"rank {pid} {status}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
             )
         else:
             results.append(payload)
