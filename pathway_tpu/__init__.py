@@ -68,6 +68,18 @@ __version__ = "0.1.0"
 def reset() -> None:
     """Clear the global computation graph (fresh build)."""
     G.clear()
+    from .internals.error_log import clear_error_log
+
+    clear_error_log()
+
+
+def global_error_log() -> list:
+    """Row-level errors recorded this run (reference pw.global_error_log —
+    error-log table routing, src/engine/error.rs:337); see
+    internals/error_log.py."""
+    from .internals.error_log import global_error_log as _gel
+
+    return _gel()
 
 
 # ---------------------------------------------------------------------------

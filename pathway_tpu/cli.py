@@ -92,7 +92,11 @@ def spawn_program(
                 for h in live:
                     if h.poll() is None:
                         h.kill()
-                return 124
+                for h in live:
+                    h.wait()
+                # keep an already-observed failure code as the cause; 124
+                # only when the timeout itself is the first failure
+                return exit_code or 124
             if live and not progressed:
                 _time.sleep(0.05)
         return exit_code

@@ -136,6 +136,10 @@ class EngineGraph:
 
     def add_operator(self, op: EngineOperator) -> EngineOperator:
         self.operators.append(op)
+        if op.trace is None:
+            from ..internals.trace import trace_user_frame
+
+            op.trace = trace_user_frame()
         from .operators.io import SourceOperator  # local import to avoid cycle
 
         if isinstance(op, SourceOperator):
@@ -180,7 +184,12 @@ class EngineGraph:
             if delta.n == 0 and port >= 0:
                 continue
             t0 = _time.perf_counter_ns()
-            out = op.process(port, delta, ts)
+            try:
+                out = op.process(port, delta, ts)
+            except Exception as exc:
+                from ..internals.trace import reraise_with_trace
+
+                reraise_with_trace(op, exc)
             op.process_ns += _time.perf_counter_ns() - t0
             op.rows_in += delta.n
             if out is not None and out.n > 0 and op.output is not None:
@@ -217,13 +226,25 @@ class EngineGraph:
         """Run on_tick_end hooks (time-based operators may release buffers)."""
         pending: List[Tuple[EngineOperator, int, Delta]] = []
         for op in sorted(self.operators, key=lambda o: o.topo_index):
-            self._collect(op, op.on_tick_end(ts), pending)
+            try:
+                out = op.on_tick_end(ts)
+            except Exception as exc:
+                from ..internals.trace import reraise_with_trace
+
+                reraise_with_trace(op, exc)
+            self._collect(op, out, pending)
         if pending:
             self.propagate(pending, ts)
 
     def flush_end(self, ts: int) -> None:
         pending: List[Tuple[EngineOperator, int, Delta]] = []
         for op in sorted(self.operators, key=lambda o: o.topo_index):
-            self._collect(op, op.on_end(), pending)
+            try:
+                out = op.on_end()
+            except Exception as exc:
+                from ..internals.trace import reraise_with_trace
+
+                reraise_with_trace(op, exc)
+            self._collect(op, out, pending)
         if pending:
             self.propagate(pending, ts)

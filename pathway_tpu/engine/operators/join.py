@@ -267,6 +267,15 @@ class JoinOperator(EngineOperator):
                     self._unres_left.discard(key)
                 own_bucket.pop(key, None)
                 own_after = len(own_bucket)
+                if (
+                    not left_port
+                    and self.warn_unmatched_left
+                    and own_after == 0
+                    and other_bucket
+                ):
+                    # last right row for this key retracted: the surviving
+                    # left rows are unmatched again
+                    self._unres_left.update(other_bucket.keys())
                 if other_bucket:
                     emit_bucket(other_bucket, key, row, -1)
                     if pad_other and own_after == 0 and own_before > 0:

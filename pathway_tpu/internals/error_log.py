@@ -1,0 +1,65 @@
+"""Global error log — per-run record of row-level errors.
+
+The reference routes operator errors into a dedicated error-log table a user
+can subscribe to (src/engine/error.rs:337 DataError + error-log routing;
+``pw.global_error_log()``).  Here row-level failures become ``Error`` cells
+(internals/error_value.py) that keep flowing — and every creation site also
+appends an entry here, so users and tests can inspect *what* failed and
+*where* without fishing cells out of downstream tables.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .trace import Trace
+
+__all__ = ["ErrorLogEntry", "log_error", "global_error_log", "clear_error_log"]
+
+logger = logging.getLogger("pathway_tpu.errors")
+
+_MAX_ENTRIES = 10_000
+_lock = threading.Lock()
+_entries: deque = deque(maxlen=_MAX_ENTRIES)
+
+
+@dataclass(frozen=True)
+class ErrorLogEntry:
+    message: str
+    operator: Optional[str] = None
+    trace: Optional[Trace] = None
+    extra: dict = field(default_factory=dict)
+
+    def __str__(self) -> str:
+        loc = f" at {self.trace}" if self.trace else ""
+        src = f" [{self.operator}]" if self.operator else ""
+        return f"{self.message}{src}{loc}"
+
+
+def log_error(
+    message: str,
+    *,
+    operator: Optional[str] = None,
+    trace: Optional[Trace] = None,
+    **extra,
+) -> ErrorLogEntry:
+    entry = ErrorLogEntry(message, operator, trace, extra)
+    with _lock:
+        _entries.append(entry)
+    logger.debug("row error: %s", entry)
+    return entry
+
+
+def global_error_log() -> list:
+    """Entries logged so far this process (most recent last)."""
+    with _lock:
+        return list(_entries)
+
+
+def clear_error_log() -> None:
+    with _lock:
+        _entries.clear()

@@ -263,7 +263,11 @@ def _rowwise(fn, *arrays, n: int) -> np.ndarray:
         try:
             out[i] = fn(*args)
         except Exception as e:
-            out[i] = Error(str(e))
+            from .error_log import log_error
+
+            message = f"{type(e).__name__}: {e}"
+            log_error(message, operator="expression")
+            out[i] = Error(message)
     return out
 
 
@@ -391,6 +395,21 @@ class ApplyExpression(ColumnExpression):
         self._batched = batched
         self._propagate_none = propagate_none
         self._deps = self._args + tuple(self._kwargs.values())
+        # user frame of the apply/udf call site — failing rows name this line
+        # (reference: trace.py frames attached per expression)
+        from .trace import trace_user_frame
+
+        self._trace = trace_user_frame()
+
+    def _row_error(self, exc: Exception):
+        from .error_log import log_error
+        from .error_value import Error
+
+        fn_name = getattr(self._fun, "__name__", "<udf>")
+        loc = f" (udf {fn_name} applied at {self._trace})" if self._trace else ""
+        message = f"{type(exc).__name__}: {exc}{loc}"
+        log_error(message, operator="apply", trace=self._trace)
+        return Error(message)
 
     def _eval(self, ctx: EvalContext) -> np.ndarray:
         arg_arrays = [a._eval(ctx) for a in self._args]
@@ -436,7 +455,7 @@ class ApplyExpression(ColumnExpression):
                 except Exception as e:
                     errored = True
                     if out.dtype == object:
-                        out[i] = Error(str(e))
+                        out[i] = self._row_error(e)
                     else:
                         out[i] = 0
         if errored and out.dtype != object:
@@ -451,7 +470,7 @@ class ApplyExpression(ColumnExpression):
                 try:
                     out2[i] = self._fun(*args_i, **kwargs_i)
                 except Exception as e:
-                    out2[i] = Error(str(e))
+                    out2[i] = self._row_error(e)
             return out2
         return out
 
@@ -475,11 +494,14 @@ class AsyncApplyExpression(ApplyExpression):
                 )
                 for i in range(ctx.n)
             ]
-            return await asyncio.gather(*coros)
+            return await asyncio.gather(*coros, return_exceptions=True)
 
         results = asyncio.run(run_all())
         out = np.empty(ctx.n, dtype=object)
-        out[:] = results
+        out[:] = [
+            self._row_error(r) if isinstance(r, BaseException) else r
+            for r in results
+        ]
         return out
 
 

@@ -1,0 +1,138 @@
+"""Error-path tests: Error cells, user-frame traces, the global error log
+(reference: python/pathway/tests/test_errors.py + test_error_messages.py;
+trace machinery internals/trace.py, re-raise graph_runner/__init__.py:218-230)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.error_value import is_error
+from pathway_tpu.internals.trace import EngineErrorWithTrace
+
+from .utils import T, run_all
+
+
+def test_failing_udf_error_cell_names_user_line():
+    t = T(
+        """
+        a
+        1
+        0
+        """
+    )
+
+    def inv(x):
+        return 10 // x
+
+    out = t.select(r=pw.apply(inv, pw.this.a))  # TRACE_LINE
+    run_all()
+    _, cols = out._materialize()
+    values = {repr(v) if is_error(v) else v for v in cols["r"]}
+    errs = [v for v in cols["r"] if is_error(v)]
+    assert 10 in {v for v in cols["r"] if not is_error(v)}
+    assert len(errs) == 1
+    message = errs[0].message
+    # the Error cell names the udf, this file, and the select call line
+    assert "inv" in message
+    assert "test_errors.py" in message
+    with open(__file__) as f:
+        src = f.read()
+    trace_line = src[: src.index("# TRACE_LINE")].count("\n") + 1
+    assert f":{trace_line}" in message
+
+
+def test_failing_udf_appears_in_global_error_log():
+    t = T(
+        """
+        a
+        0
+        """
+    )
+    t.select(r=pw.apply(lambda x: 1 // x, pw.this.a))
+    run_all()
+    log = pw.global_error_log()
+    assert any("ZeroDivisionError" in e.message for e in log)
+    entry = [e for e in log if "ZeroDivisionError" in e.message][-1]
+    assert entry.trace is not None and "test_errors.py" in entry.trace.file
+
+
+def test_error_cells_propagate_and_filters_drop_them():
+    t = T(
+        """
+        a
+        2
+        0
+        """
+    )
+    r = t.select(r=pw.apply(lambda x: 4 // x, pw.this.a))
+    r2 = r.select(double=pw.this.r * 2)  # depends on an Error cell
+    kept = r.filter(pw.this.r == 2)
+    run_all()
+    _, cols2 = r2._materialize()
+    assert sum(1 for v in cols2["double"] if is_error(v)) == 1
+    _, colsk = kept._materialize()
+    assert list(colsk["r"]) == [2]
+
+
+def test_async_udf_failure_becomes_error_cell():
+    t = T(
+        """
+        a
+        1
+        0
+        """
+    )
+
+    @pw.udf_async
+    async def ainv(x: int) -> int:
+        return 10 // x
+
+    out = t.select(r=ainv(pw.this.a))
+    run_all()
+    _, cols = out._materialize()
+    errs = [v for v in cols["r"] if is_error(v)]
+    assert len(errs) == 1
+    assert "ZeroDivisionError" in errs[0].message
+    assert 10 in [v for v in cols["r"] if not is_error(v)]
+
+
+def test_operator_crash_reraised_with_build_site_trace():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    out = t.select(b=pw.this.a + 1)  # BUILD_LINE
+    op = out._engine_table.producer
+    assert op is not None and op.trace is not None
+    assert "test_errors.py" in op.trace.file
+
+    def boom(port, delta, ts):
+        raise RuntimeError("kaput")
+
+    op.process = boom
+    with pytest.raises(EngineErrorWithTrace) as ei:
+        run_all()
+    message = str(ei.value)
+    assert "kaput" in message
+    assert "test_errors.py" in message
+    with open(__file__) as f:
+        src = f.read()
+    build_line = src[: src.index("# BUILD_LINE")].count("\n") + 1
+    assert f":{build_line}" in message
+
+
+def test_reset_clears_error_log():
+    t = T(
+        """
+        a
+        0
+        """
+    )
+    t.select(r=pw.apply(lambda x: 1 // x, pw.this.a))
+    run_all()
+    assert pw.global_error_log()
+    pw.reset()
+    assert pw.global_error_log() == []
