@@ -56,7 +56,8 @@ def spawn_program(
     non-zero exit code observed (the teardown cause), or 0 if all succeed.
     A failing process tears the others down (the reference's
     all-pods-must-be-present model, SURVEY §5.3).  ``timeout`` (seconds):
-    kill the whole cluster and return 124 if it's still running then."""
+    kill anything still running then; returns 124 only when the timeout is
+    the first failure (an earlier member's non-zero code wins)."""
     handles: List[subprocess.Popen] = []
     try:
         for pid in range(processes):

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..internals.keys import KEY_DTYPE
+from ..internals.trace import reraise_with_trace
 from .delta import Delta, RowStore, empty_delta
 
 __all__ = ["EngineTable", "EngineOperator", "EngineGraph", "OutputCallbacks"]
@@ -187,8 +188,6 @@ class EngineGraph:
             try:
                 out = op.process(port, delta, ts)
             except Exception as exc:
-                from ..internals.trace import reraise_with_trace
-
                 reraise_with_trace(op, exc)
             op.process_ns += _time.perf_counter_ns() - t0
             op.rows_in += delta.n
@@ -229,8 +228,6 @@ class EngineGraph:
             try:
                 out = op.on_tick_end(ts)
             except Exception as exc:
-                from ..internals.trace import reraise_with_trace
-
                 reraise_with_trace(op, exc)
             self._collect(op, out, pending)
         if pending:
@@ -242,8 +239,6 @@ class EngineGraph:
             try:
                 out = op.on_end()
             except Exception as exc:
-                from ..internals.trace import reraise_with_trace
-
                 reraise_with_trace(op, exc)
             self._collect(op, out, pending)
         if pending:

@@ -248,10 +248,11 @@ def _is_object(arr: np.ndarray) -> bool:
     return arr.dtype == object
 
 
-def _rowwise(fn, *arrays, n: int) -> np.ndarray:
+def _rowwise(fn, *arrays, n: int, trace=None) -> np.ndarray:
     """Per-row loop with reference error semantics: a failing row yields an
     Error cell instead of aborting the batch (Value::Error,
-    /root/reference/src/engine/value.rs:225)."""
+    /root/reference/src/engine/value.rs:225).  ``trace`` (the expression's
+    build-site user frame) flows into the Error message and the error log."""
     from .error_value import ERROR, Error, is_error
 
     out = np.empty(n, dtype=object)
@@ -266,7 +267,9 @@ def _rowwise(fn, *arrays, n: int) -> np.ndarray:
             from .error_log import log_error
 
             message = f"{type(e).__name__}: {e}"
-            log_error(message, operator="expression")
+            if trace is not None:
+                message = f"{message} (expression built at {trace})"
+            log_error(message, operator="expression", trace=trace)
             out[i] = Error(message)
     return out
 
@@ -325,11 +328,14 @@ _FLOAT_DIV_OPS = {operator.truediv}
 
 class ColumnBinaryOpExpression(ColumnExpression):
     def __init__(self, left, right, op, symbol: str):
+        from .trace import trace_user_frame
+
         self._left = smart_coerce(left)
         self._right = smart_coerce(right)
         self._op = op
         self._symbol = symbol
         self._deps = (self._left, self._right)
+        self._trace = trace_user_frame()
 
     def __repr__(self):
         return f"({self._left!r} {self._symbol} {self._right!r})"
@@ -342,8 +348,8 @@ class ColumnBinaryOpExpression(ColumnExpression):
             if op in (operator.and_, operator.or_):
                 # python bools use and/or semantics on object columns
                 pyop = (lambda a, b: a and b) if op is operator.and_ else (lambda a, b: a or b)
-                return _rowwise(pyop, l, r, n=ctx.n)
-            return _rowwise(op, l, r, n=ctx.n)
+                return _rowwise(pyop, l, r, n=ctx.n, trace=self._trace)
+            return _rowwise(op, l, r, n=ctx.n, trace=self._trace)
         try:
             if op is operator.floordiv and np.issubdtype(l.dtype, np.integer):
                 if np.any(r == 0):
@@ -352,24 +358,27 @@ class ColumnBinaryOpExpression(ColumnExpression):
                 raise ZeroDivisionError("integer modulo by zero")
             return op(l, r)
         except TypeError:
-            return _rowwise(op, l, r, n=ctx.n)
+            return _rowwise(op, l, r, n=ctx.n, trace=self._trace)
 
 
 class ColumnUnaryOpExpression(ColumnExpression):
     def __init__(self, expr, op, symbol: str):
+        from .trace import trace_user_frame
+
         self._expr = smart_coerce(expr)
         self._op = op
         self._symbol = symbol
         self._deps = (self._expr,)
+        self._trace = trace_user_frame()
 
     def _eval(self, ctx: EvalContext) -> np.ndarray:
         v = self._expr._eval(ctx)
         if self._op is operator.not_:
             if _is_object(v):
-                return _rowwise(lambda x: not x, v, n=ctx.n)
+                return _rowwise(lambda x: not x, v, n=ctx.n, trace=self._trace)
             return ~v.astype(bool)
         if _is_object(v):
-            return _rowwise(self._op, v, n=ctx.n)
+            return _rowwise(self._op, v, n=ctx.n, trace=self._trace)
         return self._op(v)
 
 
@@ -483,41 +492,66 @@ class AsyncApplyExpression(ApplyExpression):
     def _eval(self, ctx: EvalContext) -> np.ndarray:
         import asyncio
 
+        from .error_value import ERROR, is_error
+
         arg_arrays = [a._eval(ctx) for a in self._args]
         kwarg_arrays = {k: v._eval(ctx) for k, v in self._kwargs.items()}
+        out = np.empty(ctx.n, dtype=object)
+        # mirror the sync path's input handling: Error inputs propagate as
+        # ERROR without invoking the UDF; None propagates when requested
+        run_rows = []
+        for i in range(ctx.n):
+            args_i = [a[i] for a in arg_arrays]
+            kwargs_i = {k: v[i] for k, v in kwarg_arrays.items()}
+            if any(is_error(a) for a in args_i) or any(
+                is_error(v) for v in kwargs_i.values()
+            ):
+                out[i] = ERROR
+            elif self._propagate_none and (
+                any(a is None for a in args_i)
+                or any(v is None for v in kwargs_i.values())
+            ):
+                out[i] = None
+            else:
+                run_rows.append((i, args_i, kwargs_i))
 
         async def run_all():
             coros = [
-                self._fun(
-                    *(a[i] for a in arg_arrays),
-                    **{k: v[i] for k, v in kwarg_arrays.items()},
-                )
-                for i in range(ctx.n)
+                self._fun(*args_i, **kwargs_i) for _, args_i, kwargs_i in run_rows
             ]
             return await asyncio.gather(*coros, return_exceptions=True)
 
-        results = asyncio.run(run_all())
-        out = np.empty(ctx.n, dtype=object)
-        out[:] = [
-            self._row_error(r) if isinstance(r, BaseException) else r
-            for r in results
-        ]
+        if run_rows:
+            results = asyncio.run(run_all())
+            for (i, _, _), r in zip(run_rows, results):
+                if isinstance(r, Exception):
+                    out[i] = self._row_error(r)
+                elif isinstance(r, BaseException):
+                    raise r  # cancellation/system exit must not become data
+                else:
+                    out[i] = r
         return out
 
 
 class IfElseExpression(ColumnExpression):
     def __init__(self, if_, then, else_):
+        from .trace import trace_user_frame
+
         self._if = smart_coerce(if_)
         self._then = smart_coerce(then)
         self._else = smart_coerce(else_)
         self._deps = (self._if, self._then, self._else)
+        self._trace = trace_user_frame()
 
     def _eval(self, ctx: EvalContext) -> np.ndarray:
         c = self._if._eval(ctx)
         t = self._then._eval(ctx)
         e = self._else._eval(ctx)
         if _is_object(t) or _is_object(e) or _is_object(c):
-            return _rowwise(lambda ci, ti, ei: ti if ci else ei, c, t, e, n=ctx.n)
+            return _rowwise(
+                lambda ci, ti, ei: ti if ci else ei,
+                c, t, e, n=ctx.n, trace=self._trace,
+            )
         return np.where(c.astype(bool), t, e)
 
 
@@ -711,12 +745,15 @@ class MethodCallExpression(ColumnExpression):
         return_type: Any = None,
         vector_fun: Optional[Callable] = None,
     ):
+        from .trace import trace_user_frame
+
         self._method_name = name
         self._args = tuple(smart_coerce(a) for a in args)
         self._fun = fun
         self._vector_fun = vector_fun
         self._return_type = dt.wrap(return_type) if return_type is not None else dt.ANY
         self._deps = self._args
+        self._trace = trace_user_frame()
 
     def _eval(self, ctx: EvalContext) -> np.ndarray:
         arrays = [a._eval(ctx) for a in self._args]
@@ -732,4 +769,4 @@ class MethodCallExpression(ColumnExpression):
                 out[i] = self._fun(*(a[i] for a in arrays))
             return out
         except Exception:
-            return _rowwise(self._fun, *arrays, n=ctx.n)
+            return _rowwise(self._fun, *arrays, n=ctx.n, trace=self._trace)

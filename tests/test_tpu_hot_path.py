@@ -1,0 +1,298 @@
+"""TPU hot-path tests on the virtual 8-device mesh (VERDICT r2 #2): the
+mesh-sharded KNN index vs a numpy oracle, sharded_topk vs dense top-k, the
+fused serving path vs its unfused composition, and shape/determinism checks
+for all four models.  Reference bar: python/pathway/tests/external_index/."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.models.clip import ClipModel
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.models.generator import TextGenerator
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.serving import FusedEncodeSearch
+from pathway_tpu.ops.topk import local_score_topk, merge_topk, sharded_topk
+from pathway_tpu.parallel import make_mesh
+
+
+# ---------------------------------------------------------------------------
+# numpy oracle for the index
+# ---------------------------------------------------------------------------
+
+
+class NumpyKnnOracle:
+    def __init__(self, dim: int, metric: str):
+        self.dim = dim
+        self.metric = metric
+        self.rows: dict[int, np.ndarray] = {}
+
+    def add(self, keys, vectors):
+        for k, v in zip(keys, np.asarray(vectors, np.float32)):
+            self.rows[int(k)] = v
+
+    def remove(self, keys):
+        for k in keys:
+            self.rows.pop(int(k), None)
+
+    def search(self, queries, k: int):
+        queries = np.asarray(queries, np.float32)
+        if not self.rows:
+            return [[] for _ in queries]
+        keys = sorted(self.rows)
+        mat = np.stack([self.rows[key] for key in keys])
+        if self.metric == "cos":
+            norms = np.linalg.norm(mat, axis=1, keepdims=True)
+            mat = mat / np.where(norms == 0, 1.0, norms)
+            qn = np.linalg.norm(queries, axis=1, keepdims=True)
+            queries = queries / np.where(qn == 0, 1.0, qn)
+            scores = queries @ mat.T
+        elif self.metric == "l2sq":
+            scores = -(
+                np.sum(queries**2, axis=1)[:, None]
+                - 2 * queries @ mat.T
+                + np.sum(mat**2, axis=1)[None, :]
+            )
+        else:
+            scores = queries @ mat.T
+        out = []
+        for row in scores:
+            order = np.argsort(-row)[: min(k, len(keys))]
+            out.append([int(keys[j]) for j in order])
+        return out
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+@pytest.mark.parametrize("metric", ["cos", "l2sq", "dot"])
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_knn_add_remove_upsert_grow_matches_oracle(metric, use_mesh, mesh):
+    rng = np.random.default_rng(42)
+    dim = 16
+    index = DeviceKnnIndex(
+        dimension=dim,
+        metric=metric,
+        initial_capacity=64,
+        mesh=mesh if use_mesh else None,
+    )
+    oracle = NumpyKnnOracle(dim, metric)
+
+    # phase 1: bulk add past initial capacity (forces _grow, odd batch sizes
+    # exercise the scatter bucket padding)
+    v1 = rng.normal(size=(90, dim)).astype(np.float32)
+    index.add(range(1, 91), v1)
+    oracle.add(range(1, 91), v1)
+    # phase 2: remove a slice
+    index.remove(range(10, 30))
+    oracle.remove(range(10, 30))
+    # phase 3: upsert (re-add existing keys with new vectors) + odd single add
+    v2 = rng.normal(size=(7, dim)).astype(np.float32)
+    index.add([1, 2, 3, 50, 60, 70, 200], v2)
+    oracle.add([1, 2, 3, 50, 60, 70, 200], v2)
+    assert len(index) == len(oracle.rows)
+
+    queries = rng.normal(size=(9, dim)).astype(np.float32)
+    got = index.search(queries, k=5)
+    want = oracle.search(queries, k=5)
+    assert [[k for k, _ in row] for row in got] == want
+    # scores descend
+    for row in got:
+        scores = [s for _, s in row]
+        assert scores == sorted(scores, reverse=True)
+
+
+def test_knn_remove_all_then_search_empty(mesh):
+    rng = np.random.default_rng(0)
+    index = DeviceKnnIndex(dimension=8, metric="cos", initial_capacity=64, mesh=mesh)
+    v = rng.normal(size=(10, 8)).astype(np.float32)
+    index.add(range(10), v)
+    index.remove(range(10))
+    assert len(index) == 0
+    assert index.search(v[:3], k=4) == [[], [], []]
+
+
+def test_knn_candidate_filter_and_oversampled():
+    rng = np.random.default_rng(1)
+    index = DeviceKnnIndex(dimension=8, metric="cos", initial_capacity=64)
+    v = rng.normal(size=(40, 8)).astype(np.float32)
+    index.add(range(40), v)
+    q = v[:2]
+    # allow-list path
+    allow = list(range(0, 40, 2))  # even keys only
+    rows = index.search(q, k=5, candidate_keys=[allow, allow])
+    for row in rows:
+        assert all(k % 2 == 0 for k, _ in row)
+    # oversampled accept-callback path returns k accepted
+    rows = index.search_oversampled(q, k=5, accept=lambda k: k % 2 == 1)
+    for row in rows:
+        assert len(row) == 5 and all(k % 2 == 1 for k, _ in row)
+
+
+def test_sharded_topk_matches_dense(mesh):
+    rng = np.random.default_rng(7)
+    n_shards = mesh.shape["data"]
+    N, d, B, k = n_shards * 16, 8, 4, 6
+    matrix = rng.normal(size=(N, d)).astype(np.float32)
+    valid = np.ones(N, bool)
+    valid[rng.choice(N, 10, replace=False)] = False
+    queries = rng.normal(size=(B, d)).astype(np.float32)
+
+    scores, idx = sharded_topk(
+        mesh, jnp.asarray(queries), jnp.asarray(matrix), jnp.asarray(valid), k
+    )
+    scores, idx = np.asarray(scores), np.asarray(idx)
+
+    dense = queries @ matrix.T
+    dense[:, ~valid] = -np.inf
+    for qi in range(B):
+        want = np.argsort(-dense[qi])[:k]
+        assert list(idx[qi]) == list(want)
+        np.testing.assert_allclose(scores[qi], dense[qi][want], rtol=1e-5)
+
+
+def test_merge_topk_global_ids():
+    # two shards of 4 rows; candidates carry local indices + offsets
+    all_scores = jnp.asarray(
+        [[[3.0, 1.0]], [[2.5, 2.0]]]  # shard 0: [B=1, k=2]; shard 1
+    )
+    all_idx = jnp.asarray([[[1, 0]], [[3, 2]]])
+    offsets = jnp.asarray([0, 4])
+    scores, ids = merge_topk(all_scores, all_idx, offsets, k=3)
+    assert list(np.asarray(ids)[0]) == [1, 7, 6]  # 3.0@1, 2.5@(4+3), 2.0@(4+2)
+    assert list(np.asarray(scores)[0]) == [3.0, 2.5, 2.0]
+
+
+def test_local_score_topk_k_larger_than_rows():
+    q = jnp.ones((2, 4))
+    m = jnp.eye(4)[:3]
+    valid = jnp.ones(3, bool)
+    scores, idx = local_score_topk(q, m, valid, k=5)
+    assert scores.shape == (2, 5) and idx.shape == (2, 5)
+    assert np.isneginf(np.asarray(scores)[:, 3:]).all()  # padded candidates
+
+
+# ---------------------------------------------------------------------------
+# fused serving path
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_encoder():
+    return SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+
+
+def test_fused_encode_search_matches_unfused(small_encoder):
+    enc = small_encoder
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    docs = [f"document number {i} about topic {i % 5}" for i in range(30)]
+    index.add(range(30), enc.encode(docs))
+    fused = FusedEncodeSearch(enc, index, k=4)
+
+    queries = ["topic 3 report", "document number 7", "something else"]
+    got = fused(queries)
+    want = index.search(enc.encode(queries), k=4)
+    assert [[k for k, _ in row] for row in got] == [
+        [k for k, _ in row] for row in want
+    ]
+    for grow, wrow in zip(got, want):
+        np.testing.assert_allclose(
+            [s for _, s in grow], [s for _, s in wrow], rtol=1e-4, atol=1e-5
+        )
+
+
+def test_fused_batch_sizes_share_compiles(small_encoder):
+    enc = small_encoder
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    index.add(range(10), enc.encode([f"d{i}" for i in range(10)]))
+    fused = FusedEncodeSearch(enc, index, k=3)
+    for n in (2, 3, 4):  # all bucket to 4
+        assert len(fused([f"q{j}" for j in range(n)])) == n
+    assert len(fused._fns) == 1, "batch sizes 2-4 must share one compile"
+
+
+# ---------------------------------------------------------------------------
+# models: shapes + determinism
+# ---------------------------------------------------------------------------
+
+
+def test_sentence_encoder_shapes_normalized_deterministic(small_encoder):
+    enc = small_encoder
+    texts = ["alpha beta", "gamma", ""]
+    out = enc.encode(texts)
+    assert out.shape == (3, 32) and out.dtype == np.float32
+    np.testing.assert_allclose(
+        np.linalg.norm(out[:2], axis=1), 1.0, rtol=1e-5
+    )
+    out2 = enc.encode(texts)
+    np.testing.assert_array_equal(out, out2)
+    # batch composition must not change a row's embedding (mask correctness)
+    solo = enc.encode(["alpha beta"])[0]
+    np.testing.assert_allclose(out[0], solo, rtol=1e-5, atol=1e-6)
+    assert enc.encode([]).shape == (0, 32)
+
+
+def test_sentence_encoder_mesh_matches_single_device(small_encoder, mesh):
+    sharded = SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32, mesh=mesh,
+    )
+    texts = [f"text {i}" for i in range(8)]
+    np.testing.assert_allclose(
+        small_encoder.encode(texts), sharded.encode(texts), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_cross_encoder_shapes_and_order_sensitivity():
+    ce = CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=32, vocab_size=512,
+        dtype=jnp.float32,
+    )
+    pairs = [("query one", "doc a"), ("query one", "doc b"), ("q2", "doc a")]
+    scores = ce.predict(pairs)
+    assert scores.shape == (3,) and scores.dtype == np.float32
+    np.testing.assert_array_equal(scores, ce.predict(pairs))
+    assert scores[0] != scores[1]  # different docs -> different scores
+    assert ce.predict([]).shape == (0,)
+
+
+def test_clip_text_image_shapes():
+    clip = ClipModel(
+        dimension=32, proj_dim=16, n_layers=1, n_heads=4,
+        image_size=32, patch=16, max_length=16, vocab_size=512,
+        dtype=jnp.float32,
+    )
+    t = clip.encode_text(["a cat", "a dog photo"])
+    assert t.shape == (2, 16)
+    np.testing.assert_allclose(np.linalg.norm(t, axis=1), 1.0, rtol=1e-5)
+    rng = np.random.default_rng(3)
+    imgs = [rng.random((32, 32, 3)), rng.random((40, 20))]  # grayscale too
+    im = clip.encode_image(imgs)
+    assert im.shape == (2, 16)
+    np.testing.assert_allclose(np.linalg.norm(im, axis=1), 1.0, rtol=1e-5)
+    # text/image share the embedding space: similarity matrix is finite
+    assert np.isfinite(t @ im.T).all()
+
+
+def test_text_generator_greedy_deterministic():
+    gen = TextGenerator(
+        dimension=32, n_layers=1, n_heads=4, max_length=64, vocab_size=512,
+        dtype=jnp.float32,
+    )
+    prompts = ["hello world", "the quick brown"]
+    a = gen.generate(prompts, max_new_tokens=4, temperature=0.0)
+    b = gen.generate(prompts, max_new_tokens=4, temperature=0.0)
+    assert a == b and len(a) == 2
+    assert all(isinstance(s, str) for s in a)
+    # sampling with a fixed seed is reproducible too
+    c = gen.generate(prompts, max_new_tokens=4, temperature=0.8, seed=5)
+    d = gen.generate(prompts, max_new_tokens=4, temperature=0.8, seed=5)
+    assert c == d
