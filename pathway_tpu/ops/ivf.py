@@ -1,0 +1,405 @@
+"""IVF approximate KNN — the sublinear tier above DeviceKnnIndex.
+
+The reference reserves approximate search for usearch HNSW
+(src/external_integration/usearch_integration.rs:20-42, f16-quantized
+graph walks).  Graph traversal is hostile to TPUs (pointer chasing, dynamic
+shapes); the TPU-idiomatic redesign is IVF:
+
+- **train**: k-means centroids fitted with matmul assignment steps (the
+  assignment [S, C] score matrix is one MXU matmul per iteration);
+- **build**: every row is assigned to its nearest centroid under a balance
+  cap, producing a padded inverted list ``members[C, M]`` of row slots;
+- **search**: one [B, d]x[d, C] matmul scores the centroids, ``lax.top_k``
+  picks the ``n_probe`` clusters per query, their member rows are gathered
+  and *exactly* rescored ([B, L, d] einsum), and a final top-k returns keys
+  — all inside one jitted function.
+
+Scoring FLOPs drop from B·N·d to B·(C + n_probe·M)·d: with the default
+C≈sqrt(N)·2 and n_probe=C/10 the shortlist is ~N/5 of the matrix at ≥0.95
+recall@10 on clustered embeddings (tests/test_ivf.py).  The exact
+DeviceKnnIndex stays the default below ~1M rows where brute force already
+meets the latency budget on the MXU.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .knn import _bucket, normalize_metric
+
+__all__ = ["IvfKnnIndex"]
+
+
+def _kmeans(
+    sample: np.ndarray, n_clusters: int, iters: int, seed: int
+) -> np.ndarray:
+    """k-means on device: assignment is a matmul+argmax per iteration;
+    centroid update is a host segment-mean (C·d small)."""
+    rng = np.random.default_rng(seed)
+    n = sample.shape[0]
+    n_clusters = min(n_clusters, n)
+    centroids = sample[rng.choice(n, size=n_clusters, replace=False)].copy()
+    sample_dev = jnp.asarray(sample)
+
+    @jax.jit
+    def assign(cents):
+        scores = jnp.dot(
+            sample_dev, cents.T, preferred_element_type=jnp.float32
+        )
+        return jnp.argmax(scores, axis=1)
+
+    for _ in range(iters):
+        owner = np.asarray(assign(jnp.asarray(centroids)))
+        sums = np.zeros_like(centroids)
+        np.add.at(sums, owner, sample)
+        counts = np.bincount(owner, minlength=n_clusters).astype(np.float32)
+        empty = counts == 0
+        counts[empty] = 1.0
+        centroids = sums / counts[:, None]
+        # re-seed empty clusters from random rows
+        if empty.any():
+            centroids[empty] = sample[
+                rng.choice(n, size=int(empty.sum()), replace=False)
+            ]
+        norms = np.linalg.norm(centroids, axis=1, keepdims=True)
+        centroids = centroids / np.where(norms == 0, 1.0, norms)
+    return centroids.astype(np.float32)
+
+
+class IvfKnnIndex:
+    """Incrementally maintained approximate KNN (same host API as
+    DeviceKnnIndex: add / remove / search / __len__).
+
+    Adds are buffered host-side; the device structures (centroids, padded
+    inverted lists, row matrix) are (re)built lazily at search time when the
+    index grew by more than ``rebuild_fraction`` since the last build.
+    Between rebuilds, fresh rows are still searchable: they are appended to a
+    small exact tail that is brute-force scored alongside the probed
+    shortlist (so results never miss recent writes — the as-of-now contract).
+    """
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: str = "cos",
+        n_clusters: Optional[int] = None,
+        n_probe: Optional[int] = None,
+        dtype=jnp.float32,
+        train_sample: int = 32768,
+        kmeans_iters: int = 8,
+        rebuild_fraction: float = 0.25,
+        seed: int = 0,
+    ):
+        self.dimension = dimension
+        self.metric = normalize_metric(metric)
+        if self.metric == "l2sq":
+            raise NotImplementedError(
+                "IvfKnnIndex supports cos/dot; use DeviceKnnIndex for l2sq"
+            )
+        self.dtype = dtype
+        self.n_clusters = n_clusters
+        self.n_probe = n_probe
+        self.train_sample = train_sample
+        self.kmeans_iters = kmeans_iters
+        self.rebuild_fraction = rebuild_fraction
+        self.seed = seed
+        self._lock = threading.RLock()
+        # host-of-record row store (rebuild source)
+        self._rows: Dict[int, np.ndarray] = {}
+        # device structures (built lazily)
+        self._built_keys: List[int] = []
+        self._matrix = None  # [N_pad, d]
+        self._valid = None  # [N_pad] bool (False after remove)
+        self._centroids = None  # [C, d]
+        self._members = None  # [C, M] int32 slots, -1 pad
+        self._slot_of_key: Dict[int, int] = {}
+        self._tail_keys: List[int] = []  # added since last build
+        self._built_n = 0
+        self._search_fns: Dict[tuple, Any] = {}
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    # -- mutation (host-of-record; device rebuilt lazily) ------------------
+    def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+        with self._lock:
+            vectors = np.asarray(vectors, np.float32).reshape(
+                len(keys), self.dimension
+            )
+            if self.metric == "cos":
+                norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+                vectors = vectors / np.where(norms == 0, 1.0, norms)
+            for key, vec in zip(keys, vectors):
+                key = int(key)
+                if key in self._rows:
+                    self._forget_built(key)
+                self._rows[key] = vec
+                self._tail_keys.append(key)
+
+    def remove(self, keys: Sequence[int]) -> None:
+        with self._lock:
+            for key in keys:
+                key = int(key)
+                if self._rows.pop(key, None) is not None:
+                    self._forget_built(key)
+
+    def _forget_built(self, key: int) -> None:
+        """Invalidate a key's built slot (upsert/remove path); also drop it
+        from the unbuilt tail if it only lived there."""
+        slot = self._slot_of_key.pop(key, None)
+        if slot is not None and self._valid is not None:
+            self._valid = self._valid.at[slot].set(False)
+        try:
+            self._tail_keys.remove(key)
+        except ValueError:
+            pass
+
+    # -- build -------------------------------------------------------------
+    def _needs_rebuild(self) -> bool:
+        if self._matrix is None:
+            return True
+        grown = len(self._rows) - self._built_n
+        return grown > max(64, self.rebuild_fraction * max(self._built_n, 1))
+
+    def build(self) -> None:
+        """(Re)train + assign: k-means on a sample, balanced inverted lists,
+        device upload.  Called automatically from search when stale."""
+        with self._lock:
+            n = len(self._rows)
+            if n == 0:
+                self._matrix = None
+                self._tail_keys = []
+                return
+            keys = list(self._rows.keys())
+            data = np.stack([self._rows[k] for k in keys])
+            C = self.n_clusters or int(
+                np.clip(2 * np.sqrt(n), 16, 65536)
+            )
+            rng = np.random.default_rng(self.seed)
+            sample_n = min(n, self.train_sample)
+            C = min(C, n, sample_n)
+            sample = data[rng.choice(n, size=sample_n, replace=False)]
+            self._centroids = _kmeans(sample, C, self.kmeans_iters, self.seed)
+
+            # balanced assignment: nearest centroid with a 2N/C cap; overflow
+            # rows fall to their next-best centroid (keeps M bounded so the
+            # gather shapes stay small).  Vectorized per preference rank —
+            # rows competing for one cluster are ranked by sort position and
+            # the first (cap - fill) win; losers retry at the next rank.
+            cap = max(1, int(np.ceil(2.0 * n / C)))
+            scores = np.asarray(
+                jnp.dot(jnp.asarray(data), jnp.asarray(self._centroids.T))
+            )
+            n_pref = min(8, C)
+            order = np.argsort(-scores, axis=1)[:, :n_pref]
+            counts = np.zeros(C, np.int64)
+            assignment = np.full(n, -1, np.int64)
+            unassigned = np.arange(n)
+            for r in range(n_pref):
+                if unassigned.size == 0:
+                    break
+                cand = order[unassigned, r]
+                sort_ix = np.argsort(cand, kind="stable")
+                cand_sorted = cand[sort_ix]
+                # within-cluster arrival rank of each competing row
+                starts = np.searchsorted(cand_sorted, cand_sorted, side="left")
+                within = np.arange(cand_sorted.size) - starts
+                accept = within < (cap - counts[cand_sorted])
+                winners = unassigned[sort_ix[accept]]
+                assignment[winners] = cand_sorted[accept]
+                np.add.at(counts, cand_sorted[accept], 1)
+                unassigned = unassigned[sort_ix[~accept]]
+            for i in unassigned:  # rare: all 8 preferred clusters full
+                c = int(np.argmin(counts))
+                assignment[i] = c
+                counts[c] += 1
+            M = int(counts.max())
+            members = np.full((C, M), -1, np.int32)
+            fill = np.zeros(C, np.int64)
+            for slot, c in enumerate(assignment):
+                members[c, fill[c]] = slot
+                fill[c] += 1
+
+            self._built_keys = keys
+            self._slot_of_key = {k: i for i, k in enumerate(keys)}
+            self._matrix = jnp.asarray(data, self.dtype)
+            self._valid = jnp.ones(n, dtype=jnp.bool_)
+            self._members = jnp.asarray(members)
+            self._tail_keys = []
+            self._built_n = n
+            self._search_fns.clear()
+
+    # -- search ------------------------------------------------------------
+    def search(
+        self, queries: np.ndarray, k: int, n_probe: Optional[int] = None
+    ) -> List[List[Tuple[int, float]]]:
+        with self._lock:
+            queries = np.asarray(queries, np.float32).reshape(-1, self.dimension)
+            nq = queries.shape[0]
+            if nq == 0 or not self._rows:
+                return [[] for _ in range(nq)]
+            if self._needs_rebuild():
+                self.build()
+            if self.metric == "cos":
+                norms = np.linalg.norm(queries, axis=1, keepdims=True)
+                queries = queries / np.where(norms == 0, 1.0, norms)
+            C = self._centroids.shape[0]
+            p = n_probe or self.n_probe or max(1, int(np.ceil(C / 10)))
+            p = min(p, C)
+            b = _bucket(nq)
+            if b > nq:
+                queries = np.concatenate(
+                    [queries, np.zeros((b - nq, self.dimension), np.float32)]
+                )
+            # exact tail of unbuilt recent rows, brute-force scored alongside
+            tail = [key for key in self._tail_keys if key in self._rows]
+            tail_mat = (
+                np.stack([self._rows[key] for key in tail])
+                if tail
+                else np.zeros((0, self.dimension), np.float32)
+            )
+            t_pad = _bucket(len(tail)) if tail else 0
+            if t_pad > len(tail):
+                tail_mat = np.concatenate(
+                    [tail_mat, np.zeros((t_pad - len(tail), self.dimension), np.float32)]
+                )
+            tail_valid = np.zeros(max(t_pad, 1), bool)
+            tail_valid[: len(tail)] = True
+            fn = self._search_fn(b, k, p, t_pad)
+            scores, slots, t_scores, t_idx = fn(
+                jnp.asarray(queries, self.dtype),
+                self._matrix,
+                self._valid,
+                self._centroids if isinstance(self._centroids, jnp.ndarray)
+                else jnp.asarray(self._centroids),
+                self._members,
+                jnp.asarray(tail_mat, self.dtype),
+                jnp.asarray(tail_valid[:t_pad] if t_pad else tail_valid[:0]),
+            )
+            scores = np.asarray(scores)[:nq]
+            slots = np.asarray(slots)[:nq]
+            t_scores = np.asarray(t_scores)[:nq] if t_pad else None
+            t_idx = np.asarray(t_idx)[:nq] if t_pad else None
+            out: List[List[Tuple[int, float]]] = []
+            for qi in range(nq):
+                row: List[Tuple[int, float]] = []
+                for j in range(slots.shape[1]):
+                    s = float(scores[qi, j])
+                    slot = int(slots[qi, j])
+                    if not np.isfinite(s) or slot < 0:
+                        continue
+                    key = self._built_keys[slot]
+                    if key in self._rows and key in self._slot_of_key:
+                        row.append((key, s))
+                if t_pad:
+                    for j in range(t_idx.shape[1]):
+                        s = float(t_scores[qi, j])
+                        ti = int(t_idx[qi, j])
+                        if np.isfinite(s) and ti < len(tail):
+                            row.append((tail[ti], s))
+                row.sort(key=lambda kv: -kv[1])
+                # drop duplicate keys (upsert landed in both built+tail)
+                seen = set()
+                dedup = []
+                for key, s in row:
+                    if key not in seen:
+                        seen.add(key)
+                        dedup.append((key, s))
+                out.append(dedup[:k])
+            return out
+
+    def _search_fn(self, B: int, k: int, p: int, t_pad: int):
+        key = (
+            B, k, p, t_pad,
+            self._matrix.shape[0],
+            self._centroids.shape[0],
+            self._members.shape[1],
+        )
+        fn = self._search_fns.get(key)
+        if fn is None:
+            M = self._members.shape[1]
+            k_main = min(k, p * M)
+            k_tail = min(k, t_pad) if t_pad else 0
+
+            @jax.jit
+            def fn(q, matrix, valid, centroids, members, tail_mat, tail_valid):
+                qf = q.astype(jnp.float32)
+                cscores = jnp.dot(
+                    qf, centroids.T, preferred_element_type=jnp.float32
+                )  # [B, C]
+                _, probe = jax.lax.top_k(cscores, p)  # [B, p]
+                cand = members[probe].reshape(B, p * M)  # [B, L]
+                safe = jnp.maximum(cand, 0)
+                rows = matrix[safe]  # [B, L, d] gather
+                scores = jnp.einsum(
+                    "bld,bd->bl",
+                    rows.astype(jnp.float32),
+                    qf,
+                    preferred_element_type=jnp.float32,
+                )
+                ok = (cand >= 0) & valid[safe]
+                scores = jnp.where(ok, scores, -jnp.inf)
+                s, i = jax.lax.top_k(scores, k_main)
+                slots = jnp.where(
+                    jnp.isfinite(s), jnp.take_along_axis(cand, i, axis=1), -1
+                )
+                if t_pad:
+                    ts = jnp.dot(
+                        qf, tail_mat.T.astype(jnp.float32),
+                        preferred_element_type=jnp.float32,
+                    )
+                    # mask pad rows: a 0.0 pad score would outrank real rows
+                    # with negative similarity
+                    ts = jnp.where(tail_valid[None, :], ts, -jnp.inf)
+                    t_s, t_i = jax.lax.top_k(ts, k_tail)
+                else:
+                    t_s = jnp.zeros((B, 0), jnp.float32)
+                    t_i = jnp.zeros((B, 0), jnp.int32)
+                return s, slots, t_s, t_i
+
+            self._search_fns[key] = fn
+        return self._search_fns[key]
+
+    def search_oversampled(
+        self,
+        queries: np.ndarray,
+        k: int,
+        accept,  # callable(key) -> bool
+        oversample: int = 4,
+        max_rounds: int = 3,
+    ) -> List[List[Tuple[int, float]]]:
+        """Filtered search by over-sampling (same contract as
+        DeviceKnnIndex.search_oversampled): fetch oversample*k, drop rejected
+        rows, widen until satisfied or the index is exhausted."""
+        nq = np.asarray(queries).reshape(-1, self.dimension).shape[0]
+        results: List[List[Tuple[int, float]]] = [[] for _ in range(nq)]
+        kk = k * oversample
+        for _ in range(max_rounds):
+            rows = self.search(queries, kk)
+            done = True
+            for qi, row in enumerate(rows):
+                accepted = [(key, s) for key, s in row if accept(key)]
+                results[qi] = accepted[:k]
+                if len(accepted) < k and len(row) == kk:
+                    done = False
+            if done or kk >= max(len(self._rows), 1):
+                break
+            kk *= 4
+        return results
+
+    # diagnostics ----------------------------------------------------------
+    def score_flops_fraction(self) -> float:
+        """Fraction of brute-force scoring FLOPs a probed search performs
+        (centroid matmul + shortlist rescore vs full matrix)."""
+        if self._matrix is None or not len(self._rows):
+            return 1.0
+        C = self._centroids.shape[0]
+        M = self._members.shape[1]
+        p = self.n_probe or max(1, int(np.ceil(C / 10)))
+        n = self._matrix.shape[0]
+        return (C + min(p, C) * M + len(self._tail_keys)) / max(n, 1)

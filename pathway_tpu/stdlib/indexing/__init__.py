@@ -9,7 +9,18 @@ from __future__ import annotations
 
 try:
     from .data_index import DataIndex, InnerIndex
-    from .nearest_neighbors import BruteForceKnn, BruteForceKnnFactory, TpuKnn, TpuKnnFactory, USearchKnn, UsearchKnnFactory, LshKnn, LshKnnFactory
+    from .nearest_neighbors import (
+        BruteForceKnn,
+        BruteForceKnnFactory,
+        IvfKnn,
+        IvfKnnFactory,
+        LshKnn,
+        LshKnnFactory,
+        TpuKnn,
+        TpuKnnFactory,
+        USearchKnn,
+        UsearchKnnFactory,
+    )
     from .bm25 import TantivyBM25, TantivyBM25Factory, BM25Index
     from .hybrid_index import HybridIndex, HybridIndexFactory
     from .vector_document_index import (
@@ -37,6 +48,8 @@ __all__ = [
     "TpuKnnFactory",
     "USearchKnn",
     "UsearchKnnFactory",
+    "IvfKnn",
+    "IvfKnnFactory",
     "LshKnn",
     "LshKnnFactory",
     "TantivyBM25",

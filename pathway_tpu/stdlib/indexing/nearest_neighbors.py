@@ -20,6 +20,8 @@ from .filters import compile_filter
 __all__ = [
     "InnerIndexImpl",
     "DeviceKnn",
+    "DeviceIvfKnn",
+    "IvfKnn",
     "BruteForceKnn",
     "TpuKnn",
     "USearchKnn",
@@ -27,6 +29,7 @@ __all__ = [
     "BruteForceKnnFactory",
     "TpuKnnFactory",
     "UsearchKnnFactory",
+    "IvfKnnFactory",
     "LshKnnFactory",
 ]
 
@@ -86,6 +89,59 @@ class DeviceKnn(InnerIndexImpl):
         if all(f is None for f in filters):
             rows = self.index.search(vectors, k)
             return [tuple(row) for row in rows]
+        out: List[Tuple[Tuple[int, float], ...]] = []
+        for vec, fexpr in zip(vectors, filters):
+            if fexpr is None:
+                out.append(tuple(self.index.search(vec[None, :], k)[0]))
+                continue
+            accept_fn = compile_filter(str(fexpr))
+            rows = self.index.search_oversampled(
+                vec[None, :],
+                k,
+                accept=lambda key: accept_fn(self.metadata.get(int(key), {})),
+            )
+            out.append(tuple(rows[0]))
+        return out
+
+
+class DeviceIvfKnn(InnerIndexImpl):
+    """Approximate KNN for corpora past the exact index's comfort zone
+    (>~1M rows): IVF probing with exact shortlist rescore (ops/ivf.py).
+    Metadata filtering uses oversampling like DeviceKnn."""
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: str = "cos",
+        n_clusters: Optional[int] = None,
+        n_probe: Optional[int] = None,
+    ):
+        from ...ops.ivf import IvfKnnIndex
+
+        self.index = IvfKnnIndex(
+            dimension=dimension,
+            metric=metric,
+            n_clusters=n_clusters,
+            n_probe=n_probe,
+        )
+        self.metadata: Dict[int, Any] = {}
+
+    def add(self, keys, values, metadatas) -> None:
+        vectors = np.array([np.asarray(v, dtype=np.float32) for v in values])
+        self.index.add(keys, vectors)
+        for key, md in zip(keys, metadatas):
+            if md is not None:
+                self.metadata[int(key)] = md
+
+    def remove(self, keys) -> None:
+        self.index.remove(keys)
+        for key in keys:
+            self.metadata.pop(int(key), None)
+
+    def search(self, values, k, filters):
+        vectors = np.array([np.asarray(v, dtype=np.float32) for v in values])
+        if all(f is None for f in filters):
+            return [tuple(row) for row in self.index.search(vectors, k)]
         out: List[Tuple[Tuple[int, float], ...]] = []
         for vec, fexpr in zip(vectors, filters):
             if fexpr is None:
@@ -164,6 +220,33 @@ class UsearchKnnFactory(TpuKnnFactory):
     latency budget, so this is the same device index."""
 
 
+class IvfKnnFactory(_DeviceKnnFactory):
+    """Approximate IVF index (the reference's usearch-HNSW capability slot
+    re-designed for TPU; ops/ivf.py).  Use for corpora where exact MXU
+    scoring exceeds the latency budget (>~1M rows single chip)."""
+
+    def __init__(self, *args, n_clusters=None, n_probe=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.n_clusters = n_clusters
+        self.n_probe = n_probe
+
+    def build_inner_index(self, dimension: Optional[int] = None):
+        dim = dimension or self.dimension
+        if dim is None:
+            raise ValueError("index factory needs the embedding dimension")
+        inner = DeviceIvfKnn(
+            dimension=dim,
+            metric=self.metric,
+            n_clusters=self.n_clusters,
+            n_probe=self.n_probe,
+        )
+        if self.embedder is not None:
+            from .embedding_adapter import EmbeddingIndexAdapter
+
+            return EmbeddingIndexAdapter(inner, self.embedder)
+        return inner
+
+
 class LshKnnFactory(_DeviceKnnFactory):
     """Reference-name compatibility for the legacy LSH index
     (nearest_neighbors.py:262)."""
@@ -171,6 +254,7 @@ class LshKnnFactory(_DeviceKnnFactory):
 
 # class-style aliases used by reference code/configs
 BruteForceKnn = BruteForceKnnFactory
+IvfKnn = IvfKnnFactory
 TpuKnn = TpuKnnFactory
 USearchKnn = UsearchKnnFactory
 LshKnn = LshKnnFactory

@@ -1,0 +1,160 @@
+"""IVF approximate index tests (VERDICT r2 #6): recall@10 >= 0.95 vs exact
+with >= 5x scoring-FLOP reduction, plus incremental add/remove/upsert
+semantics.  Reference capability bar: usearch HNSW,
+src/external_integration/usearch_integration.rs:20-42."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.ops.ivf import IvfKnnIndex
+
+
+def clustered_corpus(
+    n: int, dim: int, n_centers: int, noise_norm: float = 0.7, seed: int = 0
+):
+    """Synthetic embedding-like corpus: mixture of gaussians on the sphere
+    with cluster noise of NORM ``noise_norm`` relative to the unit centers
+    (real text embeddings are strongly clustered; fully isotropic data is
+    the pathological case IVF is not designed for — there it degrades to
+    ~0.89 recall at the same 5x reduction)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_centers, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    which = rng.integers(0, n_centers, n)
+    noise = rng.normal(size=(n, dim)).astype(np.float32) * (
+        noise_norm / np.sqrt(dim)
+    )
+    return (centers[which] + noise).astype(np.float32)
+
+
+def exact_topk(data: np.ndarray, queries: np.ndarray, k: int):
+    dn = data / np.linalg.norm(data, axis=1, keepdims=True)
+    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    scores = qn @ dn.T
+    return np.argsort(-scores, axis=1)[:, :k]
+
+
+def test_recall_and_flop_reduction():
+    n, dim = 20000, 64
+    data = clustered_corpus(n, dim, n_centers=200)
+    index = IvfKnnIndex(
+        dimension=dim, metric="cos", n_clusters=400, n_probe=24, seed=1
+    )
+    index.add(range(n), data)
+    index.build()
+
+    rng = np.random.default_rng(5)
+    qidx = rng.choice(n, 50, replace=False)
+    queries = data[qidx] + 0.02 * rng.normal(size=(50, dim)).astype(np.float32)
+
+    truth = exact_topk(data, queries, k=10)
+    got = index.search(queries, k=10)
+    hits = sum(
+        len({key for key, _ in row} & set(truth[i].tolist()))
+        for i, row in enumerate(got)
+    )
+    recall = hits / (50 * 10)
+    assert recall >= 0.95, f"recall@10 = {recall:.3f}"
+
+    fraction = index.score_flops_fraction()
+    assert fraction <= 0.20, f"scoring flops fraction {fraction:.3f} (need >=5x)"
+
+
+def test_tail_rows_with_negative_similarity_found():
+    """Zero pad rows in the tail matrix must not outrank real fresh rows
+    whose cosine similarity is negative."""
+    dim = 8
+    rng = np.random.default_rng(9)
+    index = IvfKnnIndex(dimension=dim, metric="cos", n_clusters=4, n_probe=4)
+    base = rng.normal(size=(200, dim)).astype(np.float32)
+    index.add(range(200), base)
+    index.build()
+    index.remove(range(200))  # only fresh tail rows remain
+    v = np.zeros((1, dim), np.float32)
+    v[0, 0] = 1.0
+    index.add([500], -v)  # similarity to query v is -1 (< pad's 0.0)
+    row = index.search(v, k=1)[0]
+    assert row and row[0][0] == 500 and row[0][1] == pytest.approx(-1.0)
+
+
+def test_incremental_tail_visible_before_rebuild():
+    dim = 16
+    rng = np.random.default_rng(0)
+    index = IvfKnnIndex(dimension=dim, metric="cos", n_clusters=16, n_probe=4)
+    base = rng.normal(size=(500, dim)).astype(np.float32)
+    index.add(range(500), base)
+    index.build()
+    # fresh rows (below the rebuild threshold) must be searchable immediately
+    fresh = rng.normal(size=(3, dim)).astype(np.float32) * 5
+    index.add([1000, 1001, 1002], fresh)
+    for i in range(3):
+        row = index.search(fresh[i : i + 1], k=1)[0]
+        assert row and row[0][0] == 1000 + i
+
+
+def test_remove_and_upsert():
+    dim = 8
+    rng = np.random.default_rng(2)
+    data = rng.normal(size=(200, dim)).astype(np.float32)
+    index = IvfKnnIndex(dimension=dim, metric="cos", n_clusters=8, n_probe=8)
+    index.add(range(200), data)
+    index.build()
+    # self-NN before
+    assert index.search(data[:1], k=1)[0][0][0] == 0
+    index.remove([0])
+    assert len(index) == 199
+    row = index.search(data[:1], k=3)[0]
+    assert all(key != 0 for key, _ in row)
+    # upsert key 5 to a far-away vector; old vector must not match anymore
+    new_v = rng.normal(size=(1, dim)).astype(np.float32) * 10
+    index.add([5], new_v)
+    hit = index.search(new_v, k=1)[0]
+    assert hit and hit[0][0] == 5
+    old_row = index.search(data[5:6], k=1)[0]
+    assert not old_row or old_row[0][0] != 5
+
+
+def test_empty_and_full_probe():
+    index = IvfKnnIndex(dimension=4, metric="dot")
+    assert index.search(np.ones((2, 4)), k=3) == [[], []]
+    data = np.eye(4, dtype=np.float32)
+    index.add(range(4), data)
+    # n_probe larger than cluster count clamps
+    rows = index.search(data, k=2, n_probe=100)
+    assert [row[0][0] for row in rows] == [0, 1, 2, 3]
+
+
+def test_l2sq_rejected():
+    with pytest.raises(NotImplementedError):
+        IvfKnnIndex(dimension=4, metric="l2sq")
+
+
+def test_data_index_with_ivf_factory():
+    """IVF plugs into the DataIndex query path like any other retriever."""
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.indexing import DataIndex, InnerIndex, IvfKnnFactory
+
+    rng = np.random.default_rng(4)
+    vecs = clustered_corpus(64, 16, n_centers=8, seed=4)
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, vec=np.ndarray),
+        [(f"d{i}", vecs[i]) for i in range(64)],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qv=np.ndarray), [(vecs[3],), (vecs[40],)]
+    )
+    index = DataIndex(
+        docs,
+        InnerIndex(
+            data_column=docs.vec,
+            factory=IvfKnnFactory(dimension=16, n_clusters=8, n_probe=4),
+            dimension=16,
+        ),
+    )
+    result = index.query_as_of_now(queries.qv, number_of_matches=1)
+    out = result.select(names=docs.name)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert sorted(n[0] for n in cols["names"]) == ["d3", "d40"]
