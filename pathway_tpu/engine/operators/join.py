@@ -37,16 +37,37 @@ _LPAD = 0x9D39247E33776D41  # sentinels mixed into padded-row keys
 _RPAD = 0x8A305F5359C24D78
 
 
-def _normalize_pointer_array(arr: np.ndarray) -> np.ndarray:
+# join keys reserved for None pointers: match no real row id (ids are xxh3
+# of values / sequential-salted, never these constants) and differ PER SIDE
+# so a None on the left never meets a None on the right
+_NONE_PTR_SENTINELS = (
+    np.uint64(0xFFFFFFFFFFFFFFFE),  # left
+    np.uint64(0xFFFFFFFFFFFFFFFF),  # right
+)
+
+
+def _normalize_pointer_array(arr: np.ndarray, side: int) -> np.ndarray:
     """Pointer columns may flow as dense uint64 arrays or object arrays of
-    np.uint64/Pointer scalars (e.g. out of groupby ``any`` reducers); collapse
-    the latter to dense uint64 so id-joins take the direct-key path on both
-    sides."""
+    np.uint64/Pointer scalars (e.g. out of groupby ``any`` reducers, or with
+    None holes after an optional ix); collapse them to dense uint64 so
+    id-joins take the direct-key path on both sides.  None pointers map to a
+    side-specific sentinel that matches nothing (LEFT joins pad them, INNER
+    drops them, and two Nones never match each other)."""
     from ...internals.keys import Pointer
 
     if arr.dtype == object and len(arr) and all(
-        isinstance(v, (np.uint64, Pointer)) for v in arr
+        v is None or isinstance(v, (np.uint64, Pointer)) for v in arr
     ):
+        if any(v is None for v in arr):
+            if all(v is None for v in arr):
+                # nothing to join on either way; all-None columns are not
+                # necessarily pointers, so don't claim the direct-key path
+                return arr
+            sentinel = _NONE_PTR_SENTINELS[side]
+            return np.array(
+                [sentinel if v is None else np.uint64(v) for v in arr],
+                dtype=np.uint64,
+            )
         return arr.astype(np.uint64)
     return arr
 
@@ -103,7 +124,10 @@ class JoinOperator(EngineOperator):
         exprs = self.left_key_exprs if side == 0 else self.right_key_exprs
         ctx_cols = self.left_ctx_cols if side == 0 else self.right_ctx_cols
         ctx = build_eval_context(delta, ctx_cols)
-        vals = [_normalize_pointer_array(np.asarray(e._eval(ctx))) for e in exprs]
+        vals = [
+            _normalize_pointer_array(np.asarray(e._eval(ctx)), side)
+            for e in exprs
+        ]
         if len(vals) == 1 and vals[0].dtype == np.uint64:
             # joining directly on key values (id joins / ix)
             return vals[0].astype(KEY_DTYPE)

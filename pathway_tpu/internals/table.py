@@ -593,6 +593,33 @@ class Table:
             "ix_ref: use table.ix(table.pointer_from(...)) for now"
         )
 
+    def sort(self, key: ColumnExpression, instance=None) -> "Table":
+        """Sorted prev/next pointer columns (reference Table.sort,
+        internals/table.py:2157; engine op prev_next.rs → operators/sort.py).
+
+        Returns a table with the same keys as ``self`` and two columns
+        ``prev``/``next`` pointing at the neighbouring rows in ``key`` order
+        (within ``instance`` when given; None at the ends)."""
+        from ..engine.operators.sort import SortOperator
+
+        aug = self.select(
+            _pw_sort_key=smart_coerce(key),
+            _pw_instance=smart_coerce(instance)
+            if instance is not None
+            else smart_coerce(0),
+        )
+        et = _new_engine_table(["prev", "next"], "sort")
+        _add_op(SortOperator(aug._engine_table, et, name="sort"))
+        from .keys import Pointer
+
+        ptr_opt = dt.wrap(Optional[Pointer])
+        return Table(
+            et,
+            {"prev": ptr_opt, "next": ptr_opt},
+            self._universe,
+            column_mapping={"prev": "prev", "next": "next"},
+        )
+
     def with_universe_of(self, other: "Table") -> "Table":
         """Promise/enforce same key set as other, restoring universe equality
         (reference: with_universe_of, internals/table.py)."""

@@ -133,19 +133,26 @@ class SentenceEncoder:
             self._fns[key] = fn
         return self._fns[key]
 
-    def encode(self, texts: Sequence[str]) -> np.ndarray:
-        """Batch encode: [B] strings -> [B, d] float32."""
+    def encode_to_device(self, texts: Sequence[str]):
+        """Batch encode with the result left in HBM ([B, d] jax array) —
+        feed ``DeviceKnnIndex.add_from_device`` for device-to-device ingest
+        with no host round trip (the SURVEY §7.6 pipeline shape)."""
         with self._lock:
             texts = ["" if t is None else str(t) for t in texts]
             n = len(texts)
             if n == 0:
-                return np.zeros((0, self.config.d_model), np.float32)
+                return jnp.zeros((0, self.config.d_model), jnp.float32)
             b = _bucket(n)
             padded = list(texts) + [""] * (b - n)
             ids, mask = self.tokenizer.encode_batch(padded)
             fn = self._forward_fn(ids.shape[0], ids.shape[1])
             out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
-            return np.asarray(out)[:n]
+            return out[:n]
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        """Batch encode: [B] strings -> [B, d] float32."""
+        out = self.encode_to_device(texts)
+        return np.asarray(out, dtype=np.float32)
 
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
         return self.encode(texts)

@@ -118,7 +118,7 @@ class IvfKnnIndex:
         self._centroids = None  # [C, d]
         self._members = None  # [C, M] int32 slots, -1 pad
         self._slot_of_key: Dict[int, int] = {}
-        self._tail_keys: List[int] = []  # added since last build
+        self._tail: Dict[int, None] = {}  # keys added since last build
         self._built_n = 0
         self._search_fns: Dict[tuple, Any] = {}
 
@@ -134,30 +134,31 @@ class IvfKnnIndex:
             if self.metric == "cos":
                 norms = np.linalg.norm(vectors, axis=1, keepdims=True)
                 vectors = vectors / np.where(norms == 0, 1.0, norms)
+            existing = [int(k) for k in keys if int(k) in self._rows]
+            self._forget_built(existing)
             for key, vec in zip(keys, vectors):
                 key = int(key)
-                if key in self._rows:
-                    self._forget_built(key)
                 self._rows[key] = vec
-                self._tail_keys.append(key)
+                self._tail[key] = None
 
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:
-            for key in keys:
-                key = int(key)
-                if self._rows.pop(key, None) is not None:
-                    self._forget_built(key)
+            dropped = [
+                int(k) for k in keys if self._rows.pop(int(k), None) is not None
+            ]
+            self._forget_built(dropped)
 
-    def _forget_built(self, key: int) -> None:
-        """Invalidate a key's built slot (upsert/remove path); also drop it
-        from the unbuilt tail if it only lived there."""
-        slot = self._slot_of_key.pop(key, None)
-        if slot is not None and self._valid is not None:
-            self._valid = self._valid.at[slot].set(False)
-        try:
-            self._tail_keys.remove(key)
-        except ValueError:
-            pass
+    def _forget_built(self, keys: Sequence[int]) -> None:
+        """Invalidate built slots (upsert/remove path) in ONE device scatter;
+        also drop the keys from the unbuilt tail."""
+        slots = []
+        for key in keys:
+            slot = self._slot_of_key.pop(key, None)
+            if slot is not None:
+                slots.append(slot)
+            self._tail.pop(key, None)
+        if slots and self._valid is not None:
+            self._valid = self._valid.at[np.asarray(slots, np.int32)].set(False)
 
     # -- build -------------------------------------------------------------
     def _needs_rebuild(self) -> bool:
@@ -173,7 +174,7 @@ class IvfKnnIndex:
             n = len(self._rows)
             if n == 0:
                 self._matrix = None
-                self._tail_keys = []
+                self._tail = {}
                 return
             keys = list(self._rows.keys())
             data = np.stack([self._rows[k] for k in keys])
@@ -230,7 +231,7 @@ class IvfKnnIndex:
             self._matrix = jnp.asarray(data, self.dtype)
             self._valid = jnp.ones(n, dtype=jnp.bool_)
             self._members = jnp.asarray(members)
-            self._tail_keys = []
+            self._tail = {}
             self._built_n = n
             self._search_fns.clear()
 
@@ -257,7 +258,7 @@ class IvfKnnIndex:
                     [queries, np.zeros((b - nq, self.dimension), np.float32)]
                 )
             # exact tail of unbuilt recent rows, brute-force scored alongside
-            tail = [key for key in self._tail_keys if key in self._rows]
+            tail = [key for key in self._tail if key in self._rows]
             tail_mat = (
                 np.stack([self._rows[key] for key in tail])
                 if tail
@@ -374,23 +375,12 @@ class IvfKnnIndex:
         max_rounds: int = 3,
     ) -> List[List[Tuple[int, float]]]:
         """Filtered search by over-sampling (same contract as
-        DeviceKnnIndex.search_oversampled): fetch oversample*k, drop rejected
-        rows, widen until satisfied or the index is exhausted."""
-        nq = np.asarray(queries).reshape(-1, self.dimension).shape[0]
-        results: List[List[Tuple[int, float]]] = [[] for _ in range(nq)]
-        kk = k * oversample
-        for _ in range(max_rounds):
-            rows = self.search(queries, kk)
-            done = True
-            for qi, row in enumerate(rows):
-                accepted = [(key, s) for key, s in row if accept(key)]
-                results[qi] = accepted[:k]
-                if len(accepted) < k and len(row) == kk:
-                    done = False
-            if done or kk >= max(len(self._rows), 1):
-                break
-            kk *= 4
-        return results
+        DeviceKnnIndex.search_oversampled; shared loop in ops/knn.py)."""
+        from .knn import oversampled_filtered_search
+
+        return oversampled_filtered_search(
+            self, queries, k, accept, oversample=oversample, max_rounds=max_rounds
+        )
 
     # diagnostics ----------------------------------------------------------
     def score_flops_fraction(self) -> float:
@@ -402,4 +392,4 @@ class IvfKnnIndex:
         M = self._members.shape[1]
         p = self.n_probe or max(1, int(np.ceil(C / 10)))
         n = self._matrix.shape[0]
-        return (C + min(p, C) * M + len(self._tail_keys)) / max(n, 1)
+        return (C + min(p, C) * M + len(self._tail)) / max(n, 1)

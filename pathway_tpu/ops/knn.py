@@ -93,7 +93,6 @@ class DeviceKnnIndex:
         self.capacity = cap
         self._matrix = self._device_zeros((cap, dimension))
         self._valid = self._device_zeros((cap,), dtype=jnp.bool_)
-        self._norms = np.zeros(cap, dtype=np.float32)  # host copy for l2sq
         self.key_to_slot: Dict[int, int] = {}
         self.slot_to_key = np.zeros(cap, dtype=KEY_DTYPE)
         self._free: List[int] = list(range(cap - 1, -1, -1))
@@ -176,9 +175,6 @@ class DeviceKnnIndex:
         self.slot_to_key = np.concatenate(
             [self.slot_to_key, np.zeros(new_cap - old_cap, dtype=KEY_DTYPE)]
         )
-        self._norms = np.concatenate(
-            [self._norms, np.zeros(new_cap - old_cap, dtype=np.float32)]
-        )
         self._free.extend(range(new_cap - 1, old_cap - 1, -1))
         self.capacity = new_cap
         self._search_fns.clear()  # capacity is baked into compiled shapes
@@ -200,9 +196,8 @@ class DeviceKnnIndex:
             slots = np.array(
                 [self._free.pop() for _ in keys], dtype=np.int32
             )
-            norms = np.linalg.norm(vectors, axis=1)
-            self._norms[slots] = norms
             if self.metric == "cos":
+                norms = np.linalg.norm(vectors, axis=1)
                 safe = np.where(norms == 0, 1.0, norms)
                 vectors = vectors / safe[:, None]
             for key, slot in zip(keys, slots):
@@ -212,8 +207,9 @@ class DeviceKnnIndex:
 
     def add_from_device(self, keys: Sequence[int], vectors) -> None:
         """Ingest vectors that already live on device (e.g. encoder output) —
-        no host round trip of the matrix rows; only the per-row norms (for
-        l2sq ranking) come back, as one small async fetch."""
+        no host round trip at all: normalisation happens on device and
+        nothing is fetched back, so a pipelined caller never blocks (l2sq
+        ranking recomputes row norms inside the scoring kernel)."""
         with self._lock:
             if len(keys) == 0:
                 return
@@ -224,9 +220,7 @@ class DeviceKnnIndex:
             if len(self._free) < len(keys):
                 self._grow(len(keys) - len(self._free))
             slots = np.array([self._free.pop() for _ in keys], dtype=np.int32)
-            # route through the mesh first (multi-process: norms must come out
-            # replicated or the host fetch below would span non-addressable
-            # devices), then compute norms/normalisation on device
+            # route through the mesh first, then normalise on device
             vectors = self._to_mesh(vectors)
             norm_fn = getattr(self, "_norm_fn_cache", None)
             if norm_fn is None:
@@ -251,14 +245,11 @@ class DeviceKnnIndex:
                     else jax.jit(_norms_and_rows, out_shardings=(out_sh, out_sh))
                 )
                 self._norm_fn_cache = norm_fn
-            norms_dev, vectors = norm_fn(vectors)
-            if hasattr(norms_dev, "copy_to_host_async"):
-                norms_dev.copy_to_host_async()
+            _norms_dev, vectors = norm_fn(vectors)
             for key, slot in zip(keys, slots):
                 self.key_to_slot[int(key)] = int(slot)
                 self.slot_to_key[slot] = int(key)
             self._scatter(slots, vectors, True)
-            self._norms[slots] = np.asarray(norms_dev)
 
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:
@@ -379,23 +370,9 @@ class DeviceKnnIndex:
     ) -> List[List[Tuple[int, float]]]:
         """Filtered search by over-sampling: fetch oversample*k, drop rejected,
         widen until satisfied or the index is exhausted."""
-        nq = np.asarray(queries).reshape(-1, self.dimension).shape[0]
-        results = [[] for _ in range(nq)]
-        kk = k * oversample
-        for _ in range(max_rounds):
-            rows = self.search(queries, kk)
-            done = True
-            for qi, row in enumerate(rows):
-                accepted = [(key, s) for key, s in row if accept(key)]
-                results[qi] = accepted[:k]
-                if len(accepted) < k and len(row) >= len(self.key_to_slot):
-                    pass  # exhausted
-                elif len(accepted) < k and len(row) == kk:
-                    done = False
-            if done or kk >= max(len(self.key_to_slot), 1):
-                break
-            kk *= 4
-        return results
+        return oversampled_filtered_search(
+            self, queries, k, accept, oversample=oversample, max_rounds=max_rounds
+        )
 
     def _run_search(self, q: jnp.ndarray, k: int):
         key = (q.shape[0], k, self.capacity)
@@ -433,3 +410,34 @@ class DeviceKnnIndex:
         if self.metric == "l2sq":
             return -(scores - query_norms[:, None] ** 2)
         return -scores
+
+
+def oversampled_filtered_search(
+    index,
+    queries: np.ndarray,
+    k: int,
+    accept,  # callable(key) -> bool
+    oversample: int = 4,
+    max_rounds: int = 3,
+) -> List[List[Tuple[int, float]]]:
+    """Shared filtered-search-by-oversampling loop over any index with
+    ``search(queries, k)`` / ``__len__`` / ``dimension`` (DeviceKnnIndex and
+    IvfKnnIndex): fetch oversample*k, drop rejected, widen until satisfied
+    or the index is exhausted."""
+    nq = np.asarray(queries).reshape(-1, index.dimension).shape[0]
+    results: List[List[Tuple[int, float]]] = [[] for _ in range(nq)]
+    kk = k * oversample
+    for _ in range(max_rounds):
+        rows = index.search(queries, kk)
+        done = True
+        for qi, row in enumerate(rows):
+            accepted = [(key, s) for key, s in row if accept(key)]
+            results[qi] = accepted[:k]
+            if len(accepted) < k and len(row) >= len(index):
+                pass  # exhausted
+            elif len(accepted) < k and len(row) == kk:
+                done = False
+        if done or kk >= max(len(index), 1):
+            break
+        kk *= 4
+    return results

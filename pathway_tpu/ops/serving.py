@@ -75,17 +75,21 @@ class FusedEncodeSearch:
         self._fns[key] = fused
         return fused
 
-    def __call__(
-        self, texts: Sequence[str], k: Optional[int] = None
-    ) -> List[List[Tuple[int, float]]]:
+    def submit(self, texts: Sequence[str], k: Optional[int] = None):
+        """Dispatch one serve batch WITHOUT waiting for the result; returns a
+        zero-arg callable that completes it (blocking on the async host
+        copy).  Concurrent serving pipelines dispatches so the device queue
+        stays full — per-batch wall time approaches pure device time instead
+        of one host RTT per call."""
         k = k or self.k
         index = self.index
         with index._lock, self._lock:
             n_items = len(index.key_to_slot)
             if not texts:
-                return []
+                return lambda: []
             if n_items == 0:
-                return [[] for _ in texts]
+                empty: List[List[Tuple[int, float]]] = [[] for _ in texts]
+                return lambda: empty
             k_eff = min(k, n_items)
             ids, mask = self.encoder.tokenizer.encode_batch(texts)
             ids = np.asarray(ids)
@@ -109,9 +113,17 @@ class FusedEncodeSearch:
             )
             if hasattr(out, "copy_to_host_async"):
                 out.copy_to_host_async()
-            out = np.asarray(out)[:n_real]
-            scores = out[:, :k_eff]
-            idx = np.ascontiguousarray(out[:, k_eff:]).view(np.int32)
+            # snapshot the slot->key view at dispatch time — REAL copies,
+            # not aliases: a writer thread may reuse slots (remove + add)
+            # before the caller completes the future, and the live arrays
+            # mutate in place
+            slot_to_key = index.slot_to_key.copy()
+            live = set(index.key_to_slot)
+
+        def complete() -> List[List[Tuple[int, float]]]:
+            arr = np.asarray(out)[:n_real]
+            scores = arr[:, :k_eff]
+            idx = np.ascontiguousarray(arr[:, k_eff:]).view(np.int32)
             results: List[List[Tuple[int, float]]] = []
             for qi in range(len(texts)):
                 row: List[Tuple[int, float]] = []
@@ -119,9 +131,16 @@ class FusedEncodeSearch:
                     s = float(scores[qi, j])
                     if not np.isfinite(s):
                         continue
-                    key_ = int(index.slot_to_key[int(idx[qi, j])])
-                    if key_ not in index.key_to_slot:
+                    key_ = int(slot_to_key[int(idx[qi, j])])
+                    if key_ not in live:
                         continue
                     row.append((key_, s))
                 results.append(row[:k])
             return results
+
+        return complete
+
+    def __call__(
+        self, texts: Sequence[str], k: Optional[int] = None
+    ) -> List[List[Tuple[int, float]]]:
+        return self.submit(texts, k)()

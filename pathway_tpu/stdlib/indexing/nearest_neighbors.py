@@ -104,10 +104,11 @@ class DeviceKnn(InnerIndexImpl):
         return out
 
 
-class DeviceIvfKnn(InnerIndexImpl):
+class DeviceIvfKnn(DeviceKnn):
     """Approximate KNN for corpora past the exact index's comfort zone
     (>~1M rows): IVF probing with exact shortlist rescore (ops/ivf.py).
-    Metadata filtering uses oversampling like DeviceKnn."""
+    Inherits DeviceKnn's add/remove/search incl. oversampled metadata
+    filtering — IvfKnnIndex exposes the same host API as DeviceKnnIndex."""
 
     def __init__(
         self,
@@ -125,36 +126,6 @@ class DeviceIvfKnn(InnerIndexImpl):
             n_probe=n_probe,
         )
         self.metadata: Dict[int, Any] = {}
-
-    def add(self, keys, values, metadatas) -> None:
-        vectors = np.array([np.asarray(v, dtype=np.float32) for v in values])
-        self.index.add(keys, vectors)
-        for key, md in zip(keys, metadatas):
-            if md is not None:
-                self.metadata[int(key)] = md
-
-    def remove(self, keys) -> None:
-        self.index.remove(keys)
-        for key in keys:
-            self.metadata.pop(int(key), None)
-
-    def search(self, values, k, filters):
-        vectors = np.array([np.asarray(v, dtype=np.float32) for v in values])
-        if all(f is None for f in filters):
-            return [tuple(row) for row in self.index.search(vectors, k)]
-        out: List[Tuple[Tuple[int, float], ...]] = []
-        for vec, fexpr in zip(vectors, filters):
-            if fexpr is None:
-                out.append(tuple(self.index.search(vec[None, :], k)[0]))
-                continue
-            accept_fn = compile_filter(str(fexpr))
-            rows = self.index.search_oversampled(
-                vec[None, :],
-                k,
-                accept=lambda key: accept_fn(self.metadata.get(int(key), {})),
-            )
-            out.append(tuple(rows[0]))
-        return out
 
 
 # Factories (reference: stdlib/indexing/retrievers.py style factories used by

@@ -1,13 +1,23 @@
-"""Sorted-structure helpers (reference: stdlib/indexing/sorting.py:230 —
-binsearch trees over tables).  Host-side sorted lookup utilities used by the
-asof machinery; full tree API lands with pw.iterate."""
+"""Sorted-structure API (reference: stdlib/indexing/sorting.py:230 —
+binsearch trees + prev/next retrieval over sorted tables).
+
+The reference builds a randomized binsearch tree with ``pw.iterate`` and
+derives prev/next pointers from tree traversal (sort_from_index); here
+``Table.sort`` computes prev/next directly in the engine
+(engine/operators/sort.py), and this module supplies the value-walking API
+on top plus host-side binsearch helpers used by the asof machinery."""
 
 from __future__ import annotations
 
 import bisect
 from typing import Any, List, Tuple
 
-__all__ = ["binsearch_lower", "binsearch_upper"]
+__all__ = [
+    "binsearch_lower",
+    "binsearch_upper",
+    "sort_from_index",
+    "retrieve_prev_next_values",
+]
 
 
 def binsearch_lower(sorted_pairs: List[Tuple[Any, Any]], key: Any):
@@ -22,3 +32,48 @@ def binsearch_upper(sorted_pairs: List[Tuple[Any, Any]], key: Any):
     keys = [k for k, _ in sorted_pairs]
     i = bisect.bisect_left(keys, key)
     return sorted_pairs[i][1] if i < len(sorted_pairs) else None
+
+
+def sort_from_index(table, key, instance=None):
+    """prev/next pointer columns for ``table`` in ``key`` order — the
+    reference's tree-derived API (sorting.py:137), served by the engine sort
+    operator here."""
+    return table.sort(key, instance=instance)
+
+
+def retrieve_prev_next_values(ordered_table, value=None):
+    """For each row of a prev/next-ordered table, pointers-walk to the
+    nearest row (itself included) with a non-None ``value`` in each
+    direction; returns columns ``prev_value`` / ``next_value``
+    (reference: sorting.py:195 — same iterate-to-fixpoint shape)."""
+    import pathway_tpu as pw
+
+    if value is None:
+        value = ordered_table.value
+    elif isinstance(value, str):
+        value = getattr(ordered_table, value)
+
+    seeded = ordered_table.select(
+        prev=ordered_table.prev,
+        next=ordered_table.next,
+        value=value,
+    )
+    seeded = seeded.with_columns(
+        prev_value=pw.require(pw.this.id, pw.this.value),
+        next_value=pw.require(pw.this.id, pw.this.value),
+    )
+
+    def walk(tab):
+        return tab.with_columns(
+            prev_value=pw.coalesce(
+                tab.prev_value,
+                tab.ix(tab.prev, optional=True).prev_value,
+            ),
+            next_value=pw.coalesce(
+                tab.next_value,
+                tab.ix(tab.next, optional=True).next_value,
+            ),
+        )
+
+    result = pw.iterate(walk, tab=seeded)
+    return result.select(result.prev_value, result.next_value)

@@ -282,13 +282,6 @@ class WindowedTable:
         raise NotImplementedError(type(win))
 
     def _reduce_session(self, *args, **kwargs) -> Table:
-        if self.behavior is not None:
-            # loud failure beats the silently-ignored kwarg this used to be;
-            # session windows merge/split so their buffers need dedicated
-            # handling (reference: _window.py session + behavior lowering)
-            raise NotImplementedError(
-                "behaviors on session windows are not supported yet"
-            )
         from .session_windows import reduce_session
 
         return reduce_session(self, *args, **kwargs)

@@ -45,6 +45,46 @@ def reduce_session(windowed, *args, **kwargs) -> Table:
     if windowed.instance is not None:
         grouping.append(windowed.instance)
     aug = table.with_columns(_pw_t=key_expr)
+
+    # Behaviors (NOTE: the reference silently IGNORES behaviors on session
+    # windows — SessionWindow._apply takes `behavior` and never reads it,
+    # /root/reference/python/pathway/stdlib/temporal/_window.py:111-146.
+    # Here CommonBehavior is supported with row-time semantics: delay holds
+    # a row until clock >= t+delay; cutoff drops rows arriving after clock
+    # passed t+cutoff; keep_results=False additionally PRUNES rows past the
+    # cutoff from the per-instance accumulation, which both bounds state and
+    # retracts the frozen sessions via recompute.  keep_results=True keeps
+    # every surviving row in the instance accumulation (results must stay
+    # even if the instance later recomputes), so per-instance state is
+    # bounded only by the cutoff-surviving row count.)
+    gate = None
+    cutoff_c = None
+    keep_results = True
+    behavior = windowed.behavior
+    if behavior is not None:
+        from .temporal_behavior import CommonBehavior, ExactlyOnceBehavior
+
+        if isinstance(behavior, ExactlyOnceBehavior):
+            raise NotImplementedError(
+                "exactly-once is not defined for merging session windows; "
+                "use common_behavior(delay, cutoff, keep_results)"
+            )
+        if not isinstance(behavior, CommonBehavior):
+            raise TypeError(f"unsupported window behavior: {behavior!r}")
+        release = expire = None
+        if behavior.delay is not None:
+            d = _num(behavior.delay)
+            release = ApplyExpression(
+                lambda t, d=d: _num(t) + d, dt.FLOAT, args=(this._pw_t,)
+            )
+        if behavior.cutoff is not None:
+            cutoff_c = _num(behavior.cutoff)
+            expire = ApplyExpression(
+                lambda t, c=cutoff_c: _num(t) + c, dt.FLOAT, args=(this._pw_t,)
+            )
+        keep_results = behavior.keep_results
+        if release is not None or expire is not None:
+            aug, gate = aug._time_gate(this._pw_t, release, expire)
     grouped = aug.groupby(*[_rebind(g, table, aug) for g in grouping]) if grouping else aug.groupby()
     packed_cols = {}
     if grouping:
@@ -96,7 +136,51 @@ def reduce_session(windowed, *args, **kwargs) -> Table:
     final_exprs = {}
     for name, e in out_exprs.items():
         final_exprs[name] = _session_expr(e, exploded, col_names)
-    return exploded.select(**final_exprs)
+    out = exploded.select(**final_exprs)
+    if gate is not None and cutoff_c is not None and not keep_results:
+        gop = packed._engine_table.producer
+        gate.sweep_hooks.append(_session_state_pruner(gop, cutoff_c))
+    return out
+
+
+def _session_state_pruner(gop, cutoff: float):
+    """Sweep hook (keep_results=False): drop rows past the cutoff from the
+    per-instance sorted-tuple accumulation and re-emit the packed rows — the
+    downstream session split recomputes without them, retracting the frozen
+    sessions AND keeping state bounded (the session analog of
+    _groupby_sweeper's `del gop._groups[gk]`)."""
+    si = next(
+        i
+        for i, spec in enumerate(gop.reducer_specs)
+        if spec.out_name == "_pw_sessions"
+    )
+
+    def sweep(clock):
+        touched = {}
+        for gk, entry in list(gop._groups.items()):
+            state = entry[2][si]
+            expired = [
+                h
+                for h, (cnt, val) in state.items()
+                if _num(val[0]) + cutoff <= clock
+            ]
+            if not expired:
+                continue
+            removed = 0
+            for h in expired:
+                cnt, _val = state[h]
+                removed += cnt
+                del state[h]
+            entry[0] -= removed
+            touched[gk] = None
+        if not touched:
+            return None
+        delta = gop._emit(touched, list(gop.grouping_expressions.keys()))
+        if delta is None:
+            return None
+        return (gop.output, delta)
+
+    return sweep
 
 
 def _rebind(expr, old_table, new_table):

@@ -1,0 +1,144 @@
+"""Table.sort prev/next pointers + sorted-value retrieval
+(reference: Table.sort internals/table.py:2157, prev_next.rs engine op,
+stdlib/indexing/sorting.py retrieve_prev_next_values)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.engine.executor import Executor
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.keys import ref_scalar
+from pathway_tpu.stdlib.indexing.sorting import retrieve_prev_next_values
+
+from .test_temporal_behavior import make_executor, make_stream_table
+from .utils import T, run_all
+
+
+def links_of(table, base):
+    """{name: (prev_name, next_name)} from a sort() result joined to base."""
+    keys_b, cols_b = base._materialize()
+    name_of = {int(k): cols_b["name"][i] for i, k in enumerate(keys_b)}
+    keys_s, cols_s = table._materialize()
+    out = {}
+    for i, k in enumerate(keys_s):
+        prev = cols_s["prev"][i]
+        nxt = cols_s["next"][i]
+        out[name_of[int(k)]] = (
+            name_of[int(prev)] if prev is not None else None,
+            name_of[int(nxt)] if nxt is not None else None,
+        )
+    return out
+
+
+def test_sort_basic_prev_next():
+    base = T(
+        """
+        name    | age
+        alice   | 25
+        bob     | 20
+        charlie | 30
+        """
+    )
+    sorted_t = base.sort(key=base.age)
+    run_all()
+    links = links_of(sorted_t, base)
+    assert links == {
+        "bob": (None, "alice"),
+        "alice": ("bob", "charlie"),
+        "charlie": ("alice", None),
+    }
+
+
+def test_sort_with_instance():
+    base = T(
+        """
+        name    | age | score
+        alice   | 25  | 80
+        bob     | 20  | 90
+        charlie | 30  | 80
+        david   | 35  | 90
+        eve     | 15  | 80
+        """
+    )
+    sorted_t = base.sort(key=base.age, instance=base.score)
+    run_all()
+    links = links_of(sorted_t, base)
+    assert links == {
+        "eve": (None, "alice"),
+        "alice": ("eve", "charlie"),
+        "charlie": ("alice", None),
+        "bob": (None, "david"),
+        "david": ("bob", None),
+    }
+
+
+def test_sort_incremental_insert_and_delete():
+    t, session = make_stream_table(name=str, age=float)
+    sorted_t = t.sort(key=t.age)
+    ex = make_executor()
+
+    ka, kb, kc = (int(ref_scalar(i)) for i in (1, 2, 3))
+    session.insert(ka, ("alice", 25.0))
+    session.insert(kb, ("bob", 20.0))
+    ex.step()
+    _, cols = sorted_t._materialize()
+    keys, cols = sorted_t._materialize()
+    by_key = {int(k): (cols["prev"][i], cols["next"][i]) for i, k in enumerate(keys)}
+    assert by_key[kb] == (None, np.uint64(ka))
+    assert by_key[ka] == (np.uint64(kb), None)
+
+    # insert a row in the middle: links re-knit
+    session.insert(kc, ("carol", 22.0))
+    ex.step()
+    keys, cols = sorted_t._materialize()
+    by_key = {int(k): (cols["prev"][i], cols["next"][i]) for i, k in enumerate(keys)}
+    assert by_key[kb] == (None, np.uint64(kc))
+    assert by_key[kc] == (np.uint64(kb), np.uint64(ka))
+    assert by_key[ka] == (np.uint64(kc), None)
+
+    # delete the middle row: neighbours reconnect
+    session.remove(kc)
+    ex.step()
+    keys, cols = sorted_t._materialize()
+    by_key = {int(k): (cols["prev"][i], cols["next"][i]) for i, k in enumerate(keys)}
+    assert len(by_key) == 2
+    assert by_key[kb] == (None, np.uint64(ka))
+    assert by_key[ka] == (np.uint64(kb), None)
+
+
+def test_retrieve_prev_next_values_walks_over_nones():
+    base = T(
+        """
+        name | t  | v
+        a    | 1  | 10
+        b    | 2  |
+        c    | 3  |
+        d    | 4  | 40
+        """
+    )
+    ordered = base.sort(key=base.t)
+    joined = base.select(
+        prev=ordered.prev, next=ordered.next, value=base.v
+    )
+    walked = retrieve_prev_next_values(joined)
+    run_all()
+    keys_b, cols_b = base._materialize()
+    name_of = {int(k): cols_b["name"][i] for i, k in enumerate(keys_b)}
+    keys_w, cols_w = walked._materialize()
+    got = {}
+    for i, k in enumerate(keys_w):
+        pv, nv = cols_w["prev_value"][i], cols_w["next_value"][i]
+        got[name_of[int(k)]] = (
+            name_of[int(pv)] if pv is not None else None,
+            name_of[int(nv)] if nv is not None else None,
+        )
+    # prev_value/next_value point at the nearest row (itself included)
+    # holding a non-None v
+    assert got == {
+        "a": ("a", "a"),
+        "b": ("a", "d"),
+        "c": ("a", "d"),
+        "d": ("d", "d"),
+    }

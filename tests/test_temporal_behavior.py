@@ -259,18 +259,6 @@ def test_interval_join_delay_buffers():
     assert rows_of(out) == [("x", "p")]  # clock 16 releases both
 
 
-def test_session_window_behavior_raises():
-    import pytest as _pytest
-    from pathway_tpu.stdlib.temporal import session
-
-    t, _session = make_stream_table(t=float)
-    wt = windowby(
-        t, t.t, window=session(max_gap=1.0), behavior=common_behavior(cutoff=1.0)
-    )
-    with _pytest.raises(NotImplementedError):
-        wt.reduce(c=pw.reducers.count())
-
-
 def test_interval_join_left_cutoff_no_padded_leak():
     """A cutoff-dropped late left row must not surface as an unmatched
     padded output row (LEFT join pads against gate survivors only)."""
@@ -301,3 +289,106 @@ def test_interval_join_left_cutoff_no_padded_leak():
     ls.insert(int(ref_scalar("l3")), (101.0, "solo"))
     ex.step()
     assert any(r[0] == "solo" and r[1] is None for r in rows_of(out)), rows_of(out)
+
+
+# ---------------------------------------------------------------------------
+# session windows + behaviors (beyond the reference: SessionWindow._apply
+# silently ignores `behavior`, reference _window.py:111-146)
+# ---------------------------------------------------------------------------
+from pathway_tpu.stdlib.temporal import session  # noqa: E402
+
+
+def session_counts(table):
+    keys, cols = table._materialize()
+    return sorted(
+        (float(cols["start"][i]), int(cols["c"][i])) for i in range(len(keys))
+    )
+
+
+def test_session_delay_buffers_rows():
+    t, s = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=session(max_gap=2.0),
+        behavior=common_behavior(delay=5.0),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    ex = make_executor()
+
+    s.insert(int(ref_scalar(1)), (1.0,))
+    ex.step()
+    assert session_counts(out) == []  # held: clock 1 < 1+5
+
+    s.insert(int(ref_scalar(2)), (7.0,))
+    ex.step()
+    # clock 7 releases t=1 (1+5<=7) but holds t=7 (7+5>7)
+    assert session_counts(out) == [(1.0, 1)]
+
+    s.insert(int(ref_scalar(3)), (13.0,))
+    ex.step()
+    # clock 13 releases t=7; t=13 still held; sessions: [1], [7]
+    assert session_counts(out) == [(1.0, 1), (7.0, 1)]
+
+
+def test_session_cutoff_drops_late_rows():
+    t, s = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=session(max_gap=1.0),
+        behavior=common_behavior(cutoff=3.0),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    ex = make_executor()
+
+    s.insert(int(ref_scalar(1)), (1.0,))
+    s.insert(int(ref_scalar(2)), (10.0,))
+    ex.step()
+    assert session_counts(out) == [(1.0, 1), (10.0, 1)]
+
+    # clock is 10; a row at t=2 is past its cutoff (2+3 <= 10) -> dropped,
+    # the frozen session at start=1 is NOT extended
+    s.insert(int(ref_scalar(3)), (2.0,))
+    ex.step()
+    assert session_counts(out) == [(1.0, 1), (10.0, 1)]
+
+    # a fresh row within the gap of 10 still merges
+    s.insert(int(ref_scalar(4)), (10.5,))
+    ex.step()
+    assert session_counts(out) == [(1.0, 1), (10.0, 2)]
+
+
+def test_session_cutoff_keep_results_false_retracts_frozen():
+    t, s = make_stream_table(t=float)
+    out = windowby(
+        t,
+        t.t,
+        window=session(max_gap=1.0),
+        behavior=common_behavior(cutoff=2.0, keep_results=False),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    ex = make_executor()
+
+    s.insert(int(ref_scalar(1)), (1.0,))
+    ex.step()
+    assert session_counts(out) == [(1.0, 1)]
+
+    s.insert(int(ref_scalar(2)), (20.0,))
+    ex.step()
+    # sweeps lag one tick (time_gate.py on_tick_end): the next tick sweeps
+    # at clock 20, retracting the frozen session ending at 1 (1+2 <= 20);
+    # t=21 merges with t=20 (gap 1)
+    s.insert(int(ref_scalar(3)), (21.0,))
+    ex.step()
+    assert session_counts(out) == [(20.0, 2)]
+
+
+def test_session_exactly_once_rejected():
+    import pytest
+
+    t, s = make_stream_table(t=float)
+    with pytest.raises(NotImplementedError):
+        windowby(
+            t,
+            t.t,
+            window=session(max_gap=1.0),
+            behavior=exactly_once_behavior(),
+        ).reduce(c=pw.reducers.count())
