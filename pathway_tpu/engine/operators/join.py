@@ -48,26 +48,16 @@ _NONE_PTR_SENTINELS = (
 
 def _normalize_pointer_array(arr: np.ndarray, side: int) -> np.ndarray:
     """Pointer columns may flow as dense uint64 arrays or object arrays of
-    np.uint64/Pointer scalars (e.g. out of groupby ``any`` reducers, or with
-    None holes after an optional ix); collapse them to dense uint64 so
-    id-joins take the direct-key path on both sides.  None pointers map to a
-    side-specific sentinel that matches nothing (LEFT joins pad them, INNER
-    drops them, and two Nones never match each other)."""
+    np.uint64/Pointer scalars (e.g. out of groupby ``any`` reducers);
+    collapse the latter to dense uint64 so id-joins take the direct-key path
+    on both sides.  Columns with None holes stay on the hash path UNLESS the
+    operator declared pointer_keys at build time (see _join_keys) — the
+    encoding of a row's key must never depend on its delta's value mix."""
     from ...internals.keys import Pointer
 
     if arr.dtype == object and len(arr) and all(
-        v is None or isinstance(v, (np.uint64, Pointer)) for v in arr
+        isinstance(v, (np.uint64, Pointer)) for v in arr
     ):
-        if any(v is None for v in arr):
-            if all(v is None for v in arr):
-                # nothing to join on either way; all-None columns are not
-                # necessarily pointers, so don't claim the direct-key path
-                return arr
-            sentinel = _NONE_PTR_SENTINELS[side]
-            return np.array(
-                [sentinel if v is None else np.uint64(v) for v in arr],
-                dtype=np.uint64,
-            )
         return arr.astype(np.uint64)
     return arr
 
@@ -89,6 +79,7 @@ class JoinOperator(EngineOperator):
         assign_id_from: Optional[str] = None,
         exact_match: bool = False,
         warn_unmatched_left: bool = False,
+        pointer_keys: Optional[bool] = None,
         name: str = "join",
     ):
         super().__init__([left, right], output, name)
@@ -98,6 +89,12 @@ class JoinOperator(EngineOperator):
         # advice).  Warning is deferred to on_tick_end because within a tick
         # the left delta may simply be processed before the right one.
         self.warn_unmatched_left = warn_unmatched_left
+        # build-time declaration that BOTH single-key sides are pointer
+        # columns (ix / id joins): the raw-uint64 key path is then used
+        # unconditionally, with Nones mapped to per-side sentinels — the
+        # encoding must never depend on a delta's value mix, or inserts and
+        # retractions of one row could disagree on its join key
+        self.pointer_keys = pointer_keys
         self._unres_left: set = set()
         self._warned_unres: set = set()
         self.left_key_exprs = list(left_key_exprs)
@@ -124,6 +121,16 @@ class JoinOperator(EngineOperator):
         exprs = self.left_key_exprs if side == 0 else self.right_key_exprs
         ctx_cols = self.left_ctx_cols if side == 0 else self.right_ctx_cols
         ctx = build_eval_context(delta, ctx_cols)
+        if self.pointer_keys and len(exprs) == 1:
+            # declared pointer join: raw-uint64 keys, Nones -> side sentinel
+            arr = np.asarray(exprs[0]._eval(ctx))
+            if arr.dtype == object:
+                sentinel = _NONE_PTR_SENTINELS[side]
+                arr = np.array(
+                    [sentinel if v is None else np.uint64(v) for v in arr],
+                    dtype=np.uint64,
+                )
+            return arr.astype(KEY_DTYPE)
         vals = [
             _normalize_pointer_array(np.asarray(e._eval(ctx)), side)
             for e in exprs

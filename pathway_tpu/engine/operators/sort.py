@@ -55,7 +55,10 @@ class SortOperator(EngineOperator):
         delta = delta.consolidated()
         kcol = list(delta.columns["_pw_sort_key"])
         icol = list(delta.columns["_pw_instance"])
-        touched = set()
+        # neighbour-local incremental maintenance: each mutation touches at
+        # most itself and its two adjacent entries, so only those rows are
+        # re-linked afterwards — O(batch * log n), never a full-order rescan
+        affected: Dict[Any, set] = {}
         removed: List[int] = []
         for key, diff, kv, inst in zip(
             delta.keys.tolist(), delta.diffs.tolist(), kcol, icol
@@ -63,16 +66,27 @@ class SortOperator(EngineOperator):
             inst = _hashable(inst)
             entry = (_hashable(kv), int(key))
             order = self._orders.setdefault(inst, [])
+            touched = affected.setdefault(inst, set())
             if diff > 0:
-                bisect.insort(order, entry)
+                i = bisect.bisect_left(order, entry)
+                order.insert(i, entry)
+                touched.add(entry)
+                if i > 0:
+                    touched.add(order[i - 1])
+                if i + 1 < len(order):
+                    touched.add(order[i + 1])
             else:
                 i = bisect.bisect_left(order, entry)
                 if i < len(order) and order[i] == entry:
                     order.pop(i)
+                    if i > 0:
+                        touched.add(order[i - 1])
+                    if i < len(order):
+                        touched.add(order[i])
+                touched.discard(entry)
                 removed.append(int(key))
                 if not order:
                     del self._orders[inst]
-            touched.add(inst)
 
         rows: List[Tuple[int, int, Tuple[Any, Any]]] = []
 
@@ -83,10 +97,14 @@ class SortOperator(EngineOperator):
             old = self._links.pop(key, None)
             if old is not None:
                 rows.append((key, -1, (as_ptr(old[0]), as_ptr(old[1]))))
-        for inst in touched:
+        for inst, touched in affected.items():
             order = self._orders.get(inst, [])
             last = len(order) - 1
-            for i, (_kv, row_key) in enumerate(order):
+            for entry in touched:
+                i = bisect.bisect_left(order, entry)
+                if i > last or order[i] != entry:
+                    continue  # removed later in the same batch
+                row_key = entry[1]
                 link = (
                     order[i - 1][1] if i > 0 else None,
                     order[i + 1][1] if i < last else None,

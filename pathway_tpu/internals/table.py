@@ -282,6 +282,7 @@ class Table:
                 and not other._universe.is_subset_of(self._universe)
                 else JoinKind.INNER,
                 assign_id_from="left",
+                pointer_keys=True,
                 name="zip_same_universe",
             )
             _add_op(op)
@@ -571,6 +572,7 @@ class Table:
             kind=JoinKind.LEFT if optional else JoinKind.INNER,
             assign_id_from="left",
             warn_unmatched_left=not optional,
+            pointer_keys=True,
             name="ix",
         )
         _add_op(op)
@@ -953,6 +955,21 @@ def _reducer_dtype(reducer, args_exprs, env) -> dt.DType:
     return dt.ANY
 
 
+def _expr_is_pointer(expr) -> bool:
+    """Build-time pointer-ness of a join key expression (ids, or columns
+    whose declared dtype is POINTER) — lets JoinOperator fix the key
+    encoding once instead of per delta (engine/operators/join.py)."""
+    from .expression import ColumnReference, IdExpression
+
+    if isinstance(expr, (IdExpression, _EngineIdExpr)):
+        return True
+    if isinstance(expr, ColumnReference) and isinstance(expr.table, Table):
+        declared = expr.table._dtypes.get(expr.name)
+        if declared is not None:
+            return dt.unoptionalize(declared) == dt.POINTER
+    return False
+
+
 class _EngineIdExpr(ColumnExpression):
     """Internal: evaluates to the row keys (used for id-joins at engine level)."""
 
@@ -1031,6 +1048,12 @@ class JoinResult:
         ]
         et = _new_engine_table(out_cols, "join")
         cls = AsofNowJoinOperator if asof_now else JoinOperator
+        pointer_keys = (
+            len(left_exprs) == 1
+            and len(right_exprs) == 1
+            and _expr_is_pointer(left_exprs[0])
+            and _expr_is_pointer(right_exprs[0])
+        ) or None
         op = cls(
             left._engine_table,
             right._engine_table,
@@ -1041,6 +1064,7 @@ class JoinResult:
             right_ctx_cols=right._ctx_cols(placeholders=[right_placeholder]),
             kind=mode,
             assign_id_from=assign_id_from,
+            pointer_keys=pointer_keys,
             name="asof_now_join" if asof_now else "join",
         )
         _add_op(op)

@@ -50,30 +50,69 @@ class SentenceEncoder:
         normalize: bool = True,
     ):
         self.model_name = model
-        self.config = TransformerConfig(
-            vocab_size=vocab_size,
-            d_model=dimension,
-            n_heads=resolve_heads(dimension, n_heads),
-            n_layers=n_layers,
-            d_ff=dimension * 4,
-            max_len=max_length,
-            dtype=dtype,
-            pool="mean",
-        )
-        self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
-        self.module = TransformerEncoder(self.config)
         self.normalize = normalize
         self.mesh = mesh
         self._lock = threading.Lock()
         self._fns: Dict[tuple, Any] = {}
-        if checkpoint_path and os.path.exists(checkpoint_path):
-            self.params = self._load_checkpoint(checkpoint_path)
+
+        from .hf_import import is_hf_checkpoint
+
+        if is_hf_checkpoint(checkpoint_path):
+            # real-weights path: HF BERT-family safetensors + WordPiece vocab
+            # (models/hf_import.py) — the sentence-transformers export layout
+            from .hf_import import BertEncoderModule, load_bert_checkpoint
+
+            hf_cfg, self.params = load_bert_checkpoint(checkpoint_path)
+            max_length = min(max_length, hf_cfg.max_position_embeddings)
+            self.config = TransformerConfig(
+                vocab_size=hf_cfg.vocab_size,
+                d_model=hf_cfg.hidden_size,
+                n_heads=hf_cfg.num_attention_heads,
+                n_layers=hf_cfg.num_hidden_layers,
+                d_ff=hf_cfg.intermediate_size,
+                max_len=max_length,
+                dtype=dtype,
+                pool="mean",
+            )
+            self.module = BertEncoderModule(hf_cfg)
+            vocab_file = os.path.join(checkpoint_path, "vocab.txt")
+            if not os.path.exists(vocab_file):
+                # trained weights + hash-derived token ids = silently garbage
+                # embeddings; fail loudly instead
+                raise FileNotFoundError(
+                    f"{checkpoint_path} has model weights but no vocab.txt — "
+                    "export the tokenizer vocab alongside the checkpoint "
+                    "(tokenizer.save_vocabulary) so token ids match the "
+                    "trained embedding table"
+                )
+            from .wordpiece import WordPieceTokenizer
+
+            self.tokenizer = WordPieceTokenizer(
+                vocab_file, max_length=max_length
+            )
         else:
-            ids = jnp.zeros((1, 16), jnp.int32)
-            mask = jnp.ones((1, 16), jnp.int32)
-            self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask)[
-                "params"
-            ]
+            self.config = TransformerConfig(
+                vocab_size=vocab_size,
+                d_model=dimension,
+                n_heads=resolve_heads(dimension, n_heads),
+                n_layers=n_layers,
+                d_ff=dimension * 4,
+                max_len=max_length,
+                dtype=dtype,
+                pool="mean",
+            )
+            self.tokenizer = HashTokenizer(
+                vocab_size=vocab_size, max_length=max_length
+            )
+            self.module = TransformerEncoder(self.config)
+            if checkpoint_path and os.path.exists(checkpoint_path):
+                self.params = self._load_checkpoint(checkpoint_path)
+            else:
+                ids = jnp.zeros((1, 16), jnp.int32)
+                mask = jnp.ones((1, 16), jnp.int32)
+                self.params = self.module.init(
+                    jax.random.PRNGKey(seed), ids, mask
+                )["params"]
         self.params = _unbox(self.params)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,193 @@
+"""HF checkpoint import: safetensors -> jax BERT encoder, fully offline.
+
+The reference serves real sentence-transformers models through the HF
+runtime (xpacks/llm/embedders.py:270-330, ``model.encode`` per string).
+Here a BERT-family checkpoint directory (``config.json`` +
+``model.safetensors`` + ``vocab.txt``, the standard sentence-transformers
+export) loads straight into a jax forward implemented in this module —
+numerically matching ``transformers.BertModel`` (tests/test_hf_import.py
+asserts parity against a torch reference) — and runs batched on TPU with
+mean pooling.  No torch and no HF runtime in the serving path.
+
+Supported surface: BERT/MiniLM-style post-LayerNorm encoders (the
+architecture of all-MiniLM-L6-v2 and friends, the reference templates'
+default embedder).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BertConfig", "BertEncoderModule", "load_bert_checkpoint"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int
+    hidden_size: int
+    num_hidden_layers: int
+    num_attention_heads: int
+    intermediate_size: int
+    max_position_embeddings: int
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def from_json(path: str) -> "BertConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        return BertConfig(
+            vocab_size=raw["vocab_size"],
+            hidden_size=raw["hidden_size"],
+            num_hidden_layers=raw["num_hidden_layers"],
+            num_attention_heads=raw["num_attention_heads"],
+            intermediate_size=raw["intermediate_size"],
+            max_position_embeddings=raw["max_position_embeddings"],
+            layer_norm_eps=raw.get("layer_norm_eps", 1e-12),
+        )
+
+
+def _layer_norm(x, gamma, beta, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+
+
+def bert_forward(
+    params: Dict[str, Any], ids: jnp.ndarray, mask: jnp.ndarray, cfg: BertConfig
+) -> jnp.ndarray:
+    """HF-BERT-equivalent forward (eval mode): returns the last hidden state
+    [B, L, H].  Post-LN blocks, exact (erf) GELU, additive attention mask."""
+    emb = params["embeddings"]
+    B, L = ids.shape
+    h = (
+        emb["word"][ids]
+        + emb["position"][jnp.arange(L)][None, :, :]
+        + emb["token_type"][jnp.zeros((B, L), jnp.int32)]
+    )
+    h = _layer_norm(h, emb["ln_gamma"], emb["ln_beta"], cfg.layer_norm_eps)
+
+    n_heads = cfg.num_attention_heads
+    head_dim = cfg.hidden_size // n_heads
+    neg = jnp.asarray(-1e9, h.dtype)
+    attn_bias = jnp.where(mask[:, None, None, :] > 0, 0.0, neg)  # [B,1,1,L]
+
+    for layer in params["layers"]:
+        q = h @ layer["q_w"] + layer["q_b"]
+        k = h @ layer["k_w"] + layer["k_b"]
+        v = h @ layer["v_w"] + layer["v_b"]
+
+        def split(x):
+            return x.reshape(B, L, n_heads, head_dim).transpose(0, 2, 1, 3)
+
+        scores = split(q) @ split(k).transpose(0, 1, 3, 2)
+        scores = scores / jnp.sqrt(jnp.asarray(head_dim, h.dtype)) + attn_bias
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = (probs @ split(v)).transpose(0, 2, 1, 3).reshape(B, L, cfg.hidden_size)
+        attn_out = ctx @ layer["o_w"] + layer["o_b"]
+        h = _layer_norm(
+            h + attn_out, layer["attn_ln_gamma"], layer["attn_ln_beta"],
+            cfg.layer_norm_eps,
+        )
+        ffn = jax.nn.gelu(h @ layer["ffn_in_w"] + layer["ffn_in_b"], approximate=False)
+        ffn = ffn @ layer["ffn_out_w"] + layer["ffn_out_b"]
+        h = _layer_norm(
+            h + ffn, layer["ffn_ln_gamma"], layer["ffn_ln_beta"], cfg.layer_norm_eps
+        )
+    return h
+
+
+def mean_pool(hidden: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """sentence-transformers mean pooling: masked token average [B, H]."""
+    m = mask[:, :, None].astype(hidden.dtype)
+    return jnp.sum(hidden * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1e-9)
+
+
+class BertEncoderModule:
+    """Duck-typed stand-in for a flax module inside SentenceEncoder:
+    ``apply({"params": params}, ids, mask)`` -> mean-pooled [B, H]."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+
+    def apply(self, variables, ids, mask):
+        hidden = bert_forward(variables["params"], ids, mask, self.cfg)
+        return mean_pool(hidden, mask)
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    """torch Linear stores weight [out, in]; jax matmul wants [in, out]."""
+    return np.ascontiguousarray(x.T)
+
+
+def load_bert_checkpoint(path: str):
+    """Load an HF BERT-style checkpoint directory -> (BertConfig, params).
+
+    ``path`` must contain ``config.json`` and ``model.safetensors`` (the
+    standard ``save_pretrained`` layout).  Tensor names follow HF BertModel;
+    a leading ``bert.`` prefix (full-model exports) is accepted."""
+    from safetensors.numpy import load_file
+
+    cfg = BertConfig.from_json(os.path.join(path, "config.json"))
+    raw = load_file(os.path.join(path, "model.safetensors"))
+    tensors = {}
+    for name, value in raw.items():
+        tensors[name[5:] if name.startswith("bert.") else name] = value
+
+    def get(name: str) -> np.ndarray:
+        if name not in tensors:
+            raise KeyError(
+                f"checkpoint at {path} lacks tensor {name!r} — "
+                "only BERT-family encoders are supported"
+            )
+        return tensors[name]
+
+    params: Dict[str, Any] = {
+        "embeddings": {
+            "word": get("embeddings.word_embeddings.weight"),
+            "position": get("embeddings.position_embeddings.weight"),
+            "token_type": get("embeddings.token_type_embeddings.weight"),
+            "ln_gamma": get("embeddings.LayerNorm.weight"),
+            "ln_beta": get("embeddings.LayerNorm.bias"),
+        },
+        "layers": [],
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"encoder.layer.{i}."
+        params["layers"].append(
+            {
+                "q_w": _t(get(p + "attention.self.query.weight")),
+                "q_b": get(p + "attention.self.query.bias"),
+                "k_w": _t(get(p + "attention.self.key.weight")),
+                "k_b": get(p + "attention.self.key.bias"),
+                "v_w": _t(get(p + "attention.self.value.weight")),
+                "v_b": get(p + "attention.self.value.bias"),
+                "o_w": _t(get(p + "attention.output.dense.weight")),
+                "o_b": get(p + "attention.output.dense.bias"),
+                "attn_ln_gamma": get(p + "attention.output.LayerNorm.weight"),
+                "attn_ln_beta": get(p + "attention.output.LayerNorm.bias"),
+                "ffn_in_w": _t(get(p + "intermediate.dense.weight")),
+                "ffn_in_b": get(p + "intermediate.dense.bias"),
+                "ffn_out_w": _t(get(p + "output.dense.weight")),
+                "ffn_out_b": get(p + "output.dense.bias"),
+                "ffn_ln_gamma": get(p + "output.LayerNorm.weight"),
+                "ffn_ln_beta": get(p + "output.LayerNorm.bias"),
+            }
+        )
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return cfg, params
+
+
+def is_hf_checkpoint(path) -> bool:
+    return (
+        isinstance(path, str)
+        and os.path.isdir(path)
+        and os.path.exists(os.path.join(path, "config.json"))
+        and os.path.exists(os.path.join(path, "model.safetensors"))
+    )

@@ -19,20 +19,32 @@ class EmbeddingIndexAdapter:
     def __init__(self, inner, embedder):
         self.inner = inner
         self.embedder = embedder
-        fn = embedder.func
-        self._is_async = inspect.iscoroutinefunction(fn)
-        self._is_batched = bool(getattr(embedder, "batched", False))
+        if hasattr(embedder, "encode"):
+            # model-object embedder (SentenceEncoder & friends): batched
+            # list-of-strings -> [B, d] on device
+            self._mode = "encode"
+        else:
+            fn = embedder.func  # UDF-style embedder
+            self._mode = (
+                "async"
+                if inspect.iscoroutinefunction(fn)
+                else "batched"
+                if getattr(embedder, "batched", False)
+                else "per_item"
+            )
 
     def _embed(self, values: Sequence[Any]) -> List[np.ndarray]:
         texts = ["" if v is None else str(v) for v in values]
+        if self._mode == "encode":
+            return list(np.asarray(self.embedder.encode(texts), np.float32))
         fn = self.embedder.func
-        if self._is_async:
+        if self._mode == "async":
 
             async def run():
                 return await asyncio.gather(*(fn(t) for t in texts))
 
             out = asyncio.run(run())
-        elif self._is_batched:
+        elif self._mode == "batched":
             arr = np.empty(len(texts), dtype=object)
             arr[:] = texts
             out = fn(arr)

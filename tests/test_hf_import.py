@@ -1,0 +1,209 @@
+"""Real-weights path: HF BERT import parity (vs a locally-constructed torch
+reference — no network), WordPiece tokenizer parity vs transformers, and a
+RAG end-to-end eval over live REST (VERDICT r2 #7; reference:
+xpacks/llm/embedders.py:270-330, integration_tests/rag_evals/)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.models.hf_import import (
+    BertConfig,
+    bert_forward,
+    load_bert_checkpoint,
+    mean_pool,
+)
+from pathway_tpu.models.wordpiece import WordPieceTokenizer
+
+VOCAB = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + ["the", "cat", "sat", "on", "mat", "dog", "chas", "##ed", "ball"]
+    + ["fish", "swim", "in", "sea", "stream", "##ing", "data", "##flow"]
+    + ["tpu", "index", "##es", "live", "quer", "##y", ".", ",", "!", "un"]
+    + ["##believ", "##able"]
+)
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    """A tiny random-init BERT checkpoint saved in the standard HF layout
+    (config.json + model.safetensors + vocab.txt) — built locally."""
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig as TorchBertConfig, BertModel
+
+    d = tmp_path_factory.mktemp("bert")
+    cfg = TorchBertConfig(
+        vocab_size=len(VOCAB),
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    model = BertModel(cfg)
+    model.eval()
+    model.save_pretrained(str(d), safe_serialization=True)
+    with open(d / "vocab.txt", "w") as f:
+        f.write("\n".join(VOCAB) + "\n")
+    return str(d)
+
+
+def test_wordpiece_matches_transformers(hf_dir):
+    from transformers import BertTokenizer
+
+    ours = WordPieceTokenizer(os.path.join(hf_dir, "vocab.txt"), max_length=32)
+    theirs = BertTokenizer(os.path.join(hf_dir, "vocab.txt"))
+    texts = [
+        "The cat sat on the mat.",
+        "a dog chased the ball!",
+        "unbelievable streaming dataflow indexes",
+        "fish swim in the sea, live query",
+        "UNKNOWNWORD cat",
+        "",
+    ]
+    for t in texts:
+        assert ours.encode(t) == theirs(t)["input_ids"], t
+
+
+def test_bert_forward_matches_torch(hf_dir):
+    import torch
+    from transformers import BertModel
+
+    cfg, params = load_bert_checkpoint(hf_dir)
+    model = BertModel.from_pretrained(hf_dir)
+    model.eval()
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(5, len(VOCAB), (3, 12)).astype(np.int32)
+    mask = np.ones((3, 12), np.int32)
+    mask[1, 8:] = 0
+    mask[2, 5:] = 0
+    ids[mask == 0] = 0
+
+    ours = np.asarray(bert_forward(params, ids, mask, cfg))
+    with torch.no_grad():
+        theirs = model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    # only real (unmasked) positions must match: HF computes garbage values
+    # at masked positions too, but downstream pooling ignores them
+    np.testing.assert_allclose(
+        ours[mask > 0], theirs[mask > 0], rtol=1e-4, atol=1e-4
+    )
+
+    pooled = np.asarray(mean_pool(ours, mask))
+    m = mask[:, :, None]
+    want = (theirs * m).sum(1) / m.sum(1)
+    np.testing.assert_allclose(pooled, want, rtol=1e-4, atol=1e-4)
+
+
+def test_sentence_encoder_loads_hf_checkpoint(hf_dir):
+    enc = SentenceEncoder(checkpoint_path=hf_dir, max_length=32)
+    assert isinstance(enc.tokenizer, WordPieceTokenizer)
+    assert enc.get_embedding_dimension() == 32
+    out = enc.encode(["the cat sat", "fish swim in the sea"])
+    assert out.shape == (2, 32)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+    np.testing.assert_array_equal(out, enc.encode(["the cat sat", "fish swim in the sea"]))
+
+
+def test_rag_e2e_rest_retrieval_hit_rate(hf_dir):
+    """The full serving loop as one test: docs -> on-TPU embed -> device
+    index -> REST server -> HTTP query -> retrieved text, scored for top-1
+    hit rate on a fixture corpus (reference: integration_tests/rag_evals/)."""
+    from pathway_tpu.stdlib.indexing import DataIndex, InnerIndex
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+
+    from .utils import free_port
+
+    enc = SentenceEncoder(checkpoint_path=hf_dir, max_length=32)
+    corpus = [
+        "the cat sat on the mat",
+        "a dog chased the ball",
+        "fish swim in the sea",
+        "streaming dataflow indexes on tpu",
+    ]
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str), [(t,) for t in corpus]
+    )
+    port = free_port()
+    queries, writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, schema=None, delete_completed_queries=True
+    )
+    index = DataIndex(
+        docs,
+        InnerIndex(
+            data_column=docs.text,
+            factory=BruteForceKnnFactory(dimension=32, embedder=enc),
+            dimension=32,
+        ),
+    )
+    result = index.query_as_of_now(queries.query, number_of_matches=1)
+    writer(result.select(text=docs.text))
+
+    t = threading.Thread(
+        target=lambda: pw.run(monitoring_level=None), daemon=True
+    )
+    t.start()
+    try:
+        import time
+
+        deadline = time.time() + 30
+        ready = False
+        while time.time() < deadline and not ready:
+            try:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=1)
+                ready = True
+            except urllib.error.HTTPError:
+                ready = True  # server answered (even with an error status)
+            except Exception:
+                time.sleep(0.3)
+        assert ready, "REST server did not come up"
+
+        hits = 0
+        eval_queries = [
+            ("the cat sat on the mat", "cat"),  # exact duplicate
+            ("dog chased ball", "dog"),  # keyword overlap
+            ("fish swim sea", "fish"),
+            ("streaming dataflow tpu", "tpu"),
+        ]
+        for q, kw in eval_queries:
+            body = json.dumps({"query": q}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read())
+            text = payload if isinstance(payload, str) else str(payload)
+            if kw in text:
+                hits += 1
+        assert hits >= 3, f"retrieval hit rate {hits}/4 below threshold"
+    finally:
+        from pathway_tpu.internals.run import terminate
+
+        terminate()
+        t.join(timeout=15)
+
+
+def test_wordpiece_cjk_and_control_chars(hf_dir, tmp_path):
+    from transformers import BertTokenizer
+
+    vocab = VOCAB + ["你", "好", "界"]  # note: 世 deliberately NOT in vocab
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab) + "\n")
+    ours = WordPieceTokenizer(str(vf), max_length=32)
+    theirs = BertTokenizer(str(vf))
+    for t in ["你好 cat", "你好世界", "the\x00 cat\x07 sat", "mixed你text"]:
+        assert ours.encode(t) == theirs(t)["input_ids"], repr(t)

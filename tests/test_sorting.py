@@ -83,7 +83,6 @@ def test_sort_incremental_insert_and_delete():
     session.insert(ka, ("alice", 25.0))
     session.insert(kb, ("bob", 20.0))
     ex.step()
-    _, cols = sorted_t._materialize()
     keys, cols = sorted_t._materialize()
     by_key = {int(k): (cols["prev"][i], cols["next"][i]) for i, k in enumerate(keys)}
     assert by_key[kb] == (None, np.uint64(ka))
@@ -142,3 +141,43 @@ def test_retrieve_prev_next_values_walks_over_nones():
         "c": ("a", "d"),
         "d": ("d", "d"),
     }
+
+
+def test_sort_randomized_matches_full_recompute():
+    """Property test: neighbour-local incremental relinking must equal a
+    from-scratch sort after every tick, across random insert/remove mixes."""
+    import random
+
+    rng = random.Random(7)
+    t, session = make_stream_table(age=float)
+    sorted_t = t.sort(key=t.age)
+    ex = make_executor()
+
+    live = {}
+    next_key = 1
+    for _tick in range(12):
+        for _ in range(rng.randint(1, 6)):
+            if live and rng.random() < 0.4:
+                k = rng.choice(list(live))
+                session.remove(k)
+                del live[k]
+            else:
+                k = int(ref_scalar(next_key))
+                next_key += 1
+                age = round(rng.uniform(0, 50), 1)
+                session.insert(k, (age,))
+                live[k] = age
+        ex.step()
+        keys, cols = sorted_t._materialize()
+        got = {
+            int(k): (cols["prev"][i], cols["next"][i])
+            for i, k in enumerate(keys)
+        }
+        order = sorted(live.items(), key=lambda kv: (kv[1], kv[0]))
+        want = {}
+        for i, (k, _age) in enumerate(order):
+            want[k] = (
+                np.uint64(order[i - 1][0]) if i > 0 else None,
+                np.uint64(order[i + 1][0]) if i < len(order) - 1 else None,
+            )
+        assert got == want, f"tick {_tick}: links diverge from oracle"
