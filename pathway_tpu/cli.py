@@ -110,6 +110,50 @@ def spawn_program(
         return 130
 
 
+def run_template(
+    template: str,
+    host: Optional[str] = None,
+    port: Optional[int] = None,
+) -> int:
+    """Load a YAML template app (the L7 surface — reference template apps,
+    docs/2.developers/7.templates/) and serve it: a ``question_answerer``
+    gets the QA REST routes, a bare ``document_store`` the retrieval routes,
+    and a plain pipeline just runs."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # the TPU plugin registers at interpreter startup (sitecustomize);
+        # honor the env var by flipping the config before first backend use
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from pathway_tpu.internals.yaml_loader import load_yaml
+
+    with open(template) as f:
+        cfg = load_yaml(f)
+    if not isinstance(cfg, dict):
+        raise SystemExit(f"template {template} must be a mapping, got {type(cfg)}")
+    host = host or cfg.get("host", "127.0.0.1")
+    port = port or int(cfg.get("port", 8000))
+
+    qa = cfg.get("question_answerer")
+    if qa is not None:
+        qa.build_server(host=host, port=port)
+        print(f"serving QA endpoints at http://{host}:{port}", flush=True)
+        qa.run_server(with_cache=False)
+        return 0
+    store = cfg.get("document_store")
+    if store is not None:
+        from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
+
+        server = DocumentStoreServer(host, port, store)
+        print(f"serving DocumentStore at http://{host}:{port}", flush=True)
+        server.run(with_cache=False)
+        return 0
+    import pathway_tpu as pw
+
+    pw.run()
+    return 0
+
+
 def _persistence_env(args) -> Dict[str, str]:
     env: Dict[str, str] = {}
     if getattr(args, "record", False) or getattr(args, "mode", None):
@@ -172,7 +216,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     se.add_argument("program", nargs="?", default=None)
     se.add_argument("arguments", nargs=argparse.REMAINDER)
 
+    rn = sub.add_parser(
+        "run", help="run a YAML template app (see templates/)"
+    )
+    rn.add_argument("template", help="path to a template YAML")
+    rn.add_argument("--host", default=None, help="override the template host")
+    rn.add_argument(
+        "--port", type=int, default=None, help="override the template port"
+    )
+
     args = parser.parse_args(argv)
+
+    if args.command == "run":
+        return run_template(args.template, host=args.host, port=args.port)
 
     if args.command == "spawn-from-env":
         spawn_args = shlex.split(os.environ.get("PATHWAY_SPAWN_ARGS", ""))

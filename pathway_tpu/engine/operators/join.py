@@ -46,20 +46,6 @@ _NONE_PTR_SENTINELS = (
 )
 
 
-def _normalize_pointer_array(arr: np.ndarray, side: int) -> np.ndarray:
-    """Pointer columns may flow as dense uint64 arrays or object arrays of
-    np.uint64/Pointer scalars (e.g. out of groupby ``any`` reducers);
-    collapse the latter to dense uint64 so id-joins take the direct-key path
-    on both sides.  Columns with None holes stay on the hash path UNLESS the
-    operator declared pointer_keys at build time (see _join_keys) — the
-    encoding of a row's key must never depend on its delta's value mix."""
-    from ...internals.keys import Pointer
-
-    if arr.dtype == object and len(arr) and all(
-        isinstance(v, (np.uint64, Pointer)) for v in arr
-    ):
-        return arr.astype(np.uint64)
-    return arr
 
 
 class JoinOperator(EngineOperator):
@@ -122,7 +108,8 @@ class JoinOperator(EngineOperator):
         ctx_cols = self.left_ctx_cols if side == 0 else self.right_ctx_cols
         ctx = build_eval_context(delta, ctx_cols)
         if self.pointer_keys and len(exprs) == 1:
-            # declared pointer join: raw-uint64 keys, Nones -> side sentinel
+            # declared pointer join (ix / id joins, dtype-known pointer
+            # columns): raw-uint64 keys, Nones -> side sentinel
             arr = np.asarray(exprs[0]._eval(ctx))
             if arr.dtype == object:
                 sentinel = _NONE_PTR_SENTINELS[side]
@@ -131,13 +118,11 @@ class JoinOperator(EngineOperator):
                     dtype=np.uint64,
                 )
             return arr.astype(KEY_DTYPE)
-        vals = [
-            _normalize_pointer_array(np.asarray(e._eval(ctx)), side)
-            for e in exprs
-        ]
-        if len(vals) == 1 and vals[0].dtype == np.uint64:
-            # joining directly on key values (id joins / ix)
-            return vals[0].astype(KEY_DTYPE)
+        # undeclared: ALWAYS hash — the serialization tags values by their
+        # own type, so both sides agree regardless of how each delta mixes
+        # Nones/uint64s (a per-delta direct-path heuristic would let one
+        # row's insertion and retraction disagree on its join key)
+        vals = [np.asarray(e._eval(ctx)) for e in exprs]
         return ref_scalars_batch(vals)
 
     def _row(self, lrow: Optional[Tuple], rrow: Optional[Tuple]) -> Tuple[Any, ...]:

@@ -1,7 +1,12 @@
 """YAML template config loader
 (reference: python/pathway/internals/yaml_loader.py:74-218 — ``$variables``
 and ``!pw.<path>`` tags instantiating python objects, used by RAG app
-templates)."""
+templates; see /root/repo/templates/).
+
+Construction is two-pass: the YAML is first parsed into plain data with
+``!pw.`` tags held as deferred nodes, then ``$variables`` are substituted,
+then objects instantiate bottom-up — so variables work inside constructor
+arguments, and anchors (&x / *x) share ONE constructed object."""
 
 from __future__ import annotations
 
@@ -18,7 +23,7 @@ class PathwayYamlLoader(yaml.SafeLoader):
 
 
 def _resolve_callable(path: str) -> Any:
-    """Resolve a dotted path like ``pw.xpacks.llm.embedders.SentenceTransformerEmbedder``."""
+    """Resolve a dotted path like ``pw.xpacks.llm.embedders.TpuEmbedder``."""
     parts = path.split(".")
     if parts[0] in ("pw", "pathway", "pathway_tpu"):
         module_name = "pathway_tpu"
@@ -26,52 +31,115 @@ def _resolve_callable(path: str) -> Any:
     else:
         module_name = parts[0]
         parts = parts[1:]
+    import types
+
     obj = importlib.import_module(module_name)
-    for i, part in enumerate(parts):
+    for part in parts:
         if hasattr(obj, part):
             obj = getattr(obj, part)
+        elif isinstance(obj, types.ModuleType):
+            # walk into a submodule not imported by the parent package
+            obj = importlib.import_module(obj.__name__ + "." + part)
         else:
-            module_name = module_name + "." + part
-            obj = importlib.import_module(module_name)
+            raise AttributeError(f"{obj!r} has no attribute {part!r} in {path}")
     return obj
 
 
+class _Deferred:
+    """A ``!pw.<path>`` node awaiting variable substitution before
+    instantiation."""
+
+    __slots__ = ("path", "kind", "payload")
+
+    def __init__(self, path: str, kind: str, payload: Any):
+        self.path = path
+        self.kind = kind
+        self.payload = payload
+
+
 def _construct_pw_object(loader: PathwayYamlLoader, tag_suffix: str, node: yaml.Node):
-    target = _resolve_callable(tag_suffix)
+    # the registered "!pw." prefix is stripped by yaml before we see the
+    # suffix, so "xpacks.llm..." is relative to pathway_tpu unless the user
+    # spelled a full module root themselves
+    if tag_suffix.split(".")[0] not in ("pw", "pathway", "pathway_tpu"):
+        tag_suffix = "pw." + tag_suffix
     if isinstance(node, yaml.MappingNode):
-        kwargs = loader.construct_mapping(node, deep=True)
-        return target(**kwargs)
+        return _Deferred(
+            tag_suffix, "map", loader.construct_mapping(node, deep=True)
+        )
     if isinstance(node, yaml.SequenceNode):
-        args = loader.construct_sequence(node, deep=True)
-        return target(*args)
-    value = loader.construct_scalar(node)
-    if value in (None, ""):
-        return target() if callable(target) else target
-    return target(value)
+        return _Deferred(
+            tag_suffix, "seq", loader.construct_sequence(node, deep=True)
+        )
+    return _Deferred(tag_suffix, "scalar", loader.construct_scalar(node))
 
 
 yaml.add_multi_constructor("!pw.", _construct_pw_object, Loader=PathwayYamlLoader)
 yaml.add_multi_constructor("!pw:", _construct_pw_object, Loader=PathwayYamlLoader)
 
 
-def _resolve_variables(obj: Any, variables: Dict[str, Any]) -> Any:
+def _instantiate(
+    obj: Any,
+    variables: Dict[str, Any],
+    memo: Dict[int, Any],
+    _visiting: tuple = (),
+) -> Any:
+    """Bottom-up: substitute $variables, then build deferred objects.  The
+    memo keeps anchored (&x / *x) deferred nodes single-instance."""
+    if isinstance(obj, _Deferred):
+        if id(obj) in memo:
+            return memo[id(obj)]
+        target = _resolve_callable(obj.path)
+        payload = _instantiate(obj.payload, variables, memo, _visiting)
+        if obj.kind == "map":
+            result = target(**payload)
+        elif obj.kind == "seq":
+            result = target(*payload)
+        elif payload in (None, ""):
+            result = target() if callable(target) else target
+        else:
+            result = target(payload)
+        memo[id(obj)] = result
+        return result
     if isinstance(obj, dict):
-        return {k: _resolve_variables(v, variables) for k, v in obj.items()}
+        return {
+            k: _instantiate(v, variables, memo, _visiting)
+            for k, v in obj.items()
+        }
     if isinstance(obj, list):
-        return [_resolve_variables(v, variables) for v in obj]
+        return [_instantiate(v, variables, memo, _visiting) for v in obj]
     if isinstance(obj, str) and obj.startswith("$"):
         name = obj[1:]
         if name in variables:
-            return variables[name]
+            if name in _visiting:
+                chain = " -> ".join((*_visiting, name))
+                raise ValueError(
+                    f"circular $variable reference in template: {chain}"
+                )
+            # a variable may itself be (or contain) a deferred object; the
+            # memo keeps it single-instance across references
+            return _instantiate(
+                variables[name], variables, memo, (*_visiting, name)
+            )
     return obj
 
 
 def load_yaml(stream: Union[str, IO]) -> Any:
     """Load a template config; top-level ``$name: value`` entries define
-    variables referenced as ``$name`` elsewhere."""
+    variables referenced as ``$name`` anywhere — including inside ``!pw.``
+    constructor arguments."""
     data = yaml.load(stream, Loader=PathwayYamlLoader)
     if not isinstance(data, dict):
-        return data
-    variables = {k[1:]: v for k, v in data.items() if isinstance(k, str) and k.startswith("$")}
-    data = {k: v for k, v in data.items() if not (isinstance(k, str) and k.startswith("$"))}
-    return _resolve_variables(data, variables)
+        return _instantiate(data, {}, {})
+    variables = {
+        k[1:]: v
+        for k, v in data.items()
+        if isinstance(k, str) and k.startswith("$")
+    }
+    memo: Dict[int, Any] = {}
+    data = {
+        k: v
+        for k, v in data.items()
+        if not (isinstance(k, str) and k.startswith("$"))
+    }
+    return _instantiate(data, variables, memo)

@@ -46,11 +46,15 @@ def _is_cjk(cp: int) -> bool:
 
 
 def _clean(text: str) -> str:
-    """BERT text cleanup: drop control chars and NUL, isolate CJK chars with
-    spaces so they tokenize per character."""
+    """BERT text cleanup: tab/newline/CR become spaces, other control chars
+    and NUL are dropped, CJK chars get space-isolated so they tokenize per
+    character (mirrors BertTokenizer's _clean_text + CJK handling)."""
     out = []
     for ch in text:
         cp = ord(ch)
+        if ch in ("\t", "\n", "\r"):
+            out.append(" ")
+            continue
         if cp == 0 or cp == 0xFFFD or unicodedata.category(ch).startswith("C"):
             continue
         if _is_cjk(cp):

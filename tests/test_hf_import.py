@@ -205,5 +205,13 @@ def test_wordpiece_cjk_and_control_chars(hf_dir, tmp_path):
     vf.write_text("\n".join(vocab) + "\n")
     ours = WordPieceTokenizer(str(vf), max_length=32)
     theirs = BertTokenizer(str(vf))
-    for t in ["你好 cat", "你好世界", "the\x00 cat\x07 sat", "mixed你text"]:
+    for t in [
+        "你好 cat",
+        "你好世界",
+        "the\x00 cat\x07 sat",
+        "mixed你text",
+        "the cat sat\non the mat",
+        "tab\tseparated\twords",
+        "crlf line\r\nbreaks",
+    ]:
         assert ours.encode(t) == theirs(t)["input_ids"], repr(t)

@@ -1,0 +1,121 @@
+"""L7 template tests: the YAML loader's object construction and the
+adaptive-RAG template served end-to-end through the CLI
+(reference: docs/2.developers/7.templates/.adaptive-rag/article.py)."""
+
+from __future__ import annotations
+
+import io
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from .utils import REPO_ROOT, free_port
+
+
+def test_yaml_loader_variables_inside_constructors():
+    from pathway_tpu.internals.yaml_loader import load_yaml
+
+    cfg = load_yaml(
+        io.StringIO(
+            """
+$dim: 24
+shared: &enc !pw.xpacks.llm.embedders.TpuEmbedder
+  dimension: $dim
+  n_layers: 1
+  max_length: 32
+again: *enc
+number: $dim
+"""
+        )
+    )
+    assert cfg["number"] == 24
+    assert cfg["shared"].get_embedding_dimension() == 24
+    assert cfg["again"] is cfg["shared"], "anchor must share one instance"
+
+
+def test_yaml_loader_resolves_nested_modules():
+    from pathway_tpu.internals.yaml_loader import _resolve_callable
+
+    assert _resolve_callable(
+        "pw.xpacks.llm.question_answering.AdaptiveRAGQuestionAnswerer"
+    ).__name__ == "AdaptiveRAGQuestionAnswerer"
+    assert _resolve_callable("pw.stdlib.indexing.BruteForceKnnFactory")
+
+
+@pytest.mark.slow
+def test_adaptive_rag_template_serves_end_to_end():
+    """python -m pathway_tpu.cli run templates/adaptive_rag.yaml answers a
+    query end-to-end (the VERDICT r2 #9 acceptance)."""
+    port = free_port()
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu.cli",
+            "run",
+            "templates/adaptive_rag.yaml",
+            "--port",
+            str(port),
+        ],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def post(route, payload, timeout):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{route}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    try:
+        deadline = time.time() + 120
+        up = False
+        while time.time() < deadline and not up:
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                raise AssertionError(f"template app died:\n{out[-3000:]}")
+            try:
+                post("/v1/retrieve", {"query": "cats", "k": 1}, timeout=5)
+                up = True
+            except Exception:
+                time.sleep(1.0)
+        assert up, "template server did not come up"
+
+        docs = post("/v1/retrieve", {"query": "anything", "k": 3}, timeout=60)
+        assert len(docs) == 3
+        assert all("text" in d and "metadata" in d for d in docs)
+        paths = {d["metadata"]["path"] for d in docs}
+        assert any("sample_documents" in p for p in paths)
+
+        answer = post(
+            "/v1/pw_ai_answer", {"prompt": "What do cats do?"}, timeout=180
+        )
+        assert isinstance(answer, str) and answer.strip(), answer
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_yaml_loader_circular_variables_raise():
+    import io as _io
+
+    import pytest as _pytest
+
+    from pathway_tpu.internals.yaml_loader import load_yaml
+
+    with _pytest.raises(ValueError, match="circular"):
+        load_yaml(_io.StringIO("$a: $b\n$b: $a\nx: $a\n"))
