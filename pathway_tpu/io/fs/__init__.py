@@ -243,11 +243,17 @@ def write(table: Table, filename: str, *, format: str = "csv", **kwargs) -> None
     else:
         raise ValueError(f"unknown format {format!r}")
 
+    def on_time_end(time):
+        # flush once per commit tick: a crashed streaming job must not lose
+        # rows of already-committed times to OS buffering (the recovery
+        # contract, tests/test_recovery_e2e.py)
+        f.flush()
+
     def on_end():
         f.flush()
         f.close()
 
-    subscribe(table, on_change=on_change, on_end=on_end)
+    subscribe(table, on_change=on_change, on_time_end=on_time_end, on_end=on_end)
 
 
 # shared JSON coercion lives in the connector runtime

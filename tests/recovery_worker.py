@@ -1,0 +1,40 @@
+"""Subprocess worker for the SIGKILL crash-recovery e2e
+(tests/test_recovery_e2e.py; reference:
+integration_tests/wordcount/base.py — a persistent streaming wordcount the
+harness repeatedly kills and restarts).
+
+Env: RECOVERY_DATA_DIR (csv input dir, watched), RECOVERY_OUT (output csv),
+plus the standard PATHWAY_PERSISTENT_STORAGE / PATHWAY_PERSISTENCE_MODE /
+PATHWAY_SNAPSHOT_INTERVAL_MS persistence vars consumed by pw.run().
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    class Row(pw.Schema):
+        word: str
+
+    docs = pw.io.csv.read(
+        os.environ["RECOVERY_DATA_DIR"],
+        schema=Row,
+        mode="streaming",
+        poll_interval_s=0.1,
+        persistent_id="wc_input",
+    )
+    counts = docs.groupby(docs.word).reduce(
+        word=docs.word, count=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, os.environ["RECOVERY_OUT"])
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+
+
+if __name__ == "__main__":
+    main()
