@@ -300,12 +300,26 @@ class RowStore:
                     if diffs[i] > 0:
                         rows[key] = tuple(c[i] for c in cols)
                     else:
-                        rows.pop(key, None)
+                        cur = rows.get(key)
+                        if cur is None or rows_equal(
+                            cur, tuple(c[i] for c in cols)
+                        ):
+                            rows.pop(key, None)
                 return
         keys = delta.keys.tolist()
         if neg:
-            for key in keys[:neg]:
-                rows.pop(key, None)
+            # value-aware retraction: deltas from different upstream ports
+            # arrive in arbitrary order within a tick, so a stale retraction
+            # (old row) may land AFTER the key's new row was stored — only
+            # pop when the stored row is the one being retracted
+            if cols:
+                ret_rows = zip(*(list(c[:neg]) for c in cols))
+            else:
+                ret_rows = iter([()] * neg)
+            for key, row in zip(keys[:neg], ret_rows):
+                cur = rows.get(key)
+                if cur is None or rows_equal(cur, row):
+                    rows.pop(key, None)
         if neg < n:
             ins_keys = keys[neg:]
             if cols:

@@ -261,25 +261,73 @@ class ReindexOperator(EngineOperator):
 
 
 class ConcatOperator(EngineOperator):
-    """Disjoint union of N same-schema inputs (graph.rs: concat)."""
+    """Disjoint union of N same-schema inputs (graph.rs: concat).
+
+    ``checked=True`` (the default without a disjointness promise) tracks
+    which input each live key came from and raises on a cross-input
+    collision — a silent collision would overwrite rows in the output store
+    (the reference proves disjointness statically with its universe solver,
+    internals/universe_solver.py; ``pw.universes.
+    promise_are_pairwise_disjoint`` elides this runtime check)."""
 
     def __init__(
         self,
         inputs: Sequence[EngineTable],
         output: EngineTable,
         column_maps: Sequence[Mapping[str, str]],
+        checked: bool = True,
         name: str = "concat",
     ):
         super().__init__(inputs, output, name)
         self.column_maps = [dict(m) for m in column_maps]
+        self.checked = checked
+        # key -> {port: signed live count}; verified at tick end, because
+        # within a tick a key may legitimately migrate between inputs (the
+        # insertion from one filter branch can arrive before the retraction
+        # from the other)
+        self._ports: Dict[int, Dict[int, int]] = {}
+        self._suspects: set = set()
+
+    def snapshot_state(self):
+        return self._ports
+
+    def restore_state(self, state) -> None:
+        self._ports = state
 
     def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
+        if self.checked:
+            for key, diff in zip(delta.keys.tolist(), delta.diffs.tolist()):
+                ports = self._ports.setdefault(key, {})
+                c = ports.get(port, 0) + (1 if diff > 0 else -1)
+                if c == 0:
+                    ports.pop(port, None)
+                    if not ports:
+                        del self._ports[key]
+                else:
+                    ports[port] = c
+                    if sum(1 for v in ports.values() if v > 0) > 1:
+                        self._suspects.add(key)
         cmap = self.column_maps[port]
         return Delta(
             keys=delta.keys,
             diffs=delta.diffs,
             columns={out: delta.columns[src] for out, src in cmap.items()},
         )
+
+    def on_tick_end(self, ts: int):
+        if self._suspects:
+            for key in self._suspects:
+                ports = self._ports.get(key, {})
+                live = [p for p, c in ports.items() if c > 0]
+                if len(live) > 1:
+                    raise ValueError(
+                        f"concat inputs are not disjoint: key {key:#x} is "
+                        f"live in inputs {sorted(live)}; use concat_reindex, "
+                        "or promise disjointness with "
+                        "pw.universes.promise_are_pairwise_disjoint"
+                    )
+            self._suspects.clear()
+        return None
 
 
 class UpdateRowsOperator(EngineOperator):

@@ -204,6 +204,10 @@ def wrap(t: Any) -> DType:
         return t
     if t in _PY_MAP:
         return _PY_MAP[t]
+    from .keys import Pointer
+
+    if t is Pointer:
+        return POINTER
     origin = get_origin(t)
     if origin is Union:
         args = [a for a in get_args(t) if a is not type(None)]

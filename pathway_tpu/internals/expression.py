@@ -537,9 +537,14 @@ class IfElseExpression(ColumnExpression):
     def __init__(self, if_, then, else_):
         from .trace import trace_user_frame
 
-        self._if = smart_coerce(if_)
-        self._then = smart_coerce(then)
-        self._else = smart_coerce(else_)
+        def branch(v):
+            # None is a legitimate branch VALUE here (smart_coerce treats it
+            # as "absent" elsewhere)
+            return ColumnConstExpression(None) if v is None else smart_coerce(v)
+
+        self._if = branch(if_)
+        self._then = branch(then)
+        self._else = branch(else_)
         self._deps = (self._if, self._then, self._else)
         self._trace = trace_user_frame()
 

@@ -69,6 +69,26 @@ class SchemaMetaclass(type):
         for base in reversed(bases):
             columns.update(getattr(base, "__columns__", {}))
         annotations = namespace.get("__annotations__", {})
+        if any(isinstance(a, str) for a in annotations.values()):
+            # `from __future__ import annotations` in the user's module turns
+            # annotations into strings — resolve them PER KEY against the
+            # defining module's globals, so one TYPE_CHECKING-only name can't
+            # degrade every other column to ANY
+            import sys
+
+            module = sys.modules.get(namespace.get("__module__", ""), None)
+            module_globals = getattr(module, "__dict__", {})
+            resolved = {}
+            for key, annotation in annotations.items():
+                if isinstance(annotation, str):
+                    try:
+                        annotation = eval(  # noqa: S307 - annotation eval
+                            annotation, module_globals, dict(namespace)
+                        )
+                    except Exception:
+                        pass  # unresolvable name keeps its raw form (ANY)
+                resolved[key] = annotation
+            annotations = resolved
         for col_name, annotation in annotations.items():
             if col_name.startswith("__"):
                 continue

@@ -696,6 +696,11 @@ class Table:
             if set(t.column_names) != set(names):
                 raise ValueError("concat requires same columns")
         et = _new_engine_table(names, "concat")
+        promised = all(
+            a._universe.is_promised_disjoint(b._universe)
+            for i, a in enumerate(tables)
+            for b in tables[i + 1 :]
+        )
         _add_op(
             ConcatOperator(
                 [t._engine_table for t in tables],
@@ -704,6 +709,7 @@ class Table:
                     {n: t._column_mapping[n] for n in names}
                     for t in tables
                 ],
+                checked=not promised,
             )
         )
         dtypes = dict(self._dtypes)
@@ -720,6 +726,11 @@ class Table:
             )
             for i, t in enumerate(tables)
         ]
+        # keys hash (old_id, i) with distinct i per input — disjoint by
+        # construction, so the concat skips its runtime collision check
+        for i, a in enumerate(reindexed):
+            for b in reindexed[i + 1 :]:
+                a._universe.promise_disjoint(b._universe)
         return reindexed[0].concat(*reindexed[1:])
 
     def update_rows(self, other: "Table") -> "Table":

@@ -22,6 +22,8 @@ class Universe:
         self.id = next(Universe._ids)
         self.parent = parent
         self._equal: Set[int] = {self.id}
+        # ids of universes promised disjoint from this one
+        self._disjoint: Set[int] = set()
 
     def subuniverse(self) -> "Universe":
         return Universe(parent=self)
@@ -41,6 +43,18 @@ class Universe:
         merged = self._equal | other._equal
         self._equal = merged
         other._equal = merged
+
+    def promise_disjoint(self, other: "Universe") -> None:
+        """User vouches the two key sets never intersect (reference
+        promise_are_pairwise_disjoint) — concat then skips its runtime
+        collision check."""
+        self._disjoint.update(other._equal)
+        other._disjoint.update(self._equal)
+
+    def is_promised_disjoint(self, other: "Universe") -> bool:
+        return bool(self._disjoint & other._equal) or bool(
+            other._disjoint & self._equal
+        )
 
     def __repr__(self):  # pragma: no cover
         return f"<Universe {self.id}>"

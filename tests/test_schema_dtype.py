@@ -1,0 +1,234 @@
+"""Schema + dtype system tests (reference suites: schema/dtype coverage in
+python/pathway/tests/ — class schemas, column_definition, schema algebra,
+dtype wrapping/optional/lca) and universe disjointness promises."""
+
+from __future__ import annotations
+
+import datetime
+from typing import Optional
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.keys import Pointer
+
+from .utils import T, run_all
+
+
+# ---------------------------------------------------------------------------
+# schemas
+# ---------------------------------------------------------------------------
+
+
+def test_schema_class_columns_and_primary_key():
+    class S(pw.Schema):
+        doc_id: int = pw.column_definition(primary_key=True)
+        text: str
+        rank: float = pw.column_definition(default_value=0.0)
+
+    assert S.column_names() == ["doc_id", "text", "rank"]
+    assert S.primary_key_columns() == ["doc_id"]
+    assert S.default_values() == {"rank": 0.0}
+    hints = S.typehints()
+    assert hints["doc_id"] == dt.INT
+    assert hints["text"] == dt.STR
+
+
+def test_schema_inheritance_and_union():
+    class A(pw.Schema):
+        x: int
+
+    class B(pw.Schema):
+        y: str
+
+    class C(A):
+        z: float
+
+    assert C.column_names() == ["x", "z"]
+    union = A | B
+    assert union.column_names() == ["x", "y"]
+
+
+def test_schema_with_types_and_without():
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    s2 = S.with_types(a=float)
+    assert s2.typehints()["a"] == dt.FLOAT
+    assert s2.typehints()["b"] == dt.STR
+    s3 = S.without("b")
+    assert s3.column_names() == ["a"]
+    with pytest.raises(ValueError):
+        S.with_types(missing=int)
+
+
+def test_schema_from_types_roundtrip():
+    s = pw.schema_from_types(u=int, v=str)
+    assert s.column_names() == ["u", "v"]
+
+
+def test_primary_key_drives_row_identity():
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    rows1 = pw.debug.table_from_rows(S, [(1, "a"), (2, "b")])
+    rows2 = pw.debug.table_from_rows(S, [(1, "x")])
+    keys1, _ = rows1._materialize()
+    keys2, _ = rows2._materialize()
+    assert set(map(int, keys2)) <= set(map(int, keys1)), (
+        "same primary key must map to the same pointer"
+    )
+
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+
+
+def test_wrap_basic_python_types():
+    assert dt.wrap(int) == dt.INT
+    assert dt.wrap(float) == dt.FLOAT
+    assert dt.wrap(str) == dt.STR
+    assert dt.wrap(bytes) == dt.BYTES
+    assert dt.wrap(bool) == dt.BOOL
+    assert dt.wrap(Pointer) == dt.POINTER
+    assert dt.wrap(datetime.timedelta) == dt.DURATION
+
+
+def test_wrap_optional_and_unoptionalize():
+    o = dt.wrap(Optional[int])
+    assert dt.is_optional(o)
+    assert dt.unoptionalize(o) == dt.INT
+    assert not dt.is_optional(dt.INT)
+    assert dt.unoptionalize(dt.INT) == dt.INT
+
+
+def test_value_compatibility():
+    assert dt.INT.is_value_compatible(3)
+    assert dt.INT.is_value_compatible(np.int64(3))
+    assert not dt.STR.is_value_compatible(3)
+    assert dt.FLOAT.is_value_compatible(3)  # ints widen to float
+    assert dt.wrap(Optional[str]).is_value_compatible(None)
+
+
+def test_types_lca():
+    assert dt.types_lca(dt.INT, dt.INT) == dt.INT
+    assert dt.types_lca(dt.INT, dt.FLOAT) == dt.FLOAT
+    lca = dt.types_lca(dt.INT, dt.STR)
+    assert lca == dt.ANY or lca.name == "ANY"
+
+
+def test_ndarray_dtype():
+    arr_t = dt.wrap(np.ndarray)
+    assert arr_t.is_value_compatible(np.zeros(3))
+
+
+# ---------------------------------------------------------------------------
+# universes: disjointness promises gate concat checking
+# ---------------------------------------------------------------------------
+
+
+def test_concat_disjoint_tables_work():
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    a = pw.debug.table_from_rows(S, [(1, 1)])
+    b = pw.debug.table_from_rows(S, [(2, 2)])
+    out = a.concat(b)
+    run_all()
+    _, cols = out._materialize()
+    assert sorted(cols["v"]) == [1, 2]
+
+
+def test_concat_overlapping_keys_raise_without_promise():
+    from pathway_tpu.internals.trace import EngineErrorWithTrace
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    a = pw.debug.table_from_rows(S, [(1, "a")])
+    b = pw.debug.table_from_rows(S, [(1, "b")])  # same primary key -> same id
+    a.concat(b)
+    with pytest.raises(EngineErrorWithTrace, match="not disjoint"):
+        run_all()
+
+
+def test_concat_with_promise_skips_check():
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    a = pw.debug.table_from_rows(S, [(1, "a")])
+    b = pw.debug.table_from_rows(S, [(2, "b")])
+    pw.universes.promise_are_pairwise_disjoint(a, b)
+    out = a.concat(b)
+    from pathway_tpu.engine.operators.rowwise import ConcatOperator
+
+    op = out._engine_table.producer
+    assert isinstance(op, ConcatOperator) and op.checked is False
+    run_all()
+    _, cols = out._materialize()
+    assert sorted(cols["v"]) == ["a", "b"]
+
+
+def test_concat_key_migration_within_tick_is_fine():
+    """A row flipping between filter branches must not trip the disjointness
+    check: the insertion from one branch and the retraction from the other
+    land in the same tick (reconciled at tick end)."""
+    from .test_temporal_behavior import make_executor, make_stream_table
+    from pathway_tpu.internals.keys import ref_scalar
+
+    t, session = make_stream_table(v=float)
+    hi = t.filter(pw.this.v > 10.0)
+    lo = t.filter(pw.this.v <= 10.0)
+    out = hi.concat(lo)
+    ex = make_executor()
+
+    k = int(ref_scalar(1))
+    session.insert(k, (5.0,))
+    ex.step()
+    session.insert(k, (20.0,))  # upsert flips the branch
+    ex.step()
+    _, cols = out._materialize()
+    assert list(cols["v"]) == [20.0]
+
+
+def test_schema_partial_annotation_resolution():
+    # simulate `from __future__ import annotations` with one bad name: the
+    # good columns must still resolve (not degrade to ANY wholesale)
+    namespace = {
+        "__annotations__": {"a": "int", "b": "NoSuchTypeAnywhere"},
+        "__module__": __name__,
+    }
+    from pathway_tpu.internals.schema import SchemaMetaclass, Schema
+
+    S = SchemaMetaclass("S", (Schema,), namespace)
+    hints = S.typehints()
+    assert hints["a"] == dt.INT
+    assert hints["b"] == dt.ANY
+
+
+def test_concat_reindex_skips_check_and_never_collides():
+    a = T("""
+    v
+    1
+    2
+    """)
+    b = T("""
+    v
+    3
+    """)
+    out = a.concat_reindex(b)
+    from pathway_tpu.engine.operators.rowwise import ConcatOperator
+
+    op = out._engine_table.producer
+    assert isinstance(op, ConcatOperator) and op.checked is False
+    run_all()
+    _, cols = out._materialize()
+    assert sorted(cols["v"]) == [1, 2, 3]
