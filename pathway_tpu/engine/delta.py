@@ -22,15 +22,25 @@ __all__ = ["Delta", "RowStore", "empty_delta", "rows_equal", "values_equal"]
 
 
 def values_equal(a: Any, b: Any) -> bool:
-    """Value equality that is safe for np.ndarray cells."""
+    """Value equality that is safe for np.ndarray cells.  NaN counts as
+    equal to NaN (value-identity semantics): a retraction rebuilt with the
+    same NaN cell must match the stored row, or the retraction would be
+    silently skipped and the row leak (RowStore.apply)."""
     if a is b:
         return True
     if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
         if not isinstance(a, np.ndarray) or not isinstance(b, np.ndarray):
             return False
-        return a.shape == b.shape and bool(np.array_equal(a, b))
+        if a.shape != b.shape:
+            return False
+        try:
+            return bool(np.array_equal(a, b, equal_nan=True))
+        except TypeError:  # non-numeric dtypes reject equal_nan
+            return bool(np.array_equal(a, b))
     if isinstance(a, tuple) and isinstance(b, tuple):
         return rows_equal(a, b)
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b or (a != a and b != b)
     try:
         return bool(a == b)
     except (ValueError, TypeError):

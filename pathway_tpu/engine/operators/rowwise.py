@@ -281,32 +281,45 @@ class ConcatOperator(EngineOperator):
         super().__init__(inputs, output, name)
         self.column_maps = [dict(m) for m in column_maps]
         self.checked = checked
-        # key -> {port: signed live count}; verified at tick end, because
-        # within a tick a key may legitimately migrate between inputs (the
-        # insertion from one filter branch can arrive before the retraction
-        # from the other)
-        self._ports: Dict[int, Dict[int, int]] = {}
+        # per-port live-key SET + a tiny pending-retraction side dict (a
+        # retraction can precede its matching insertion across deltas within
+        # one tick); collision suspects are verified at tick end, because a
+        # key may legitimately migrate between inputs within a tick.  All
+        # bulk state updates are C-level set ops — no per-row Python loop on
+        # the hot path.
+        self._live: List[set] = [set() for _ in inputs]
+        self._pending_neg: List[Dict[int, int]] = [{} for _ in inputs]
         self._suspects: set = set()
 
     def snapshot_state(self):
-        return self._ports
+        return {"live": self._live, "pending": self._pending_neg}
 
     def restore_state(self, state) -> None:
-        self._ports = state
+        self._live = state["live"]
+        self._pending_neg = state["pending"]
 
     def process(self, port: int, delta: Delta, ts: int) -> Optional[Delta]:
         if self.checked:
-            for key, diff in zip(delta.keys.tolist(), delta.diffs.tolist()):
-                ports = self._ports.setdefault(key, {})
-                c = ports.get(port, 0) + (1 if diff > 0 else -1)
-                if c == 0:
-                    ports.pop(port, None)
-                    if not ports:
-                        del self._ports[key]
-                else:
-                    ports[port] = c
-                    if sum(1 for v in ports.values() if v > 0) > 1:
-                        self._suspects.add(key)
+            pos = delta.diffs > 0
+            inserted = set(delta.keys[pos].tolist())
+            removed = set(delta.keys[~pos].tolist())
+            live = self._live[port]
+            pending = self._pending_neg[port]
+            for key in removed - live:  # early retraction: usually empty
+                pending[key] = pending.get(key, 0) + 1
+            live -= removed
+            if pending:
+                cancelled = inserted & pending.keys()
+                for key in cancelled:
+                    if pending[key] == 1:
+                        del pending[key]
+                    else:
+                        pending[key] -= 1
+                inserted -= cancelled
+            live |= inserted
+            for other_port, other in enumerate(self._live):
+                if other_port != port:
+                    self._suspects |= inserted & other
         cmap = self.column_maps[port]
         return Delta(
             keys=delta.keys,
@@ -317,12 +330,11 @@ class ConcatOperator(EngineOperator):
     def on_tick_end(self, ts: int):
         if self._suspects:
             for key in self._suspects:
-                ports = self._ports.get(key, {})
-                live = [p for p, c in ports.items() if c > 0]
-                if len(live) > 1:
+                owners = [p for p, live in enumerate(self._live) if key in live]
+                if len(owners) > 1:
                     raise ValueError(
                         f"concat inputs are not disjoint: key {key:#x} is "
-                        f"live in inputs {sorted(live)}; use concat_reindex, "
+                        f"live in inputs {owners}; use concat_reindex, "
                         "or promise disjointness with "
                         "pw.universes.promise_are_pairwise_disjoint"
                     )

@@ -51,6 +51,34 @@ def render_metrics(graph) -> str:
             f"pathway_operator_process_seconds_total{{{label}}} "
             f"{op.process_ns / 1e9:.6f}"
         )
+    # per-connector ingestion/lag stats (reference: ConnectorMonitor,
+    # src/connectors/monitoring.rs:237 scraped by http_server.rs)
+    from ..io._offsets import connector_monitors
+
+    lines.append("# TYPE pathway_connector_rows_total counter")
+    lines.append("# TYPE pathway_connector_lag_seconds gauge")
+    lines.append("# TYPE pathway_connector_partitions gauge")
+    for mon in connector_monitors():
+        stats = mon.stats()
+        # id uniquifies the series: two sources may share a display name, and
+        # duplicate label sets would fail the whole Prometheus scrape
+        label = f'connector="{_sanitize(stats["name"])}",id="{mon.id}"'
+        lines.append(
+            f"pathway_connector_rows_total{{{label},kind=\"insert\"}} "
+            f"{stats['rows_inserted']}"
+        )
+        lines.append(
+            f"pathway_connector_rows_total{{{label},kind=\"delete\"}} "
+            f"{stats['rows_deleted']}"
+        )
+        if stats["lag_seconds"] is not None:
+            lines.append(
+                f"pathway_connector_lag_seconds{{{label}}} "
+                f"{stats['lag_seconds']:.3f}"
+            )
+        lines.append(
+            f"pathway_connector_partitions{{{label}}} {stats['partitions']}"
+        )
     lines.append("")
     return "\n".join(lines)
 

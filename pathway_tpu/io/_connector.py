@@ -51,6 +51,7 @@ class SessionWriter:
         dtypes: Mapping[str, dt.DType],
         salt: int = 0,
         track_value_deletions: bool = False,
+        name: str = "source",
     ):
         self.session = session
         self.column_names = list(column_names)
@@ -70,6 +71,10 @@ class SessionWriter:
         # set by the PersistenceManager when a persistence config is active
         # (persistence/engine_state.py SourcePersistence)
         self.persistence = None
+        # per-connector lag/offset stats, scraped by /metrics (io/_offsets.py)
+        from ._offsets import ConnectorMonitor
+
+        self.monitor = ConnectorMonitor(name)
 
     def key_of(self, values: Mapping[str, Any]) -> int:
         if self.primary_key:
@@ -102,6 +107,7 @@ class SessionWriter:
             else:
                 key = self.key_of(values)
         self.session.insert(key, row)
+        self.monitor.on_insert()
 
     def insert_rows(self, rows_values: Sequence[Mapping[str, Any]]) -> None:
         """Bulk insert: coerce + key a whole chunk, then hand it to the
@@ -129,6 +135,7 @@ class SessionWriter:
             auto = iter(sequential_keys(start, n_auto, salt=self._salt))
             keys = [int(next(auto)) if k is None else k for k in keys]
         self.session.insert_batch(keys, rows)
+        self.monitor.on_insert(len(rows))
 
     def remove(self, values: Mapping[str, Any], key: Optional[int] = None) -> None:
         values = coerce_row_types(values, self.dtypes)
@@ -157,8 +164,20 @@ class SessionWriter:
                     "schema has no primary key"
                 )
         self.session.remove(key)
+        self.monitor.on_delete()
+
+    def commit_offsets(self, offsets: Mapping[Any, Any]) -> None:
+        """Record committed per-partition read positions: persisted when a
+        persistence config is active, and always folded into the connector
+        monitor's offset antichain for lag/partition stats."""
+        from ._offsets import OffsetAntichain
+
+        if self.persistence is not None:
+            self.persistence.save_offsets(dict(offsets))
+        self.monitor.on_commit(OffsetAntichain(dict(offsets)))
 
     def close(self) -> None:
+        self.monitor.on_finish()
         self.session.close()
 
 
@@ -219,6 +238,7 @@ def register_source(
         dtypes,
         salt=salt,
         track_value_deletions=track_value_deletions,
+        name=name,
     )
     et = G.engine_graph.add_table(column_names, name)
     op = G.engine_graph.add_operator(

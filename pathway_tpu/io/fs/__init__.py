@@ -183,8 +183,7 @@ def read(
                     continue
                 parse_file(fpath, writer)
                 seen[fpath] = mtime
-            if pers is not None:
-                pers.save_offsets(dict(seen))
+            writer.commit_offsets(seen)
 
         return register_source(
             schema, runner, mode="static", name=name, persistent_id=persistent_id
@@ -206,8 +205,7 @@ def read(
                 # must not claim the file was fully read
                 parse_file(fpath, writer)
                 seen[fpath] = mtime
-                if pers is not None:
-                    pers.save_offsets(dict(seen))
+                writer.commit_offsets(seen)
             time.sleep(poll_interval_s)
 
     return register_source(

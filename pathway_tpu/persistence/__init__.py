@@ -13,7 +13,15 @@ import os
 from dataclasses import dataclass, field
 from typing import Optional
 
-__all__ = ["Backend", "Config", "PersistenceMode", "SnapshotAccess"]
+from .object_cache import CachedObjectStorage
+
+__all__ = [
+    "Backend",
+    "Config",
+    "PersistenceMode",
+    "SnapshotAccess",
+    "CachedObjectStorage",
+]
 
 
 class PersistenceMode(enum.Enum):

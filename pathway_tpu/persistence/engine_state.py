@@ -154,7 +154,14 @@ class SourcePersistence:
         self._meta["chunk_offsets"] = {
             s: o for s, o in chunk_offsets.items() if s <= rewind_to
         }
+        self._meta["sealed"] = min(
+            self._meta.get("sealed", 0), self._meta["chunks"]
+        )
         self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
+
+    #: merge the chunk log once it exceeds this many files (reference:
+    #: ConcreteSnapshotMerger background compaction, operator_snapshot.rs:337)
+    COMPACT_AFTER = 64
 
     def flush(self, frontier: int) -> None:
         with self._lock:
@@ -174,8 +181,67 @@ class SourcePersistence:
                 chunk_offsets = {}
                 self._meta["chunk_offsets"] = chunk_offsets
             chunk_offsets[seq] = offsets
+            if (
+                self._meta["chunks"] - self._meta.get("sealed", 0)
+                > self.COMPACT_AFTER
+            ):
+                self._compact()
         self._meta["offsets"] = offsets
         self._meta["frontier"] = frontier
+        self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
+
+    def _merge_range(self, start: int, end: int) -> None:
+        """Merge chunks [start, end) into one chunk at ``start``."""
+        merged: List[bytes] = []
+        last_intact = start - 1
+        for seq in range(start, end):
+            key = f"sources/{self.pid}/chunk-{seq:08d}"
+            blob = self.backend.get(key)
+            if not blob:
+                continue
+            if blob.startswith(self.CHUNK_MAGIC):
+                blob = blob[len(self.CHUNK_MAGIC):]
+            payloads, intact = scan(blob)
+            merged.extend(payloads)
+            last_intact = seq
+            if not intact:
+                break
+        self.backend.put(
+            f"sources/{self.pid}/chunk-{start:08d}",
+            self.CHUNK_MAGIC + b"".join(frame(p) for p in merged),
+        )
+        for seq in range(start + 1, end):
+            self.backend.delete(f"sources/{self.pid}/chunk-{seq:08d}")
+        chunk_offsets = dict(self._meta.get("chunk_offsets") or {})
+        kept = {s: o for s, o in chunk_offsets.items() if s < start}
+        kept[start] = chunk_offsets.get(last_intact)
+        self._meta["chunks"] = start + 1
+        self._meta["chunk_offsets"] = kept
+
+    def _compact(self) -> None:
+        """Tiered merge: seal the newest COMPACT_AFTER chunks into one
+        segment; when sealed segments pile up, merge them too.  Each event is
+        rewritten O(1) times per tier (amortized O(n log n) backend I/O over
+        a job's lifetime — a full-log rewrite every 64 flushes would be
+        quadratic).  File count stays <= 2*COMPACT_AFTER; byte growth is
+        inherent to an input log (OPERATOR_PERSISTING truncates bytes via
+        drop_log)."""
+        sealed = self._meta.get("sealed", 0)  # chunks below this are sealed
+        self._merge_range(sealed, self._meta["chunks"])
+        self._meta["sealed"] = sealed + 1
+        if self._meta["sealed"] > self.COMPACT_AFTER:
+            self._merge_range(0, self._meta["chunks"])
+            self._meta["sealed"] = 1
+
+    def drop_log(self) -> None:
+        """Delete every recorded chunk (OPERATOR_PERSISTING: once operator
+        snapshots cover the frontier, the input log before it is dead
+        weight — restores come from operator state, not replay)."""
+        for seq in range(self._meta["chunks"]):
+            self.backend.delete(f"sources/{self.pid}/chunk-{seq:08d}")
+        self._meta["chunks"] = 0
+        self._meta["sealed"] = 0
+        self._meta["chunk_offsets"] = {}
         self.backend.put(f"sources/{self.pid}/METADATA", pickle.dumps(self._meta))
 
 
@@ -216,12 +282,29 @@ class PersistenceManager:
         record = access in (SnapshotAccess.RECORD, SnapshotAccess.FULL)
         replay = access in (SnapshotAccess.REPLAY, SnapshotAccess.FULL)
         restored_ops = self.operator_mode and self._restore_operators()
+        # operator-mode commits truncate the input log (drop_log); if the
+        # operator snapshot then can't be used (format bump, mode switched
+        # back to input replay), the only safe recovery is a FULL re-ingest:
+        # reset source offsets so connectors re-read from the beginning
+        # instead of seeking past data whose log no longer exists
+        logs_dropped = bool(self._commit and self._commit.get("ops"))
+        reset_offsets = logs_dropped and not restored_ops
+        if reset_offsets:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "operator snapshots unusable but the input log was truncated "
+                "by OPERATOR_PERSISTING commits — resetting source offsets "
+                "for a full re-ingest (at-least-once recovery)"
+            )
         for src in graph.sources:
             pid = getattr(src, "persistent_id", None)
             writer = getattr(src, "writer", None)
             if not pid:
                 continue
             sp = SourcePersistence(self.backend, pid, record=record)
+            if reset_offsets:
+                sp.save_offsets(None)
             if writer is not None:
                 writer.persistence = sp
             if record:
@@ -309,6 +392,12 @@ class PersistenceManager:
                 }
             ),
         )
+        if ops_saved:
+            # the operator snapshot covers everything flushed above; the
+            # input log is no longer needed for recovery (this is what keeps
+            # OPERATOR_PERSISTING byte-bounded on long-running jobs)
+            for _src, sp in self._sources:
+                sp.drop_log()
 
     def finalize(self, ts: int) -> None:
         self.commit(ts)

@@ -1,0 +1,70 @@
+"""Cached object storage — blob cache for re-computable artifacts.
+
+The reference caches re-downloadable objects (fetched files, parse results)
+in persistent storage so restarts skip the re-download/re-parse
+(src/persistence/cached_object_storage.rs:377).  Here the cache is a thin
+keyed-blob layer over any ``PersistenceBackend`` (file/S3/memory) with
+version-aware keys: ``get_or_compute`` recomputes only when the (key,
+version) pair is unseen — e.g. a document parser keyed by (path, mtime)
+re-parses a file only when it actually changed across restarts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import threading
+from typing import Any, Callable, Optional
+
+from .backends import PersistenceBackend
+
+__all__ = ["CachedObjectStorage"]
+
+
+def _digest(key: Any, version: Any) -> str:
+    raw = pickle.dumps((key, version))
+    return hashlib.sha256(raw).hexdigest()
+
+
+class CachedObjectStorage:
+    def __init__(self, backend: PersistenceBackend, namespace: str = "objects"):
+        self.backend = backend
+        self.namespace = namespace
+        self._lock = threading.Lock()
+
+    def _blob_key(self, key: Any, version: Any) -> str:
+        return f"{self.namespace}/{_digest(key, version)}"
+
+    def get(self, key: Any, version: Any = None) -> Optional[Any]:
+        blob = self.backend.get(self._blob_key(key, version))
+        return pickle.loads(blob) if blob is not None else None
+
+    def contains(self, key: Any, version: Any = None) -> bool:
+        return self.backend.get(self._blob_key(key, version)) is not None
+
+    def put(self, key: Any, value: Any, version: Any = None) -> None:
+        self.backend.put(self._blob_key(key, version), pickle.dumps(value))
+
+    def invalidate(self, key: Any, version: Any = None) -> None:
+        self.backend.delete(self._blob_key(key, version))
+
+    def clear(self) -> None:
+        for k in self.backend.list_keys(f"{self.namespace}/"):
+            self.backend.delete(k)
+
+    def get_or_compute(
+        self, key: Any, compute: Callable[[], Any], version: Any = None
+    ) -> Any:
+        """Cached call: returns the stored value for (key, version), or runs
+        ``compute`` once and stores its result.  The lock only guards the
+        in-process race; backends are last-writer-wins like the reference."""
+        blob = self.backend.get(self._blob_key(key, version))
+        if blob is not None:
+            return pickle.loads(blob)
+        with self._lock:
+            blob = self.backend.get(self._blob_key(key, version))
+            if blob is not None:
+                return pickle.loads(blob)
+            value = compute()
+            self.backend.put(self._blob_key(key, version), pickle.dumps(value))
+            return value

@@ -1,0 +1,71 @@
+"""Offset antichain + connector lag monitoring (reference:
+src/connectors/offset.rs OffsetAntichain, monitoring.rs:237
+ConnectorMonitor)."""
+
+from __future__ import annotations
+
+import os
+
+import pathway_tpu as pw
+from pathway_tpu.io._offsets import ConnectorMonitor, OffsetAntichain, connector_monitors
+
+
+def test_antichain_advance_and_merge():
+    a = OffsetAntichain()
+    a.advance("part0.csv", 100)
+    a.advance("part0.csv", 50)  # offsets never move backwards
+    a.advance("part1.csv", 7)
+    assert a.get("part0.csv") == 100
+    assert len(a) == 2
+
+    b = OffsetAntichain({"part0.csv": 120, "part2.csv": 1})
+    merged = a.merge(b)
+    assert merged.as_dict() == {"part0.csv": 120, "part1.csv": 7, "part2.csv": 1}
+    assert merged.dominates(a) and merged.dominates(b)
+    assert not a.dominates(b)
+    assert OffsetAntichain.from_dict(merged.as_dict()) == merged
+
+
+def test_connector_monitor_counters_and_lag():
+    mon = ConnectorMonitor("test_src")
+    assert mon.lag_seconds() is None
+    mon.on_insert(10)
+    mon.on_delete(2)
+    mon.on_commit(OffsetAntichain({"p": 5}))
+    stats = mon.stats()
+    assert stats["rows_inserted"] == 10
+    assert stats["rows_deleted"] == 2
+    assert stats["commits"] == 1
+    assert stats["partitions"] == 1
+    assert stats["lag_seconds"] is not None and stats["lag_seconds"] < 5
+    assert mon in connector_monitors()
+
+
+def test_fs_connector_populates_monitor(tmp_path):
+    path = tmp_path / "in.csv"
+    path.write_text("word\nalpha\nbeta\n")
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.csv.read(str(path), schema=S, mode="static")
+    pw.io.null.write(t)
+    pw.run(monitoring_level=None)
+    mons = [m for m in connector_monitors() if m.name == "fs"]
+    assert mons, "fs connector must register a monitor"
+    mon = mons[-1]
+    assert mon.rows_inserted == 2
+    assert mon.finished
+    assert len(mon.offsets) == 1  # one ingested file partition
+
+    from pathway_tpu.internals.metrics import render_metrics
+
+    text = render_metrics(pw.G.engine_graph)
+    import re
+
+    assert re.search(
+        r'pathway_connector_rows_total\{connector="fs",id="\d+",'
+        r'kind="insert"\} 2',
+        text,
+    ), text
+    assert "pathway_connector_partitions" in text

@@ -243,3 +243,109 @@ def test_atomic_batch_source_replays_with_markers():
 
     e2 = build_replay()
     assert sorted(v for _, v in e2) == [1, 2, 3]
+
+
+def test_chunk_log_compaction_bounds_file_count():
+    """Many flushes must not grow the chunk-file count unboundedly
+    (reference: ConcreteSnapshotMerger, operator_snapshot.rs:337)."""
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.persistence.engine_state import SourcePersistence
+
+    backend = MemoryBackend()
+    sp = SourcePersistence(backend, "src")
+    n_flushes = SourcePersistence.COMPACT_AFTER + 20
+    for i in range(n_flushes):
+        sp.record(("insert", i))
+        sp.save_offsets({"pos": i})
+        sp.flush(frontier=i * 2)
+    chunk_files = [
+        k for k in backend.list_keys("sources/src/") if "chunk-" in k
+    ]
+    assert len(chunk_files) <= SourcePersistence.COMPACT_AFTER + 1
+
+    # replay still yields every event in order after compaction
+    sp2 = SourcePersistence(backend, "src")
+    events = sp2.replay_events()
+    assert events == [("insert", i) for i in range(n_flushes)]
+    assert sp2.offsets() == {"pos": n_flushes - 1}
+
+
+def test_drop_log_removes_chunks():
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.persistence.engine_state import SourcePersistence
+
+    backend = MemoryBackend()
+    sp = SourcePersistence(backend, "src")
+    for i in range(5):
+        sp.record(("insert", i))
+        sp.flush(frontier=i)
+    sp.drop_log()
+    assert not [
+        k for k in backend.list_keys("sources/src/") if "chunk-" in k
+    ]
+    sp2 = SourcePersistence(backend, "src")
+    assert sp2.replay_events() == []
+
+
+def test_cached_object_storage_roundtrip_and_versioning():
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.persistence.object_cache import CachedObjectStorage
+
+    cache = CachedObjectStorage(MemoryBackend())
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return {"parsed": [1, 2, 3]}
+
+    v1 = cache.get_or_compute(("a.pdf",), compute, version=100)
+    v2 = cache.get_or_compute(("a.pdf",), compute, version=100)
+    assert v1 == v2 == {"parsed": [1, 2, 3]}
+    assert len(calls) == 1, "second lookup must hit the cache"
+    # a new version (file modified) recomputes
+    cache.get_or_compute(("a.pdf",), compute, version=200)
+    assert len(calls) == 2
+    assert cache.contains(("a.pdf",), version=100)
+    cache.invalidate(("a.pdf",), version=100)
+    assert not cache.contains(("a.pdf",), version=100)
+    cache.clear()
+    assert not cache.contains(("a.pdf",), version=200)
+
+
+def test_operator_persisting_drops_input_log(tmp_path):
+    """After an operator snapshot covers the frontier, the input log is
+    truncated — OPERATOR_PERSISTING stays byte-bounded on long jobs."""
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.persistence.engine_state import PersistenceManager
+    from pathway_tpu.engine.executor import Executor
+    from pathway_tpu.engine.operators.io import InputSession, SourceOperator
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.keys import ref_scalar
+    from pathway_tpu.internals.table import Table
+    from pathway_tpu.internals.universe import Universe
+
+    backend = MemoryBackend()
+    session = InputSession(upsert=True)
+    et = pw.G.engine_graph.add_table(["word"], "s")
+    src = SourceOperator(et, session, {"word": dt.wrap(str)}, name="s")
+    src.persistent_id = "s"
+    pw.G.engine_graph.add_operator(src)
+    t = Table(et, {"word": dt.wrap(str)}, Universe(), short_name="s")
+    t.groupby(pw.this.word).reduce(word=pw.this.word, c=pw.reducers.count())
+
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(tmp_path)),
+        persistence_mode=pw.persistence.PersistenceMode.OPERATOR_PERSISTING,
+    )
+    manager = PersistenceManager(cfg)
+    manager.backend = backend
+    manager.attach(pw.G.engine_graph)
+    ex = Executor(pw.G.engine_graph)
+    pw.G.engine_graph.finalize()
+    session.insert(int(ref_scalar(1)), ("alpha",))
+    ex.step()
+    manager.commit(ts=1000)
+    assert not [
+        k for k in backend.list_keys("sources/s/") if "chunk-" in k
+    ], "operator snapshot must truncate the input log"
+    assert backend.get("COMMIT") is not None
