@@ -218,9 +218,59 @@ class IvfKnnFactory(_DeviceKnnFactory):
         return inner
 
 
+class DeviceLshKnn(DeviceKnn):
+    """Host-side LSH KNN (random-projection buckets + exact rescore) behind
+    the InnerIndexImpl protocol (stdlib/ml/_knn_lsh.py)."""
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: str = "cos",
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+    ):
+        from ...stdlib.ml._knn_lsh import LshKnnIndex
+
+        self.index = LshKnnIndex(
+            dimension=dimension,
+            metric=metric,
+            n_or=n_or,
+            n_and=n_and,
+            bucket_length=bucket_length,
+        )
+        self.metadata: Dict[int, Any] = {}
+
+
 class LshKnnFactory(_DeviceKnnFactory):
-    """Reference-name compatibility for the legacy LSH index
-    (nearest_neighbors.py:262)."""
+    """The reference's legacy LSH index (_knn_lsh.py:50-94), as a real
+    random-projection implementation — not an exact-index alias."""
+
+    def __init__(
+        self, *args, n_or: int = 20, n_and: int = 10,
+        bucket_length: float = 10.0, **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.n_or = n_or
+        self.n_and = n_and
+        self.bucket_length = bucket_length
+
+    def build_inner_index(self, dimension: Optional[int] = None):
+        dim = dimension or self.dimension
+        if dim is None:
+            raise ValueError("index factory needs the embedding dimension")
+        inner = DeviceLshKnn(
+            dimension=dim,
+            metric=self.metric,
+            n_or=self.n_or,
+            n_and=self.n_and,
+            bucket_length=self.bucket_length,
+        )
+        if self.embedder is not None:
+            from .embedding_adapter import EmbeddingIndexAdapter
+
+            return EmbeddingIndexAdapter(inner, self.embedder)
+        return inner
 
 
 # class-style aliases used by reference code/configs

@@ -1,0 +1,148 @@
+"""LSH KNN — random-projection bucketing with exact shortlist rescore.
+
+The reference's legacy pure-dataflow index (stdlib/ml/_knn_lsh.py:50-94):
+``n_or`` repetitions of ``n_and`` random hyperplane bits (cosine) or
+quantized line projections (euclidean) map each vector to buckets; queries
+union their buckets' members and rescore exactly.  Here the same scheme
+runs host-side with numpy (bucket upkeep is dict work; the rescore is a
+small dense matmul), conforming to the InnerIndexImpl protocol so it plugs
+into DataIndex like the device indexes.
+
+Operating guidance: DeviceKnnIndex (exact, MXU) and IvfKnnIndex (probed)
+dominate this on TPU — LshKnn exists for reference API parity and for
+host-only deployments."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["LshKnnIndex"]
+
+
+class LshKnnIndex:
+    """Same host API as DeviceKnnIndex: add / remove / search / len."""
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: str = "cos",
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+        seed: int = 0,
+    ):
+        from ...ops.knn import normalize_metric
+
+        self.dimension = dimension
+        self.metric = normalize_metric(metric)
+        self.n_or = n_or
+        self.n_and = n_and
+        self.bucket_length = bucket_length
+        self._lock = threading.RLock()
+        rng = np.random.default_rng(seed)
+        # [n_or, n_and, d] hyperplanes / projection lines
+        self._planes = rng.normal(size=(n_or, n_and, dimension)).astype(
+            np.float32
+        )
+        self._shifts = rng.uniform(0, bucket_length, size=(n_or, n_and)).astype(
+            np.float32
+        )
+        self._rows: Dict[int, np.ndarray] = {}
+        # per repetition: bucket signature -> set of keys
+        self._buckets: List[Dict[bytes, set]] = [{} for _ in range(n_or)]
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def _signatures(self, vectors: np.ndarray) -> np.ndarray:
+        """[B, n_or] bucket signatures (bytes) per repetition."""
+        proj = np.einsum("okd,bd->bok", self._planes, vectors)
+        if self.metric in ("cos", "dot"):
+            bits = (proj > 0).astype(np.uint8)  # hyperplane side
+        else:  # euclidean: quantized line projection
+            bits = np.floor(
+                (proj + self._shifts[None]) / self.bucket_length
+            ).astype(np.int64)
+        B = vectors.shape[0]
+        out = np.empty((B, self.n_or), dtype=object)
+        for b in range(B):
+            for o in range(self.n_or):
+                out[b, o] = bits[b, o].tobytes()
+        return out
+
+    def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+        with self._lock:
+            vectors = np.asarray(vectors, np.float32).reshape(
+                len(keys), self.dimension
+            )
+            existing = [int(k) for k in keys if int(k) in self._rows]
+            if existing:
+                self.remove(existing)
+            sigs = self._signatures(vectors)
+            for i, key in enumerate(keys):
+                key = int(key)
+                self._rows[key] = vectors[i]
+                for o in range(self.n_or):
+                    self._buckets[o].setdefault(sigs[i, o], set()).add(key)
+
+    def remove(self, keys: Sequence[int]) -> None:
+        with self._lock:
+            drop = [int(k) for k in keys if int(k) in self._rows]
+            if not drop:
+                return
+            vectors = np.stack([self._rows[k] for k in drop])
+            sigs = self._signatures(vectors)
+            for i, key in enumerate(drop):
+                del self._rows[key]
+                for o in range(self.n_or):
+                    bucket = self._buckets[o].get(sigs[i, o])
+                    if bucket is not None:
+                        bucket.discard(key)
+                        if not bucket:
+                            del self._buckets[o][sigs[i, o]]
+
+    def search(
+        self, queries: np.ndarray, k: int
+    ) -> List[List[Tuple[int, float]]]:
+        with self._lock:
+            queries = np.asarray(queries, np.float32).reshape(
+                -1, self.dimension
+            )
+            if queries.shape[0] == 0 or not self._rows:
+                return [[] for _ in range(queries.shape[0])]
+            sigs = self._signatures(queries)
+            out: List[List[Tuple[int, float]]] = []
+            for qi in range(queries.shape[0]):
+                candidates: set = set()
+                for o in range(self.n_or):
+                    candidates |= self._buckets[o].get(sigs[qi, o], set())
+                if not candidates:
+                    out.append([])
+                    continue
+                cand = sorted(candidates)
+                mat = np.stack([self._rows[c] for c in cand])
+                q = queries[qi]
+                if self.metric == "cos":
+                    denom = np.linalg.norm(mat, axis=1) * max(
+                        np.linalg.norm(q), 1e-9
+                    )
+                    scores = (mat @ q) / np.where(denom == 0, 1.0, denom)
+                elif self.metric == "dot":
+                    scores = mat @ q
+                else:  # l2sq ranking score: -squared distance
+                    scores = -np.sum((mat - q[None, :]) ** 2, axis=1)
+                order = np.argsort(-scores)[:k]
+                out.append([(cand[j], float(scores[j])) for j in order])
+            return out
+
+    def search_oversampled(
+        self, queries, k, accept, oversample: int = 4, max_rounds: int = 3
+    ):
+        from ...ops.knn import oversampled_filtered_search
+
+        return oversampled_filtered_search(
+            self, queries, k, accept, oversample=oversample, max_rounds=max_rounds
+        )

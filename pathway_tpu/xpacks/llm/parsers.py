@@ -13,6 +13,7 @@ __all__ = [
     "Utf8Parser",
     "ParseUnstructured",
     "UnstructuredParser",
+    "PdfParser",
     "PypdfParser",
     "ImageParser",
     "SlideParser",
@@ -88,18 +89,129 @@ class ParseUnstructured(UDF):
 UnstructuredParser = ParseUnstructured
 
 
+def _pdf_literal_string(raw: bytes) -> str:
+    """Decode a PDF literal string body (backslash escapes, octal)."""
+    out = bytearray()
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == 0x5C and i + 1 < len(raw):  # backslash
+            n = raw[i + 1]
+            mapped = {
+                0x6E: 0x0A, 0x72: 0x0D, 0x74: 0x09, 0x62: 0x08, 0x66: 0x0C,
+                0x28: 0x28, 0x29: 0x29, 0x5C: 0x5C,
+            }.get(n)
+            if mapped is not None:
+                out.append(mapped)
+                i += 2
+                continue
+            if 0x30 <= n <= 0x37:  # octal escape
+                j = i + 1
+                digits = b""
+                while j < len(raw) and len(digits) < 3 and 0x30 <= raw[j] <= 0x37:
+                    digits += bytes([raw[j]])
+                    j += 1
+                out.append(int(digits, 8) & 0xFF)
+                i = j
+                continue
+            i += 1  # line continuation / unknown escape: drop the backslash
+            continue
+        out.append(c)
+        i += 1
+    return out.decode("latin-1")
+
+
+def _pdf_extract_text(contents: bytes) -> List[str]:
+    """Minimal pure-python PDF text extraction: inflate every Flate stream
+    and collect the Tj/TJ/'-operator strings of its BT..ET text blocks.
+    Handles the simple-font PDFs that text exporters produce; CID/Type0
+    composite fonts need a real PDF library."""
+    import re
+    import zlib
+
+    texts: List[str] = []
+    for m in re.finditer(rb"stream\r?\n", contents):
+        start = m.end()
+        end = contents.find(b"endstream", start)
+        if end < 0:
+            continue
+        data = contents[start:end].rstrip(b"\r\n")
+        try:
+            data = zlib.decompress(data)
+        except zlib.error:
+            pass  # uncompressed stream (or an image) — try as-is
+        if b"BT" not in data:
+            continue
+        parts: List[str] = []
+        for block in re.findall(rb"BT(.*?)ET", data, re.S):
+            # literal strings followed by a show operator; TJ arrays mix
+            # strings and kerning numbers
+            for sm in re.finditer(
+                rb"\((?:[^()\\]|\\.)*\)|<[0-9A-Fa-f\s]+>", block
+            ):
+                token = sm.group(0)
+                tail = block[sm.end(): sm.end() + 24]
+                if not re.match(
+                    rb"\s*(?:Tj|'|\")|[^\[]*?\]\s*TJ", tail
+                ):
+                    continue
+                if token.startswith(b"("):
+                    parts.append(_pdf_literal_string(token[1:-1]))
+                else:
+                    hexed = re.sub(rb"\s", b"", token[1:-1])
+                    try:
+                        parts.append(bytes.fromhex(hexed.decode()).decode(
+                            "latin-1"
+                        ))
+                    except ValueError:
+                        pass
+            parts.append("\n")
+        text = "".join(parts).strip()
+        if text:
+            texts.append(text)
+    return texts
+
+
+class PdfParser(UDF):
+    """Pure-python PDF text extraction — no native PDF library in the image
+    (reference capability: parsers.py:746 PypdfParser).  Covers simple-font
+    Flate PDFs; composite-font documents should go through
+    ParseUnstructured/PypdfParser where those libraries are installed."""
+
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs):
+        def parse(contents: bytes) -> List[Chunk]:
+            out: List[Chunk] = []
+            for i, text in enumerate(_pdf_extract_text(bytes(contents))):
+                if apply_text_cleanup:
+                    text = " ".join(text.split())
+                if text:
+                    out.append((text, {"page": i}))
+            return out
+
+        super().__init__(parse, **kwargs)
+
+
 class PypdfParser(UDF):
-    """(reference: parsers.py:746 — pypdf text extraction; gated)"""
+    """(reference: parsers.py:746 — pypdf text extraction; falls back to the
+    pure-python PdfParser when pypdf is not installed)"""
 
     def __init__(self, apply_text_cleanup: bool = True, **kwargs):
         try:
             import pypdf
-        except ImportError as e:
-            raise ImportError("PypdfParser requires the `pypdf` package") from e
+        except ImportError:
+            pypdf = None
 
         def parse(contents: bytes) -> List[Chunk]:
             import io
 
+            if pypdf is None:
+                out: List[Chunk] = []
+                for i, text in enumerate(_pdf_extract_text(bytes(contents))):
+                    if apply_text_cleanup:
+                        text = " ".join(text.split())
+                    if text:
+                        out.append((text, {"page": i}))
+                return out
             reader = pypdf.PdfReader(io.BytesIO(contents))
             out = []
             for i, page in enumerate(reader.pages):
@@ -114,10 +226,27 @@ class PypdfParser(UDF):
 
 
 class ImageParser(UDF):
-    """(reference: parsers.py:396 — vision-LLM image description; here decodes
-    the image into an ndarray chunk for the CLIP image embedder path)."""
+    """(reference: parsers.py:396 — vision-LLM image description).  TPU-first
+    redesign: instead of a remote vision LLM, the optional ``labels`` list
+    zero-shot classifies the image with the local CLIP model and emits the
+    top labels as the chunk text (searchable); the decoded ndarray always
+    lands in metadata for the CLIP image-embedding index path."""
 
-    def __init__(self, downsize_to: int = 64, **kwargs):
+    def __init__(
+        self,
+        downsize_to: int = 64,
+        labels: Optional[List[str]] = None,
+        clip_model=None,
+        top_k_labels: int = 3,
+        **kwargs,
+    ):
+        clip = clip_model
+        if labels and clip is None:
+            from ...models.clip import ClipModel
+
+            clip = ClipModel(image_size=downsize_to)
+        label_vecs = None
+
         def parse(contents: bytes) -> List[Chunk]:
             import io
 
@@ -130,7 +259,19 @@ class ImageParser(UDF):
             img = Image.open(io.BytesIO(contents)).convert("RGB")
             img = img.resize((downsize_to, downsize_to))
             arr = np.asarray(img, dtype=np.float32) / 255.0
-            return [("", {"image": arr})]
+            text = ""
+            meta: Dict[str, Any] = {"image": arr}
+            if labels:
+                nonlocal label_vecs
+                if label_vecs is None:
+                    label_vecs = clip.encode_text(list(labels))
+                img_vec = clip.encode_image([arr])[0]
+                scores = label_vecs @ img_vec
+                order = scores.argsort()[::-1][:top_k_labels]
+                picked = [labels[i] for i in order]
+                text = ", ".join(picked)
+                meta["labels"] = picked
+            return [(text, meta)]
 
         super().__init__(parse, **kwargs)
 

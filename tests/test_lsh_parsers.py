@@ -1,0 +1,136 @@
+"""LSH index + parser coverage (reference: stdlib/ml/_knn_lsh.py,
+xpacks/llm/parsers.py PypdfParser/ImageParser)."""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+import pytest
+
+from pathway_tpu.stdlib.ml._knn_lsh import LshKnnIndex
+from pathway_tpu.xpacks.llm.parsers import ImageParser, PdfParser
+
+
+def test_lsh_cosine_recall_on_clustered_data():
+    from .test_ivf import clustered_corpus
+
+    n, dim = 2000, 32
+    data = clustered_corpus(n, dim, n_centers=40, noise_norm=0.5)
+    index = LshKnnIndex(dimension=dim, metric="cos", n_or=24, n_and=8, seed=2)
+    index.add(range(n), data)
+    assert len(index) == n
+
+    rng = np.random.default_rng(1)
+    qidx = rng.choice(n, 30, replace=False)
+    queries = data[qidx]
+    hits = 0
+    for i, qi in enumerate(qidx):
+        row = index.search(queries[i : i + 1], k=1)[0]
+        if row and row[0][0] == int(qi):
+            hits += 1
+    assert hits >= 27, f"self-NN recall {hits}/30"
+
+
+def test_lsh_euclidean_add_remove_upsert():
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(100, 8)).astype(np.float32)
+    index = LshKnnIndex(
+        dimension=8, metric="l2sq", n_or=16, n_and=4, bucket_length=4.0
+    )
+    index.add(range(100), data)
+    assert index.search(data[:1], k=1)[0][0][0] == 0
+    index.remove([0])
+    assert len(index) == 99
+    row = index.search(data[:1], k=3)[0]
+    assert all(key != 0 for key, _ in row)
+    # upsert: key 5 moves far away
+    far = data[5] + 100.0
+    index.add([5], far[None, :])
+    assert index.search(far[None, :], k=1)[0][0][0] == 5
+
+
+def test_lsh_factory_plugs_into_data_index():
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.indexing import DataIndex, InnerIndex, LshKnnFactory
+
+    rng = np.random.default_rng(3)
+    vecs = rng.normal(size=(30, 8)).astype(np.float32)
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, vec=np.ndarray),
+        [(f"d{i}", vecs[i]) for i in range(30)],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qv=np.ndarray), [(vecs[7],)]
+    )
+    index = DataIndex(
+        docs,
+        InnerIndex(
+            data_column=docs.vec,
+            factory=LshKnnFactory(dimension=8, n_or=24, n_and=4),
+            dimension=8,
+        ),
+    )
+    result = index.query_as_of_now(queries.qv, number_of_matches=1)
+    out = result.select(names=docs.name)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert cols["names"][0][0] == "d7"
+
+
+def make_simple_pdf(lines) -> bytes:
+    """Handcraft a tiny one-page PDF with a Flate-compressed text stream."""
+    def esc(line: str) -> bytes:
+        return (
+            line.replace("\\", "\\\\").replace("(", "\\(").replace(")", "\\)")
+        ).encode("latin-1")
+
+    content = b"BT /F1 12 Tf 72 720 Td " + b" ".join(
+        b"(%s) Tj 0 -14 Td" % esc(line) for line in lines
+    ) + b" ET"
+    compressed = zlib.compress(content)
+    stream_obj = (
+        b"4 0 obj\n<< /Length %d /Filter /FlateDecode >>\nstream\n" % len(compressed)
+        + compressed
+        + b"\nendstream\nendobj\n"
+    )
+    return (
+        b"%PDF-1.4\n"
+        b"1 0 obj\n<< /Type /Catalog /Pages 2 0 R >>\nendobj\n"
+        b"2 0 obj\n<< /Type /Pages /Kids [3 0 R] /Count 1 >>\nendobj\n"
+        b"3 0 obj\n<< /Type /Page /Parent 2 0 R /Contents 4 0 R >>\nendobj\n"
+        + stream_obj
+        + b"trailer\n<< /Root 1 0 R >>\n%%EOF\n"
+    )
+
+
+def test_pdf_parser_extracts_flate_text():
+    pdf = make_simple_pdf(["Hello TPU world", "Streaming (deltas) ok"])
+    parser = PdfParser()
+    chunks = parser.func(pdf)
+    assert chunks, "no text extracted"
+    text = " ".join(t for t, _ in chunks)
+    assert "Hello TPU world" in text
+    assert "Streaming (deltas) ok" in text
+
+
+def test_image_parser_decodes_and_optionally_labels():
+    pytest.importorskip("PIL")
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (48, 48), (200, 30, 30)).save(buf, format="PNG")
+    raw = buf.getvalue()
+
+    plain = ImageParser(downsize_to=32)
+    chunks = plain.func(raw)
+    assert len(chunks) == 1
+    text, meta = chunks[0]
+    assert meta["image"].shape == (32, 32, 3)
+    assert 0.0 <= meta["image"].max() <= 1.0
+
+    labelled = ImageParser(downsize_to=32, labels=["red square", "blue circle"])
+    text, meta = labelled.func(raw)[0]
+    assert text and "labels" in meta and len(meta["labels"]) == 2
