@@ -78,6 +78,11 @@ class EngineOperator:
         self.rows_in: int = 0
         self.rows_out: int = 0
         self.process_ns: int = 0
+        # per-tick latency probe (reference Prober/ProberStats,
+        # src/engine/progress_reporter.rs): time spent in this operator
+        # during the last completed tick
+        self.last_tick_ns: int = 0
+        self._tick_acc_ns: int = 0
         for port, table in enumerate(self.inputs):
             table.consumers.append((self, port))
         if output is not None:
@@ -189,7 +194,9 @@ class EngineGraph:
                 out = op.process(port, delta, ts)
             except Exception as exc:
                 reraise_with_trace(op, exc)
-            op.process_ns += _time.perf_counter_ns() - t0
+            elapsed = _time.perf_counter_ns() - t0
+            op.process_ns += elapsed
+            op._tick_acc_ns += elapsed
             op.rows_in += delta.n
             if out is not None and out.n > 0 and op.output is not None:
                 out = out.consolidated()
@@ -232,6 +239,10 @@ class EngineGraph:
             self._collect(op, out, pending)
         if pending:
             self.propagate(pending, ts)
+        # roll the per-tick latency probes (progress_reporter.rs analog)
+        for op in self.operators:
+            op.last_tick_ns = op._tick_acc_ns
+            op._tick_acc_ns = 0
 
     def flush_end(self, ts: int) -> None:
         pending: List[Tuple[EngineOperator, int, Delta]] = []

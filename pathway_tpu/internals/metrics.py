@@ -38,6 +38,7 @@ def render_metrics(graph) -> str:
         "# TYPE pathway_operator_rows_in_total counter",
         "# TYPE pathway_operator_rows_out_total counter",
         "# TYPE pathway_operator_process_seconds_total counter",
+        "# TYPE pathway_operator_last_tick_seconds gauge",
     ]
     total_rows = 0
     for table in graph.tables:
@@ -50,6 +51,10 @@ def render_metrics(graph) -> str:
         lines.append(
             f"pathway_operator_process_seconds_total{{{label}}} "
             f"{op.process_ns / 1e9:.6f}"
+        )
+        lines.append(
+            f"pathway_operator_last_tick_seconds{{{label}}} "
+            f"{op.last_tick_ns / 1e9:.6f}"
         )
     # per-connector ingestion/lag stats (reference: ConnectorMonitor,
     # src/connectors/monitoring.rs:237 scraped by http_server.rs)

@@ -33,6 +33,7 @@ from . import (
     python,
     redpanda,
     s3,
+    sharepoint,
     slack,
     sqlite,
 )
@@ -64,6 +65,7 @@ __all__ = [
     "python",
     "redpanda",
     "s3",
+    "sharepoint",
     "slack",
     "sqlite",
     "subscribe",

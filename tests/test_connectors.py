@@ -128,5 +128,13 @@ def test_gated_connectors_raise_clearly():
         pw.io.postgres.write(t, {}, "tbl")
     with pytest.raises(ImportError, match="pymongo"):
         pw.io.mongodb.write(t, "mongodb://x", "db", "coll")
-    with pytest.raises(ImportError, match="airbyte"):
-        pw.io.airbyte.read("cfg.yaml", ["users"])
+    # airbyte is a real protocol runner now (tests/test_airbyte_sharepoint.py);
+    # it raises only when neither an image nor an exec_command is given, at
+    # run time
+    with pytest.raises(ImportError, match="sharepoint"):
+        pw.io.sharepoint.read(
+            "https://x.sharepoint.com/sites/s",
+            root_path="Docs",
+            client_id="i",
+            client_secret="s",
+        )
