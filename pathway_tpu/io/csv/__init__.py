@@ -8,7 +8,10 @@ from ...internals.schema import Schema
 from ...internals.table import Table
 from .. import fs as _fs
 
-__all__ = ["read", "write"]
+# re-export the DSV settings next to the reader, like the reference
+from ..fs import CsvParserSettings  # noqa: F401
+
+__all__ = ["read", "write", "CsvParserSettings"]
 
 
 def read(

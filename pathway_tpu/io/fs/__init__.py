@@ -17,7 +17,28 @@ from ...internals.schema import Schema, schema_from_types
 from ...internals.table import Table
 from .._connector import SessionWriter, jsonable, register_source
 
-__all__ = ["read", "write"]
+__all__ = ["read", "write", "CsvParserSettings"]
+
+
+class CsvParserSettings:
+    """DSV parser settings (reference: io/_utils.py:125 CsvParserSettings —
+    delimiter/quote/escape/comments for the general-DSV format)."""
+
+    def __init__(
+        self,
+        delimiter: str = ",",
+        quote: str = '"',
+        escape: Optional[str] = None,
+        enable_double_quote_escapes: bool = True,
+        enable_quoting: bool = True,
+        comment_character: Optional[str] = None,
+    ):
+        self.delimiter = delimiter
+        self.quote = quote
+        self.escape = escape
+        self.enable_double_quote_escapes = enable_double_quote_escapes
+        self.enable_quoting = enable_quoting
+        self.comment_character = comment_character
 
 
 def _expand(path: str) -> List[str]:
@@ -36,6 +57,7 @@ def _parse_into(
     format: str,
     schema: Optional[Type[Schema]],
     with_metadata: bool = False,
+    csv_settings=None,
 ) -> None:
     """Parse one local file into the session (shared by fs/s3/gdrive)."""
     columns = (
@@ -72,7 +94,7 @@ def _parse_into(
             _buf.clear()
 
     try:
-        _dispatch_format(fpath, format, columns, emit)
+        _dispatch_format(fpath, format, columns, emit, csv_settings=csv_settings)
     finally:
         # flush even when a malformed row raises mid-file, so every
         # successfully parsed row reaches the session (the pre-buffering
@@ -80,9 +102,41 @@ def _parse_into(
         flush()
 
 
-def _dispatch_format(fpath, format, columns, emit) -> None:
+def _dispatch_format(fpath, format, columns, emit, csv_settings=None) -> None:
 
-    if format == "csv":
+    if format == "csv" and csv_settings is not None:
+        # general DSV: python csv module honouring the parser settings
+        # (reference DsvParser, src/connectors/data_format.rs:500)
+        with open(fpath, newline="") as f:
+            reader = _csv.reader(
+                f,
+                delimiter=csv_settings.delimiter,
+                quotechar=csv_settings.quote if csv_settings.enable_quoting else None,
+                escapechar=csv_settings.escape,
+                doublequote=csv_settings.enable_double_quote_escapes,
+                quoting=_csv.QUOTE_MINIMAL
+                if csv_settings.enable_quoting
+                else _csv.QUOTE_NONE,
+            )
+            header = None
+            comment = csv_settings.comment_character
+            for row in reader:
+                if not row or (comment and row[0].startswith(comment)):
+                    continue
+                if header is None:
+                    header = row
+                    idx = {
+                        c: header.index(c) if c in header else None
+                        for c in columns
+                    }
+                    continue
+                emit(
+                    {
+                        c: (row[i] if i is not None and i < len(row) else None)
+                        for c, i in idx.items()
+                    }
+                )
+    elif format == "csv":
         # native C++ scanner (native/src/csv.cc) — columnar extents, one str
         # per cell; pure-Python fallback inside csv_rows when the library is
         # unavailable
@@ -164,7 +218,14 @@ def read(
     dtypes = schema.typehints()
 
     def parse_file(fpath: str, writer: SessionWriter):
-        _parse_into(fpath, writer, format, schema, with_metadata=with_metadata)
+        _parse_into(
+            fpath,
+            writer,
+            format,
+            schema,
+            with_metadata=with_metadata,
+            csv_settings=csv_settings,
+        )
 
     # offsets for persistence = {path: mtime} of fully-ingested files; after
     # snapshot replay the runner seeks past them (reference seek semantics,

@@ -138,3 +138,31 @@ def test_gated_connectors_raise_clearly():
             client_id="i",
             client_secret="s",
         )
+
+
+def test_dsv_general_delimiter_and_comments(tmp_path):
+    path = tmp_path / "data.tsv"
+    path.write_text(
+        "# a comment line\n"
+        "word\tcount\n"
+        "alpha\t1\n"
+        'quo"ted\t2\n'
+    )
+
+    class S(pw.Schema):
+        word: str
+        count: int
+
+    t = pw.io.csv.read(
+        str(path),
+        schema=S,
+        mode="static",
+        csv_settings=pw.io.csv.CsvParserSettings(
+            delimiter="\t", comment_character="#"
+        ),
+    )
+    rows = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: rows.append(row))
+    pw.run(monitoring_level=None)
+    got = sorted((r["word"], r["count"]) for r in rows)
+    assert got == [("alpha", 1), ('quo"ted', 2)]
