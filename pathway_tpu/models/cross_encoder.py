@@ -47,6 +47,22 @@ class CrossEncoderModel:
         checkpoint_path: Optional[str] = None,
         dtype=jnp.bfloat16,
     ):
+        from .hf_import import is_hf_checkpoint
+
+        self._lock = threading.Lock()
+        self._fns: Dict[tuple, Any] = {}
+        self._hf = is_hf_checkpoint(checkpoint_path)
+        if self._hf:
+            # real-weights path: HF BertForSequenceClassification (the
+            # sentence-transformers cross-encoder export; hf_import.py)
+            from .hf_import import load_hf_text_model
+
+            self.module, self.params, self.config, self.tokenizer = (
+                load_hf_text_model(
+                    checkpoint_path, max_length, dtype, cross=True
+                )
+            )
+            return
         self.config = TransformerConfig(
             vocab_size=vocab_size,
             d_model=dimension,
@@ -59,8 +75,6 @@ class CrossEncoderModel:
         )
         self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
         self.module = _CrossEncoderModule(self.config)
-        self._lock = threading.Lock()
-        self._fns: Dict[tuple, Any] = {}
         ids = jnp.zeros((1, 16), jnp.int32)
         mask = jnp.ones((1, 16), jnp.int32)
         self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask)["params"]
@@ -69,11 +83,18 @@ class CrossEncoderModel:
     def _forward_fn(self, shape):
         fn = self._fns.get(shape)
         if fn is None:
-            fn = jax.jit(
-                lambda params, ids, mask: self.module.apply(
-                    {"params": params}, ids, mask
+            if self._hf:
+                fn = jax.jit(
+                    lambda params, ids, mask, type_ids: self.module.apply(
+                        {"params": params}, ids, mask, type_ids
+                    )
                 )
-            )
+            else:
+                fn = jax.jit(
+                    lambda params, ids, mask: self.module.apply(
+                        {"params": params}, ids, mask
+                    )
+                )
             self._fns[shape] = fn
         return fn
 
@@ -90,5 +111,19 @@ class CrossEncoderModel:
             ds = [str(p[1]) for p in pairs] + [""] * (b - n)
             ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
             fn = self._forward_fn(ids.shape)
-            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            if self._hf:
+                # BERT pair segments: tokens after the first [SEP] are type 1
+                first_sep = np.argmax(ids == self.tokenizer.SEP, axis=1)
+                type_ids = (
+                    (np.arange(ids.shape[1])[None, :] > first_sep[:, None])
+                    & (mask > 0)
+                ).astype(np.int32)
+                out = fn(
+                    self.params,
+                    jnp.asarray(ids),
+                    jnp.asarray(mask),
+                    jnp.asarray(type_ids),
+                )
+            else:
+                out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
             return np.asarray(out, dtype=np.float32)[:n]

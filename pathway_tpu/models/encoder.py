@@ -59,36 +59,11 @@ class SentenceEncoder:
 
         if is_hf_checkpoint(checkpoint_path):
             # real-weights path: HF BERT-family safetensors + WordPiece vocab
-            # (models/hf_import.py) — the sentence-transformers export layout
-            from .hf_import import BertEncoderModule, load_bert_checkpoint
+            # (models/hf_import.py)
+            from .hf_import import load_hf_text_model
 
-            hf_cfg, self.params = load_bert_checkpoint(checkpoint_path)
-            max_length = min(max_length, hf_cfg.max_position_embeddings)
-            self.config = TransformerConfig(
-                vocab_size=hf_cfg.vocab_size,
-                d_model=hf_cfg.hidden_size,
-                n_heads=hf_cfg.num_attention_heads,
-                n_layers=hf_cfg.num_hidden_layers,
-                d_ff=hf_cfg.intermediate_size,
-                max_len=max_length,
-                dtype=dtype,
-                pool="mean",
-            )
-            self.module = BertEncoderModule(hf_cfg)
-            vocab_file = os.path.join(checkpoint_path, "vocab.txt")
-            if not os.path.exists(vocab_file):
-                # trained weights + hash-derived token ids = silently garbage
-                # embeddings; fail loudly instead
-                raise FileNotFoundError(
-                    f"{checkpoint_path} has model weights but no vocab.txt — "
-                    "export the tokenizer vocab alongside the checkpoint "
-                    "(tokenizer.save_vocabulary) so token ids match the "
-                    "trained embedding table"
-                )
-            from .wordpiece import WordPieceTokenizer
-
-            self.tokenizer = WordPieceTokenizer(
-                vocab_file, max_length=max_length
+            self.module, self.params, self.config, self.tokenizer = (
+                load_hf_text_model(checkpoint_path, max_length, dtype)
             )
         else:
             self.config = TransformerConfig(

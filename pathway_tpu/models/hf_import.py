@@ -60,16 +60,23 @@ def _layer_norm(x, gamma, beta, eps):
 
 
 def bert_forward(
-    params: Dict[str, Any], ids: jnp.ndarray, mask: jnp.ndarray, cfg: BertConfig
+    params: Dict[str, Any],
+    ids: jnp.ndarray,
+    mask: jnp.ndarray,
+    cfg: BertConfig,
+    type_ids: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """HF-BERT-equivalent forward (eval mode): returns the last hidden state
-    [B, L, H].  Post-LN blocks, exact (erf) GELU, additive attention mask."""
+    [B, L, H].  Post-LN blocks, exact (erf) GELU, additive attention mask.
+    ``type_ids`` segments sentence pairs (cross-encoders); defaults to 0s."""
     emb = params["embeddings"]
     B, L = ids.shape
+    if type_ids is None:
+        type_ids = jnp.zeros((B, L), jnp.int32)
     h = (
         emb["word"][ids]
         + emb["position"][jnp.arange(L)][None, :, :]
-        + emb["token_type"][jnp.zeros((B, L), jnp.int32)]
+        + emb["token_type"][type_ids]
     )
     h = _layer_norm(h, emb["ln_gamma"], emb["ln_beta"], cfg.layer_norm_eps)
 
@@ -126,19 +133,25 @@ def _t(x: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(x.T)
 
 
-def load_bert_checkpoint(path: str):
+def _load_tensors(path: str):
+    """One safetensors read; a leading ``bert.`` prefix (full-model exports)
+    is stripped."""
+    from safetensors.numpy import load_file
+
+    raw = load_file(os.path.join(path, "model.safetensors"))
+    return {
+        (name[5:] if name.startswith("bert.") else name): value
+        for name, value in raw.items()
+    }
+
+
+def load_bert_checkpoint(path: str, _tensors=None):
     """Load an HF BERT-style checkpoint directory -> (BertConfig, params).
 
     ``path`` must contain ``config.json`` and ``model.safetensors`` (the
-    standard ``save_pretrained`` layout).  Tensor names follow HF BertModel;
-    a leading ``bert.`` prefix (full-model exports) is accepted."""
-    from safetensors.numpy import load_file
-
+    standard ``save_pretrained`` layout).  Tensor names follow HF BertModel."""
     cfg = BertConfig.from_json(os.path.join(path, "config.json"))
-    raw = load_file(os.path.join(path, "model.safetensors"))
-    tensors = {}
-    for name, value in raw.items():
-        tensors[name[5:] if name.startswith("bert.") else name] = value
+    tensors = _tensors if _tensors is not None else _load_tensors(path)
 
     def get(name: str) -> np.ndarray:
         if name not in tensors:
@@ -182,6 +195,104 @@ def load_bert_checkpoint(path: str):
         )
     params = jax.tree_util.tree_map(jnp.asarray, params)
     return cfg, params
+
+
+def load_bert_cross_encoder(path: str):
+    """Load an HF ``BertForSequenceClassification`` checkpoint (the
+    architecture of sentence-transformers cross-encoders like
+    ms-marco-MiniLM) -> (BertConfig, params incl. pooler + classifier).
+    Forward: encoder -> [CLS] -> pooler dense+tanh -> classifier logits."""
+    tensors = _load_tensors(path)
+    cfg, params = load_bert_checkpoint(path, _tensors=tensors)
+    if "classifier.weight" not in tensors:
+        raise KeyError(
+            f"checkpoint at {path} has no classification head "
+            "(classifier.weight) — it is an encoder/embedder export, not a "
+            "cross-encoder; use SentenceEncoder(checkpoint_path=...) for it"
+        )
+    extra = {
+        "classifier": {
+            "w": jnp.asarray(_t(tensors["classifier.weight"])),
+            "b": jnp.asarray(tensors["classifier.bias"]),
+        }
+    }
+    if "pooler.dense.weight" in tensors:
+        extra["pooler"] = {
+            "w": jnp.asarray(_t(tensors["pooler.dense.weight"])),
+            "b": jnp.asarray(tensors["pooler.dense.bias"]),
+        }
+    params.update(extra)
+    return cfg, params
+
+
+def bert_classify(
+    params: Dict[str, Any],
+    ids: jnp.ndarray,
+    mask: jnp.ndarray,
+    cfg: BertConfig,
+    type_ids: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Sequence-classification logits [B, n_labels] (HF
+    BertForSequenceClassification semantics: pooler(tanh) over [CLS], then
+    the classifier head; without a head, returns the pooled [CLS])."""
+    hidden = bert_forward(params, ids, mask, cfg, type_ids)
+    cls = hidden[:, 0, :]
+    if "pooler" in params:
+        cls = jnp.tanh(cls @ params["pooler"]["w"] + params["pooler"]["b"])
+    if "classifier" in params:
+        return cls @ params["classifier"]["w"] + params["classifier"]["b"]
+    return cls
+
+
+class BertCrossEncoderModule:
+    """Duck-typed module for CrossEncoderModel: ``apply`` -> [B] scores
+    (single-logit heads squeeze; multi-label heads return logit 0)."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+
+    def apply(self, variables, ids, mask, type_ids=None):
+        logits = bert_classify(
+            variables["params"], ids, mask, self.cfg, type_ids
+        )
+        return logits[:, 0]
+
+
+def load_hf_text_model(path: str, max_length: int, dtype, cross: bool = False):
+    """Shared SentenceEncoder/CrossEncoderModel HF initialisation: one
+    place for the config clamp, tokenizer lookup, and module choice.
+    Returns (module, params, transformer_config, tokenizer)."""
+    from .transformer import TransformerConfig
+    from .wordpiece import WordPieceTokenizer
+
+    hf_cfg, params = (
+        load_bert_cross_encoder(path) if cross else load_bert_checkpoint(path)
+    )
+    max_length = min(max_length, hf_cfg.max_position_embeddings)
+    config = TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_heads=hf_cfg.num_attention_heads,
+        n_layers=hf_cfg.num_hidden_layers,
+        d_ff=hf_cfg.intermediate_size,
+        max_len=max_length,
+        dtype=dtype,
+        pool="mean",
+    )
+    vocab_file = os.path.join(path, "vocab.txt")
+    if not os.path.exists(vocab_file):
+        # trained weights + hash-derived token ids = silently garbage
+        # embeddings/scores; fail loudly instead
+        raise FileNotFoundError(
+            f"{path} has model weights but no vocab.txt — export the "
+            "tokenizer vocab alongside the checkpoint "
+            "(tokenizer.save_vocabulary) so token ids match the weights"
+        )
+    tokenizer = WordPieceTokenizer(vocab_file, max_length=max_length)
+    module = (
+        BertCrossEncoderModule(hf_cfg) if cross else BertEncoderModule(hf_cfg)
+    )
+    return module, params, config, tokenizer
 
 
 def is_hf_checkpoint(path) -> bool:

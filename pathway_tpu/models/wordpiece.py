@@ -152,11 +152,23 @@ class WordPieceTokenizer:
         self, text: str, pair: str | None = None, max_length: int | None = None
     ) -> List[int]:
         max_length = max_length or self.max_length
-        ids = [self.CLS] + self.tokenize(text)
-        if pair is not None:
-            ids = ids[: max_length - 1] + [self.SEP] + self.tokenize(pair)
-        ids = ids[: max_length - 1] + [self.SEP]
-        return ids
+        if pair is None:
+            ids = [self.CLS] + self.tokenize(text)
+            return ids[: max_length - 1] + [self.SEP]
+        # sentence pairs truncate longest-first (HF semantics): both segments
+        # keep tokens, so an over-long query can't silently evict the whole
+        # document and collapse every pair to the same score
+        a = self.tokenize(text)
+        b = self.tokenize(pair)
+        budget = max(max_length - 3, 2)
+        while len(a) + len(b) > budget:
+            if len(a) >= len(b) and len(a) > 1:
+                a.pop()
+            elif len(b) > 1:
+                b.pop()
+            else:
+                break
+        return [self.CLS] + a + [self.SEP] + b + [self.SEP]
 
     def encode_batch(
         self,

@@ -215,3 +215,57 @@ def test_wordpiece_cjk_and_control_chars(hf_dir, tmp_path):
         "crlf line\r\nbreaks",
     ]:
         assert ours.encode(t) == theirs(t)["input_ids"], repr(t)
+
+
+@pytest.fixture(scope="module")
+def hf_cross_dir(tmp_path_factory):
+    """Tiny BertForSequenceClassification (num_labels=1) — the architecture
+    of sentence-transformers cross-encoders."""
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig as TorchBertConfig
+    from transformers import BertForSequenceClassification
+
+    d = tmp_path_factory.mktemp("bert_cross")
+    cfg = TorchBertConfig(
+        vocab_size=len(VOCAB),
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=64,
+        num_labels=1,
+    )
+    torch.manual_seed(1)
+    model = BertForSequenceClassification(cfg)
+    model.eval()
+    model.save_pretrained(str(d), safe_serialization=True)
+    with open(d / "vocab.txt", "w") as f:
+        f.write("\n".join(VOCAB) + "\n")
+    return str(d)
+
+
+def test_cross_encoder_matches_torch(hf_cross_dir):
+    import torch
+    from transformers import BertForSequenceClassification, BertTokenizer
+
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+
+    ce = CrossEncoderModel(checkpoint_path=hf_cross_dir, max_length=32)
+    pairs = [
+        ("the cat sat", "a dog chased the ball"),
+        ("fish swim", "the cat sat on the mat"),
+        ("live query", "streaming dataflow indexes"),
+    ]
+    ours = ce.predict(pairs)
+    assert ours.shape == (3,)
+
+    model = BertForSequenceClassification.from_pretrained(hf_cross_dir)
+    model.eval()
+    tok = BertTokenizer(os.path.join(hf_cross_dir, "vocab.txt"))
+    with torch.no_grad():
+        for i, (q, d) in enumerate(pairs):
+            enc = tok(q, d, return_tensors="pt")
+            logit = model(**enc).logits[0, 0].item()
+            assert abs(float(ours[i]) - logit) < 1e-3, (i, ours[i], logit)
+    # scores differ across pairs (the head + segments actually matter)
+    assert len({round(float(s), 5) for s in ours}) == 3
