@@ -50,6 +50,16 @@ def values_equal(a: Any, b: Any) -> bool:
 def rows_equal(a: Optional[Tuple[Any, ...]], b: Optional[Tuple[Any, ...]]) -> bool:
     if a is None or b is None:
         return a is b
+    try:
+        # C-level tuple compare: the common all-scalar case never reaches the
+        # per-value Python loop.  A True is always trustworthy; a False is
+        # trustworthy unless a NaN cell (x != x) compared false to itself.
+        if a == b:
+            return True
+        if all(x == x for x in a):
+            return False
+    except (ValueError, TypeError):
+        pass  # ndarray cells: ambiguous truth value — take the careful path
     if len(a) != len(b):
         return False
     return all(values_equal(x, y) for x, y in zip(a, b))
