@@ -36,14 +36,27 @@ from .internals.run import run, run_all
 from .internals.schema import (
     ColumnDefinition,
     Schema,
+    SchemaProperties,
     column_definition,
     schema_builder,
+    schema_from_csv,
     schema_from_dict,
     schema_from_types,
 )
-from .internals.table import GroupedTable, JoinMode, JoinResult, Table
+from .internals.table import (
+    GroupedJoinResult,
+    GroupedTable,
+    Joinable,
+    JoinMode,
+    JoinResult,
+    Table,
+    TableLike,
+    TableSlice,
+)
 from .internals.thisclass import left, right, this
 from .internals.universe import Universe
+from .internals.py_object_wrapper import PyObjectWrapper, wrap_py_object
+from .internals.interactive import LiveTable, enable_interactive_mode
 
 # submodules
 from . import debug  # noqa: E402
@@ -54,13 +67,45 @@ from .internals import udfs  # noqa: E402
 from .internals.udfs import UDF, udf, udf_async  # noqa: E402
 from .internals.yaml_loader import load_yaml  # noqa: E402
 from .internals.sql import sql  # noqa: E402
-from .internals.config import PathwayConfig, get_config, set_license_key  # noqa: E402
+from .internals.config import (  # noqa: E402
+    PathwayConfig,
+    get_config,
+    set_license_key,
+    set_monitoring_config,
+)
 from .internals.monitoring import MonitoringLevel  # noqa: E402
+from .internals.api_reducers import BaseCustomAccumulator  # noqa: E402
 from . import persistence  # noqa: E402
+from .persistence import PersistenceMode  # noqa: E402
 from . import parallel  # noqa: E402
 from . import stdlib  # noqa: E402
-from .stdlib import indexing, ml, temporal, utils, stateful, graphs  # noqa: E402
-from .stdlib.temporal import asof_join, interval_join, window_join, windowby  # noqa: E402
+from .stdlib import (  # noqa: E402
+    graphs,
+    indexing,
+    ml,
+    ordered,
+    stateful,
+    statistical,
+    temporal,
+    utils,
+    viz,
+)
+from .stdlib.temporal import (  # noqa: E402
+    AsofJoinResult,
+    IntervalJoinResult,
+    WindowJoinResult,
+    asof_join,
+    interval_join,
+    window_join,
+    windowby,
+)
+from .stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
+from .stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
+
+# deprecated aliases kept for reference compatibility (pathway.asynchronous,
+# UDFSync/UDFAsync pre-date the unified pw.UDF)
+UDFSync = UDF
+UDFAsync = UDF
 
 __version__ = "0.1.0"
 
@@ -80,6 +125,14 @@ def global_error_log() -> list:
     from .internals.error_log import global_error_log as _gel
 
     return _gel()
+
+
+def local_error_log():
+    """Context manager capturing errors raised while open (reference
+    pw.local_error_log, internals/errors.py:13)."""
+    from .internals.error_log import local_error_log as _lel
+
+    return _lel()
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +174,49 @@ def cast(target_type, expr):
     return CastExpression(expr, target_type)
 
 
+def declare_type(target_type, col):
+    """Retype a column in the schema only; values pass through unchanged
+    (reference internals/common.py:215)."""
+    from .internals.expression import DeclareTypeExpression
+
+    return DeclareTypeExpression(col, target_type)
+
+
+def fill_error(col, replacement):
+    """Replace Error cells with ``replacement`` per row (reference
+    internals/common.py:438; Error cells: internals/error_value.py)."""
+    from .internals.expression import FillErrorExpression
+
+    return FillErrorExpression(col, replacement)
+
+
+# free-function flavors of the Table/Joinable methods (reference
+# internals/table.py:2574 `groupby`, internals/joins.py:1163 `join_inner` …)
+
+def join(left_table, right_table, *on, id=None, how=JoinMode.INNER) -> JoinResult:
+    return left_table.join(right_table, *on, id=id, how=how)
+
+
+def join_inner(left_table, right_table, *on, id=None) -> JoinResult:
+    return left_table.join_inner(right_table, *on, id=id)
+
+
+def join_left(left_table, right_table, *on, id=None) -> JoinResult:
+    return left_table.join_left(right_table, *on, id=id)
+
+
+def join_right(left_table, right_table, *on, id=None) -> JoinResult:
+    return left_table.join_right(right_table, *on, id=id)
+
+
+def join_outer(left_table, right_table, *on, id=None) -> JoinResult:
+    return left_table.join_outer(right_table, *on, id=id)
+
+
+def groupby(grouped, *args, **kwargs):
+    return grouped.groupby(*args, **kwargs)
+
+
 def unwrap(expr):
     from .internals.expression import smart_coerce
 
@@ -160,8 +256,9 @@ from .internals.row_transformer import (  # noqa: E402
 
 
 # Heavy subpackages (flax model zoo, LLM xpack, device kernels) load lazily
-# so plain ETL pipelines don't pay the model-stack import cost (PEP 562).
-_LAZY_SUBMODULES = ("xpacks", "models", "ops")
+# so plain ETL pipelines don't pay the model-stack import cost (PEP 562);
+# `asynchronous` is lazy so its DeprecationWarning only fires on use.
+_LAZY_SUBMODULES = ("xpacks", "models", "ops", "asynchronous")
 
 
 def __getattr__(name: str):
@@ -183,3 +280,10 @@ Pointer_ = Pointer
 DateTimeNaive = _datetime.datetime
 DateTimeUtc = _datetime.datetime
 Duration = _datetime.timedelta
+# pw.Type — the reference's engine type vocabulary (engine.pyi:33)
+Type = dt.PathwayType
+# outer joins return a JoinResult here; the reference's docstrings call that
+# an "OuterJoinResult object" (internals/joins.py:393) and its __all__ lists
+# the name without ever defining it — alias for drop-in compat. (`window`,
+# the other stale reference __all__ entry, is deliberately NOT provided.)
+OuterJoinResult = JoinResult

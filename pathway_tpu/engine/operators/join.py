@@ -191,7 +191,15 @@ class JoinOperator(EngineOperator):
         nl = len(self.left_names)
         columns = {}
         for ci, name in enumerate(self.output.column_names):
-            columns[name] = _object_array(lt[ci] if ci < nl else rt[ci - nl])
+            # hidden side-id columns (padded side -> None) back `left.id` /
+            # `right.id` in join selects; declared last, so the positional
+            # left/right mapping below is unaffected
+            if name == "_pw_lid":
+                columns[name] = _object_array(lkeys)
+            elif name == "_pw_rid":
+                columns[name] = _object_array(rkeys)
+            else:
+                columns[name] = _object_array(lt[ci] if ci < nl else rt[ci - nl])
         return Delta(
             keys=self._out_keys_batch(lkeys, rkeys),
             diffs=np.asarray(diffs, dtype=np.int64),

@@ -36,6 +36,7 @@ __all__ = [
     "latest",
     "stateful_single",
     "stateful_many",
+    "BaseCustomAccumulator",
     "udf_reducer",
 ]
 
@@ -131,5 +132,62 @@ def stateful_many(combine: Callable) -> Callable[..., ReducerExpression]:
     return stateful_single(combine)
 
 
-def udf_reducer(reducer_cls):  # pragma: no cover - compatibility shim
-    raise NotImplementedError("udf_reducer: use stateful_single instead")
+class BaseCustomAccumulator:
+    """Base for user-defined accumulators used with ``pw.reducers.udf_reducer``
+    (reference internals/custom_reducers.py:174).  Subclasses implement
+    ``from_row`` / ``update`` / ``compute_result``; ``neutral`` and
+    ``retract`` are optional accelerators — this engine re-folds surviving
+    rows on retraction, so omitting ``retract`` stays correct."""
+
+    @classmethod
+    def neutral(cls):
+        raise NotImplementedError
+
+    @classmethod
+    def from_row(cls, row):
+        raise NotImplementedError
+
+    def update(self, other) -> None:
+        raise NotImplementedError
+
+    def retract(self, other) -> None:
+        raise NotImplementedError
+
+    def compute_result(self):
+        raise NotImplementedError
+
+    def serialize(self):
+        import pickle
+
+        return pickle.dumps(self)
+
+    @classmethod
+    def deserialize(cls, val):
+        import pickle
+
+        return pickle.loads(val)
+
+
+def udf_reducer(reducer_cls: type) -> Callable[..., ReducerExpression]:
+    """Stateful reducer from a :class:`BaseCustomAccumulator` subclass
+    (reference internals/custom_reducers.py:280 ``udf_reducer``)."""
+
+    def make(*exprs) -> ReducerExpression:
+        def fold(state, rows):
+            # rows is never empty: StatefulReducer drops groups whose rows
+            # all retracted before calling the fold (neutral()/retract() are
+            # reference-side optimizations; re-folding survivors is already
+            # retraction-correct here)
+            acc = None
+            for r in rows:
+                row = list(r) if isinstance(r, _builtin_tuple) else [r]
+                nxt = reducer_cls.from_row(row)
+                if acc is None:
+                    acc = nxt
+                else:
+                    acc.update(nxt)
+            return acc.compute_result() if acc is not None else None
+
+        return ReducerExpression(lambda: engine_reducers.StatefulReducer(fold), *exprs)
+
+    return make

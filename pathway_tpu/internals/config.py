@@ -63,6 +63,13 @@ def set_license_key(key: Optional[str]) -> None:
     _config.license_key = key
 
 
+def set_monitoring_config(*, server_endpoint: Optional[str]) -> None:
+    """Set (or clear) the OTLP monitoring endpoint consumed by
+    internals/telemetry.py (reference internals/config.py:144
+    ``set_monitoring_config``; no license gating here)."""
+    _config.monitoring_server = server_endpoint
+
+
 class local_config:
     def __init__(self, **overrides):
         self.overrides = overrides

@@ -158,6 +158,26 @@ class Tuple_(DType):
 
 
 @dataclass(frozen=True)
+class List_(DType):
+    """Homogeneous variable-length sequence (reference PathwayType.list,
+    engine.pyi:49) — unlike Tuple_, one element type for every position."""
+
+    wrapped: DType = ANY
+
+    @property
+    def _name(self) -> str:  # type: ignore[override]
+        return f"List({self.wrapped})"
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return isinstance(value, (tuple, list)) and all(
+            self.wrapped.is_value_compatible(v) for v in value
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return self._name
+
+
+@dataclass(frozen=True)
 class Optional_(DType):
     wrapped: DType = ANY
 
@@ -315,3 +335,39 @@ def types_lca(a: DType, b: DType) -> DType:
             wrapped=a.wrapped if a.wrapped == b.wrapped else ANY,
         )
     return ANY
+
+
+class PathwayType:
+    """``pw.Type`` — the reference's engine-level type vocabulary
+    (engine.pyi:33 PathwayType) mapped onto this module's DTypes; lets
+    connector schemas written against the reference (``pw.Type.STRING`` …)
+    work unchanged."""
+
+    ANY = ANY
+    STRING = STR
+    INT = INT
+    BOOL = BOOL
+    FLOAT = FLOAT
+    POINTER = POINTER
+    DATE_TIME_NAIVE = DATE_TIME_NAIVE
+    DATE_TIME_UTC = DATE_TIME_UTC
+    DURATION = DURATION
+    JSON = JSON
+    BYTES = BYTES
+    PY_OBJECT_WRAPPER = PY_OBJECT
+
+    @staticmethod
+    def array(dim=None, wrapped=None):
+        return Array(n_dim=dim, wrapped=wrapped if wrapped is not None else FLOAT)
+
+    @staticmethod
+    def tuple(*args):
+        return Tuple_(tuple(args))
+
+    @staticmethod
+    def list(arg):
+        return List_(arg)
+
+    @staticmethod
+    def optional(arg):
+        return Optional_(arg)

@@ -18,13 +18,20 @@ from typing import Optional
 
 from .trace import Trace
 
-__all__ = ["ErrorLogEntry", "log_error", "global_error_log", "clear_error_log"]
+__all__ = [
+    "ErrorLogEntry",
+    "log_error",
+    "global_error_log",
+    "local_error_log",
+    "clear_error_log",
+]
 
 logger = logging.getLogger("pathway_tpu.errors")
 
 _MAX_ENTRIES = 10_000
 _lock = threading.Lock()
 _entries: deque = deque(maxlen=_MAX_ENTRIES)
+_local_sinks: list = []
 
 
 @dataclass(frozen=True)
@@ -50,8 +57,37 @@ def log_error(
     entry = ErrorLogEntry(message, operator, trace, extra)
     with _lock:
         _entries.append(entry)
+        for sink in _local_sinks:
+            sink.append(entry)
     logger.debug("row error: %s", entry)
     return entry
+
+
+class LocalErrorLog(list):
+    """Entries captured while a ``local_error_log()`` context was open."""
+
+
+def local_error_log():
+    """Context manager yielding a log that captures errors raised while it
+    is open (reference ``pw.local_error_log``, internals/errors.py:13 — there
+    it scopes errors of operators *built* inside the context; with this
+    framework's eager engine the natural scope is errors *raised* inside,
+    so run the computation — e.g. ``pw.debug.compute_and_print`` — within
+    the ``with`` block).  Entries also remain visible in the global log."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        captured = LocalErrorLog()
+        with _lock:
+            _local_sinks.append(captured)
+        try:
+            yield captured
+        finally:
+            with _lock:
+                _local_sinks.remove(captured)
+
+    return _cm()
 
 
 def global_error_log() -> list:

@@ -297,12 +297,18 @@ class ColumnReference(ColumnExpression):
 
 
 class IdExpression(ColumnExpression):
-    """``table.id`` — the key column."""
+    """``table.id`` — the key column.  In contexts that carry per-side row
+    ids under the ``__id__`` pseudo-column (join selects: the joined output
+    has its own keys, but ``left.id``/``right.id`` must mean the *side's*
+    row ids), the bound table's entry wins over the ambient keys."""
 
     def __init__(self, table):
         self._table = table
 
     def _eval(self, ctx: EvalContext) -> np.ndarray:
+        side = ctx.columns.get((id(self._table), "__id__"))
+        if side is not None:
+            return side
         return ctx.keys
 
 
@@ -595,6 +601,39 @@ class CastExpression(ColumnExpression):
             if caster is not None:
                 return np.array([None if x is None else caster(x) for x in v], dtype=object)
         return v
+
+
+class DeclareTypeExpression(CastExpression):
+    """``pw.declare_type`` — retypes the column in the schema only; values
+    pass through untouched (reference internals/common.py:215)."""
+
+    def _eval(self, ctx: EvalContext) -> np.ndarray:
+        return self._expr._eval(ctx)
+
+
+class FillErrorExpression(ColumnExpression):
+    """``pw.fill_error(col, replacement)`` — Error cells replaced per row
+    (reference internals/common.py:438; Value::Error, src/engine/value.rs:225)."""
+
+    def __init__(self, expr, replacement):
+        self._expr = smart_coerce(expr)
+        self._replacement = smart_coerce(replacement)
+        self._deps = (self._expr, self._replacement)
+
+    def _eval(self, ctx: EvalContext) -> np.ndarray:
+        from .error_value import is_error
+
+        v = self._expr._eval(ctx)
+        if not _is_object(v):
+            return v
+        if not any(is_error(x) for x in v):
+            return v
+        r = self._replacement._eval(ctx)
+        out = v.copy()
+        for i in range(ctx.n):
+            if is_error(out[i]):
+                out[i] = r[i]
+        return out
 
 
 class ConvertExpression(CastExpression):

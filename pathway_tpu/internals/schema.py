@@ -17,13 +17,22 @@ from . import dtype as dt
 __all__ = [
     "Schema",
     "ColumnDefinition",
+    "SchemaProperties",
     "column_definition",
     "schema_from_types",
     "schema_from_dict",
+    "schema_from_csv",
     "schema_builder",
 ]
 
 _NO_DEFAULT = object()
+
+
+@dataclass(frozen=True)
+class SchemaProperties:
+    """Whole-schema properties (reference internals/schema.py:263)."""
+
+    append_only: Optional[bool] = None
 
 
 @dataclass(frozen=True)
@@ -211,6 +220,74 @@ class _SchemaBuilder:
 
     def build(self, name: str = "Schema") -> Type[Schema]:
         return _make_schema(name, dict(self._columns))
+
+
+def schema_from_csv(
+    path: str,
+    *,
+    name: str = "Schema",
+    properties: SchemaProperties = SchemaProperties(),
+    delimiter: str = ",",
+    quote: str = '"',
+    comment_character: Optional[str] = None,
+    escape: Optional[str] = None,
+    double_quote_escapes: bool = True,
+    num_parsed_rows: Optional[int] = None,
+) -> Type[Schema]:
+    """Infer a schema from a CSV file's header + values: a column is int if
+    every value parses as int, else float if every value parses as float,
+    else str (reference internals/schema.py:832 ``schema_from_csv``).
+    With no sampled values (``num_parsed_rows=0`` or a header-only file) a
+    column types as ANY — same as the reference's ``choose_type([])``."""
+    import csv
+    import itertools
+
+    def lines_without_comments(f):
+        for line in f:
+            if comment_character is None or not line.lstrip().startswith(
+                comment_character
+            ):
+                yield line
+
+    with open(path, newline="") as f:
+        reader = csv.DictReader(
+            lines_without_comments(f),
+            delimiter=delimiter,
+            quotechar=quote,
+            escapechar=escape,
+            doublequote=double_quote_escapes,
+        )
+        if reader.fieldnames is None:
+            raise ValueError("can't generate Schema based on an empty CSV file")
+        column_names = list(reader.fieldnames)
+        rows = list(
+            reader if num_parsed_rows is None else itertools.islice(reader, num_parsed_rows)
+        )
+
+    def parses(s: str, fn) -> bool:
+        try:
+            fn(s)
+            return True
+        except (TypeError, ValueError):
+            return False
+
+    def choose_type(values):
+        if not values:
+            return dt.ANY
+        if all(parses(v, int) for v in values):
+            return dt.INT
+        if all(parses(v, float) for v in values):
+            return dt.FLOAT
+        return dt.STR
+
+    columns = {
+        col: ColumnSchema(name=col, dtype=choose_type([r[col] for r in rows]))
+        for col in column_names
+    }
+    schema = _make_schema(name, columns)
+    if properties.append_only is not None:
+        schema.__append_only__ = properties.append_only
+    return schema
 
 
 def schema_builder(

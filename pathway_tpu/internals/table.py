@@ -49,7 +49,15 @@ from .thisclass import this as this_placeholder
 from .type_interpreter import infer_dtype
 from .universe import Universe
 
-__all__ = ["Table", "GroupedTable", "JoinResult", "JoinMode"]
+__all__ = [
+    "Table",
+    "TableLike",
+    "Joinable",
+    "GroupedTable",
+    "GroupedJoinResult",
+    "JoinResult",
+    "JoinMode",
+]
 
 
 class JoinMode:
@@ -57,6 +65,16 @@ class JoinMode:
     LEFT = "left"
     RIGHT = "right"
     OUTER = "outer"
+
+
+class TableLike:
+    """Common superclass of everything with a universe
+    (reference internals/table_like.py:15)."""
+
+
+class Joinable(TableLike):
+    """Things that can participate in joins: Table and JoinResult
+    (reference internals/joins.py:46)."""
 
 
 def _add_op(op):
@@ -67,7 +85,7 @@ def _new_engine_table(columns: Sequence[str], name: str = "") -> EngineTable:
     return G.engine_graph.add_table(columns, name)
 
 
-class Table:
+class Table(Joinable):
     """A (possibly streaming) table of keyed rows."""
 
     _counter = itertools.count()
@@ -997,7 +1015,7 @@ class _ConstKeyExpr(ColumnExpression):
         return np.zeros(len(ctx.keys), dtype=np.uint64)
 
 
-class JoinResult:
+class JoinResult(Joinable):
     """Result of table.join(...) pending a select
     (reference: internals/joins.py:1422)."""
 
@@ -1054,9 +1072,13 @@ class JoinResult:
         elif left_is_id and right_is_id:
             assign_id_from = "left"
 
-        out_cols = [f"_l_{c}" for c in left._engine_table.column_names] + [
-            f"_r_{c}" for c in right._engine_table.column_names
-        ]
+        out_cols = (
+            [f"_l_{c}" for c in left._engine_table.column_names]
+            + [f"_r_{c}" for c in right._engine_table.column_names]
+            # hidden side-id columns (must stay last: JoinOperator._assemble
+            # maps left/right columns positionally before them)
+            + ["_pw_lid", "_pw_rid"]
+        )
         et = _new_engine_table(out_cols, "join")
         cls = AsofNowJoinOperator if asof_now else JoinOperator
         pointer_keys = (
@@ -1111,6 +1133,12 @@ class JoinResult:
             ctx[(id(right_placeholder), api)] = f"_r_{eng}"
             if (id(this_placeholder), api) not in ctx:
                 ctx[(id(this_placeholder), api)] = f"_r_{eng}"
+        # side row ids: left.id / right.id resolve to the hidden id columns
+        # (IdExpression checks the "__id__" pseudo-column for its table)
+        ctx[(id(self._left), "__id__")] = "_pw_lid"
+        ctx[(id(left_placeholder), "__id__")] = "_pw_lid"
+        ctx[(id(self._right), "__id__")] = "_pw_rid"
+        ctx[(id(right_placeholder), "__id__")] = "_pw_rid"
         return ctx
 
     def select(self, *args, **kwargs) -> Table:
@@ -1172,3 +1200,87 @@ class JoinResult:
             if n not in full_cols:
                 full_cols[n] = ColumnReference(self._right, n)
         return self.select(**full_cols).filter(expression)
+
+    def groupby(
+        self,
+        *args,
+        id: Optional[Any] = None,
+        sort_by: Optional[Any] = None,
+        instance: Optional[Any] = None,
+    ) -> "GroupedJoinResult":
+        """Group the join result (reference: internals/joins.py:748 →
+        GroupedJoinResult, groupbys.py:272)."""
+        return GroupedJoinResult(
+            self, list(args), id_expr=id, sort_by=sort_by, instance=instance
+        )
+
+
+class GroupedJoinResult:
+    """``join(...).groupby(...)`` pending a reduce
+    (reference internals/groupbys.py:272).  The join is materialized into an
+    intermediate table carrying the grouping, id/sort_by/instance, and
+    reducer-input expressions — all evaluated in the join's context — then
+    grouped there."""
+
+    def __init__(
+        self,
+        join_result: "JoinResult",
+        grouping: List[Any],
+        id_expr=None,
+        sort_by=None,
+        instance=None,
+    ):
+        self._join = join_result
+        self._grouping = grouping
+        self._id = id_expr
+        self._sort_by = sort_by
+        self._instance = instance
+
+    def reduce(self, *args, **kwargs) -> Table:
+        import copy as _copy
+
+        out_exprs: Dict[str, Any] = {}
+        for arg in args:
+            if not isinstance(arg, ColumnReference):
+                raise ValueError("positional reduce args must be column references")
+            out_exprs[arg.name] = arg
+        out_exprs.update({k: smart_coerce(v) for k, v in kwargs.items()})
+
+        sel: Dict[str, Any] = {
+            f"_g{i}": g for i, g in enumerate(self._grouping)
+        }
+        if self._id is not None:
+            sel["_gid"] = self._id
+        if self._sort_by is not None:
+            sel["_gsort"] = self._sort_by
+        if self._instance is not None:
+            sel["_ginst"] = self._instance
+        rebind: Dict[Tuple[str, int], str] = {}
+        n_inputs = 0
+        for name, expr in out_exprs.items():
+            if isinstance(expr, ReducerExpression):
+                for k, a in enumerate(expr._args):
+                    sel[f"_r{n_inputs}"] = a
+                    rebind[(name, k)] = f"_r{n_inputs}"
+                    n_inputs += 1
+            else:
+                sel[f"_o_{name}"] = expr
+        inter = self._join.select(**sel)
+        grouped = inter.groupby(
+            *[inter[f"_g{i}"] for i in range(len(self._grouping))],
+            id=inter["_gid"] if self._id is not None else None,
+            sort_by=inter["_gsort"] if self._sort_by is not None else None,
+            instance=inter["_ginst"] if self._instance is not None else None,
+        )
+        red_kwargs: Dict[str, Any] = {}
+        for name, expr in out_exprs.items():
+            if isinstance(expr, ReducerExpression):
+                clone = _copy.copy(expr)
+                clone._args = tuple(
+                    inter[rebind[(name, k)]] for k in range(len(expr._args))
+                )
+                clone._deps = clone._args
+                red_kwargs[name] = clone
+            else:
+                red_kwargs[name] = inter[f"_o_{name}"]
+        return grouped.reduce(**red_kwargs)

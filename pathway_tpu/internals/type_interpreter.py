@@ -35,6 +35,10 @@ def infer_dtype(expr: Any, env: Mapping[int, Mapping[str, dt.DType]]) -> dt.DTyp
         return expr._target
     if isinstance(expr, (e.IsNoneExpression, e.IsNotNoneExpression)):
         return dt.BOOL
+    if isinstance(expr, e.FillErrorExpression):
+        return dt.types_lca(
+            infer_dtype(expr._expr, env), infer_dtype(expr._replacement, env)
+        )
     if isinstance(expr, e.IfElseExpression):
         return dt.types_lca(
             infer_dtype(expr._then, env), infer_dtype(expr._else, env)
