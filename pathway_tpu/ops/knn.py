@@ -63,6 +63,11 @@ def _scatter_flags(valid: jnp.ndarray, slots: jnp.ndarray, flag: bool):
     return valid.at[slots].set(flag)
 
 
+@jax.jit
+def _scatter_vals(arr: jnp.ndarray, slots: jnp.ndarray, vals: jnp.ndarray):
+    return arr.at[slots].set(vals)
+
+
 class DeviceKnnIndex:
     """Incrementally maintained dense KNN index on TPU.
 
@@ -93,6 +98,13 @@ class DeviceKnnIndex:
         self.capacity = cap
         self._matrix = self._device_zeros((cap, dimension))
         self._valid = self._device_zeros((cap,), dtype=jnp.bool_)
+        # device-resident slot->key map as two int32 planes (jax runs with
+        # 32-bit ints; keys are uint64).  The fused serving path gathers the
+        # top slots' keys ON DEVICE so query completion needs no host-side
+        # metadata snapshot — an O(len(index)) set/copy per call was the
+        # dominant cost of the old host mapping at 1M rows (~30 ms/batch).
+        self._keys_hi = self._device_zeros((cap,), dtype=jnp.int32)
+        self._keys_lo = self._device_zeros((cap,), dtype=jnp.int32)
         self.key_to_slot: Dict[int, int] = {}
         self.slot_to_key = np.zeros(cap, dtype=KEY_DTYPE)
         self._free: List[int] = list(range(cap - 1, -1, -1))
@@ -155,6 +167,12 @@ class DeviceKnnIndex:
             new_valid = jax.lax.dynamic_update_slice(
                 jnp.zeros((new_cap,), jnp.bool_), self._valid, (0,)
             )
+            new_hi = jax.lax.dynamic_update_slice(
+                jnp.zeros((new_cap,), jnp.int32), self._keys_hi, (0,)
+            )
+            new_lo = jax.lax.dynamic_update_slice(
+                jnp.zeros((new_cap,), jnp.int32), self._keys_lo, (0,)
+            )
         else:
             # jitted grow with explicit out_shardings: stays sharded, works on
             # multi-process meshes where host-side device_put cannot re-pin
@@ -164,14 +182,19 @@ class DeviceKnnIndex:
                 ),
                 out_shardings=self._sharding(True),
             )(self._matrix)
-            new_valid = jax.jit(
+            grow_flat = jax.jit(
                 lambda v: jax.lax.dynamic_update_slice(
-                    jnp.zeros((new_cap,), jnp.bool_), v, (0,)
+                    jnp.zeros((new_cap,), v.dtype), v, (0,)
                 ),
                 out_shardings=self._sharding(False),
-            )(self._valid)
+            )
+            new_valid = grow_flat(self._valid)
+            new_hi = grow_flat(self._keys_hi)
+            new_lo = grow_flat(self._keys_lo)
         self._matrix = new_matrix
         self._valid = new_valid
+        self._keys_hi = new_hi
+        self._keys_lo = new_lo
         self.slot_to_key = np.concatenate(
             [self.slot_to_key, np.zeros(new_cap - old_cap, dtype=KEY_DTYPE)]
         )
@@ -203,7 +226,7 @@ class DeviceKnnIndex:
             for key, slot in zip(keys, slots):
                 self.key_to_slot[int(key)] = int(slot)
                 self.slot_to_key[slot] = int(key)
-            self._scatter(slots, vectors, True)
+            self._scatter(slots, vectors, True, keys=keys)
 
     def add_from_device(self, keys: Sequence[int], vectors) -> None:
         """Ingest vectors that already live on device (e.g. encoder output) —
@@ -249,7 +272,7 @@ class DeviceKnnIndex:
             for key, slot in zip(keys, slots):
                 self.key_to_slot[int(key)] = int(slot)
                 self.slot_to_key[slot] = int(key)
-            self._scatter(slots, vectors, True)
+            self._scatter(slots, vectors, True, keys=keys)
 
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:
@@ -264,13 +287,26 @@ class DeviceKnnIndex:
             slots = np.array(slots, dtype=np.int32)
             self._scatter(slots, np.zeros((len(slots), self.dimension), np.float32), False)
 
-    def _scatter(self, slots: np.ndarray, vectors, valid: bool) -> None:
+    def _scatter(
+        self, slots: np.ndarray, vectors, valid: bool, keys=None
+    ) -> None:
         """Batched scatter, padded to a bucket to bound recompiles (pad rows
         repeat the first row — idempotent writes).  ``vectors`` may be a host
-        numpy array or a device array (add_from_device path)."""
+        numpy array or a device array (add_from_device path).  ``keys`` (add
+        path) also updates the device slot->key planes; removals skip them —
+        the cleared valid flag masks stale keys."""
         n = len(slots)
         b = _bucket(n)
         on_device = isinstance(vectors, jax.Array)
+        if keys is not None:
+            keys64 = np.fromiter(
+                (int(k) for k in keys), dtype=np.uint64, count=n
+            )
+            hi = (keys64 >> np.uint64(32)).astype(np.uint32).view(np.int32)
+            lo = (keys64 & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+            if b > n:
+                hi = np.concatenate([hi, np.full(b - n, hi[0], np.int32)])
+                lo = np.concatenate([lo, np.full(b - n, lo[0], np.int32)])
         if b > n:
             slots = np.concatenate([slots, np.full(b - n, slots[0], np.int32)])
             xp = jnp if on_device else np
@@ -282,10 +318,17 @@ class DeviceKnnIndex:
         if self.mesh is None:
             self._matrix = _scatter_rows(self._matrix, slots_dev, vectors_dev)
             self._valid = _scatter_flags(self._valid, slots_dev, valid)
+            if keys is not None:
+                self._keys_hi = _scatter_vals(self._keys_hi, slots_dev, self._to_mesh(hi))
+                self._keys_lo = _scatter_vals(self._keys_lo, slots_dev, self._to_mesh(lo))
         else:
             row_fn, flag_fn = self._scatter_jits()
             self._matrix = row_fn(self._matrix, slots_dev, vectors_dev)
             self._valid = flag_fn(self._valid, slots_dev, valid)
+            if keys is not None:
+                val_fn = self._scatter_val_jit()
+                self._keys_hi = val_fn(self._keys_hi, slots_dev, self._to_mesh(hi))
+                self._keys_lo = val_fn(self._keys_lo, slots_dev, self._to_mesh(lo))
 
     def _scatter_jits(self):
         """Scatter fns with explicit sharded out_shardings (keeps the matrix
@@ -306,6 +349,16 @@ class DeviceKnnIndex:
             )
             self._scatter_fn_cache = fns
         return fns
+
+    def _scatter_val_jit(self):
+        fn = getattr(self, "_scatter_val_cache", None)
+        if fn is None:
+            fn = jax.jit(
+                lambda a, s, v: a.at[s].set(v),
+                out_shardings=self._sharding(False),
+            )
+            self._scatter_val_cache = fn
+        return fn
 
     # -- search ------------------------------------------------------------
     def search(

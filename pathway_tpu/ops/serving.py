@@ -48,7 +48,7 @@ class FusedEncodeSearch:
         normalize = metric == "cos"
 
         @jax.jit
-        def fused(params, ids, mask, matrix, valid):
+        def fused(params, ids, mask, matrix, valid, keys_hi, keys_lo):
             z = module.apply({"params": params}, ids, mask)
             z = z.astype(jnp.float32)
             if normalize:
@@ -56,7 +56,9 @@ class FusedEncodeSearch:
                     jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
                 )
             scores = jnp.dot(
-                z, matrix.T.astype(jnp.float32), preferred_element_type=jnp.float32
+                z.astype(matrix.dtype),
+                matrix.T,
+                preferred_element_type=jnp.float32,
             )
             if metric == "l2sq":
                 scores = 2 * scores - jnp.sum(
@@ -64,13 +66,20 @@ class FusedEncodeSearch:
                 )[None, :]
             scores = jnp.where(valid[None, :], scores, -jnp.inf)
             s, i = jax.lax.top_k(scores, k)
-            # pack into one output so the host fetch is a single transfer;
-            # indices are BITCAST (not value-cast) into the float lanes, so
-            # slots beyond 2^24 survive exactly
-            i_bits = jax.lax.bitcast_convert_type(
-                i.astype(jnp.int32), jnp.float32
-            )
-            return jnp.concatenate([s, i_bits], axis=1)
+            # gather the winners' KEYS on device (int32 hi/lo planes kept by
+            # the index): completion then needs no host-side slot->key
+            # snapshot at all — the old per-call set()/copy() of the 1M-row
+            # host mapping was ~30 ms, dwarfing the actual compute
+            hi = jnp.take(keys_hi, i, axis=0)
+            lo = jnp.take(keys_lo, i, axis=0)
+            # pack into ONE INT32 output so the host fetch is a single
+            # transfer.  The scores are bitcast into int lanes — not the
+            # keys into float lanes — because TPU canonicalizes NaN payloads
+            # in float values (0x7fc00000), which would silently corrupt any
+            # key whose 32-bit half happens to be a NaN bit pattern (~0.8%
+            # of uniform xxh3 keys); integer lanes always survive bit-exact.
+            s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
+            return jnp.concatenate([s_bits, hi, lo], axis=1)
 
         self._fns[key] = fused
         return fused
@@ -109,21 +118,29 @@ class FusedEncodeSearch:
             B, L = ids.shape
             fn = self._compiled(B, L, k_eff, index.capacity)
             out = fn(
-                self.encoder.params, ids, mask, index._matrix, index._valid
+                self.encoder.params,
+                ids,
+                mask,
+                index._matrix,
+                index._valid,
+                index._keys_hi,
+                index._keys_lo,
             )
             if hasattr(out, "copy_to_host_async"):
                 out.copy_to_host_async()
-            # snapshot the slot->key view at dispatch time — REAL copies,
-            # not aliases: a writer thread may reuse slots (remove + add)
-            # before the caller completes the future, and the live arrays
-            # mutate in place
-            slot_to_key = index.slot_to_key.copy()
-            live = set(index.key_to_slot)
+            # nothing host-side to snapshot: the dispatch captured a
+            # consistent device view under the index lock (matrix/valid/keys
+            # are replaced functionally, never mutated in place), and the
+            # winners' keys come back IN the packed output.  A slot whose row
+            # was removed at dispatch time scores -inf and is dropped below.
 
         def complete() -> List[List[Tuple[int, float]]]:
             arr = np.asarray(out)[:n_real]
-            scores = arr[:, :k_eff]
-            idx = np.ascontiguousarray(arr[:, k_eff:]).view(np.int32)
+            scores = np.ascontiguousarray(arr[:, :k_eff]).view(np.float32)
+            ints = np.ascontiguousarray(arr[:, k_eff:]).view(np.uint32)
+            hi = ints[:, :k_eff].astype(np.uint64)
+            lo = ints[:, k_eff:].astype(np.uint64)
+            keys = (hi << np.uint64(32)) | lo
             results: List[List[Tuple[int, float]]] = []
             for qi in range(len(texts)):
                 row: List[Tuple[int, float]] = []
@@ -131,10 +148,7 @@ class FusedEncodeSearch:
                     s = float(scores[qi, j])
                     if not np.isfinite(s):
                         continue
-                    key_ = int(slot_to_key[int(idx[qi, j])])
-                    if key_ not in live:
-                        continue
-                    row.append((key_, s))
+                    row.append((int(keys[qi, j]), s))
                 results.append(row[:k])
             return results
 

@@ -209,6 +209,24 @@ def test_fused_encode_search_matches_unfused(small_encoder):
         )
 
 
+def test_fused_full_range_keys_survive_packing(small_encoder):
+    """Winner keys ride back from the device as int32 lanes; keys whose
+    32-bit halves are float-NaN bit patterns (TPU canonicalizes NaN payloads
+    in FLOAT lanes, so score/key packing order matters) and full-range
+    uint64 keys must round-trip bit-exact."""
+    enc = small_encoder
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    rng = np.random.default_rng(11)
+    keys = [int(k) for k in rng.integers(0, 2**64, size=27, dtype=np.uint64)]
+    # adversarial keys: hi and/or lo words are NaN bit patterns
+    keys += [0x7F800001_7FC00001, 0x7FC00000_00000005, 0x00000007_FFC00001]
+    docs = [f"document number {i} about topic {i % 5}" for i in range(30)]
+    index.add(keys, enc.encode(docs))
+    fused = FusedEncodeSearch(enc, index, k=30)
+    got = {k for k, _ in fused(["topic 3 report"])[0]}
+    assert got == set(keys), sorted(set(keys) - got)
+
+
 def test_fused_batch_sizes_share_compiles(small_encoder):
     enc = small_encoder
     index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
