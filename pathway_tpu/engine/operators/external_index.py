@@ -67,6 +67,14 @@ class ExternalIndexOperator(EngineOperator):
         self._queries: Dict[int, Tuple[Any, Any, int]] = {}
         self._dirty = False
 
+    def dist_routing(self, port: int):
+        # distributed: every rank maintains the FULL index and sees every
+        # query.  The device plane shards under the hood (DeviceKnnIndex on a
+        # global mesh needs every process issuing the same jit calls — SPMD);
+        # rank-0-only processing would deadlock those collectives, and
+        # key-sharding host-side would duplicate what the mesh already does.
+        return "replicate"
+
     # -- data side ---------------------------------------------------------
     def _process_data(self, delta: Delta) -> None:
         delta = delta.consolidated()

@@ -58,6 +58,24 @@ class GroupByOperator(EngineOperator):
         # group_key -> [row_count, grouping_values_tuple, [reducer states]]
         self._groups: Dict[int, List[Any]] = {}
 
+    def dist_routing(self, port: int):
+        # distributed: route input rows to the owner of their GROUP key, so
+        # each rank reduces a disjoint set of groups (reference: exchange on
+        # the grouping key before differential reduce, dataflow.rs
+        # group_by_table)
+        return self._group_keys
+
+    def _group_keys(self, delta: Delta) -> np.ndarray:
+        ctx = build_eval_context(delta, self.ctx_cols)
+        if self.key_expression is not None:
+            return np.asarray(self.key_expression._eval(ctx)).astype(KEY_DTYPE)
+        gvals = [
+            np.asarray(e._eval(ctx)) for e in self.grouping_expressions.values()
+        ]
+        if gvals:
+            return ref_scalars_batch(gvals)
+        return np.zeros(delta.n, dtype=KEY_DTYPE)
+
     def snapshot_state(self):
         return self._groups
 

@@ -158,7 +158,13 @@ class SourceOperator(EngineOperator):
         return self.session.finished and not self.session.has_pending
 
     def poll(self, ts: int) -> Optional[Delta]:
-        events = self.session.drain()
+        return self.events_to_delta(self.session.drain())
+
+    def events_to_delta(self, events) -> Optional[Delta]:
+        """Resolve a raw event batch into a keyed delta against this
+        operator's current output store (upsert chains, delete-by-key).  The
+        distributed executor calls this AFTER routing raw events to their
+        key owner, so resolution always sees the owner's store."""
         if not events:
             return None
         names = self.output.column_names

@@ -95,6 +95,12 @@ class JoinOperator(EngineOperator):
         self._left: Dict[int, Dict[int, Tuple[Any, ...]]] = {}
         self._right: Dict[int, Dict[int, Tuple[Any, ...]]] = {}
 
+    def dist_routing(self, port: int):
+        # distributed: co-locate both sides by JOIN key so matches happen
+        # rank-locally (reference: differential join's exchange pact on the
+        # arrangement key)
+        return lambda delta: self._join_keys(delta, port)
+
     def snapshot_state(self):
         return {"left": self._left, "right": self._right}
 

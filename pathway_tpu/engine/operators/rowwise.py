@@ -47,6 +47,9 @@ class RowwiseOperator(EngineOperator):
     """select / with_columns: output columns are expressions over input rows
     (reference: expression_table, src/engine/graph.rs:708)."""
 
+    def dist_routing(self, port: int):
+        return None  # row-local: output key = input key, no cross-row state
+
     def __init__(
         self,
         input_table: EngineTable,
@@ -138,6 +141,9 @@ class RowwiseOperator(EngineOperator):
 
 class FilterOperator(EngineOperator):
     """filter rows by a boolean expression (graph.rs: filter_table)."""
+
+    def dist_routing(self, port: int):
+        return None  # row-local: output key = input key, no cross-row state
 
     def __init__(
         self,
@@ -236,6 +242,11 @@ class ReindexOperator(EngineOperator):
     graph.rs: reindex_table).  The new key is recomputed from row values, so
     retractions rekey consistently."""
 
+    def dist_routing(self, port: int):
+        # row-local: the new key is a pure function of the row, so insert and
+        # retraction rekey identically wherever they are processed
+        return None
+
     def __init__(
         self,
         input_table: EngineTable,
@@ -269,6 +280,9 @@ class ConcatOperator(EngineOperator):
     (the reference proves disjointness statically with its universe solver,
     internals/universe_solver.py; ``pw.universes.
     promise_are_pairwise_disjoint`` elides this runtime check)."""
+
+    def dist_routing(self, port: int):
+        return "key"  # co-locate ports by row key (owner = key shard)
 
     def __init__(
         self,
@@ -346,6 +360,9 @@ class UpdateRowsOperator(EngineOperator):
     """``left.update_rows(right)``: right rows shadow left rows on key clash
     (reference: update_rows_table, graph.rs:726)."""
 
+    def dist_routing(self, port: int):
+        return "key"  # co-locate ports by row key (owner = key shard)
+
     def __init__(
         self,
         left: EngineTable,
@@ -397,6 +414,9 @@ class UpdateRowsOperator(EngineOperator):
 class UpdateCellsOperator(EngineOperator):
     """``left.update_cells(right)``: right overrides a subset of columns for
     keys it contains (reference: update_cells_table, graph.rs:717)."""
+
+    def dist_routing(self, port: int):
+        return "key"  # co-locate ports by row key (owner = key shard)
 
     def __init__(
         self,
@@ -465,6 +485,9 @@ class FlattenOperator(EngineOperator):
     """Explode an iterable column into one row per element; new key =
     hash(parent key, position) (reference: flatten_table, graph.rs:820)."""
 
+    def dist_routing(self, port: int):
+        return None  # row-local: output key = input key, no cross-row state
+
     def __init__(
         self,
         input_table: EngineTable,
@@ -503,6 +526,9 @@ class FlattenOperator(EngineOperator):
 class RestrictOperator(EngineOperator):
     """Keep rows of ``data`` whose key is present in ``keyset``
     (restrict / intersect / having; graph.rs: restrict_or_override_table)."""
+
+    def dist_routing(self, port: int):
+        return "key"  # co-locate ports by row key (owner = key shard)
 
     def __init__(
         self,

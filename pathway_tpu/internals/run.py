@@ -65,6 +65,18 @@ def run(
                     bootstrap.append((op, port, t.store.to_delta()))
     if persistence_config is None:
         persistence_config = _persistence_config_from_env()
+    if (
+        persistence_config is not None
+        and persistence_config.backend is not None
+        and distributed.is_distributed()
+    ):
+        # one snapshot namespace per rank: each process persists ITS OWN
+        # input log + offsets (atomic per-rank commits are what make the
+        # cluster's replay compose into global exactly-once; reference:
+        # per-worker persisted frontiers, src/persistence/tracker.rs:49)
+        persistence_config = _rank_scoped(
+            persistence_config, distributed.process_id()
+        )
     G.ran = True
     executor = Executor(G.engine_graph, commit_duration_ms)
     with _executor_lock:
@@ -112,7 +124,26 @@ def run(
             operators=len(G.engine_graph.operators),
             tables=len(G.engine_graph.tables),
         ):
-            executor.run(bootstrap=bootstrap)
+            try:
+                executor.run(bootstrap=bootstrap)
+            except BaseException as exc:
+                from ..parallel.exchange import PeerLost
+
+                if isinstance(exc, PeerLost):
+                    # a cluster peer died: this worker cannot make progress
+                    # and must not linger (jax's atexit shutdown would block
+                    # on the lost peer's shutdown barrier).  Hard-abort like
+                    # the reference's worker-panic propagation
+                    # (src/engine/dataflow.rs:5667-5676); recovery is a full
+                    # cluster restart from the last persisted commits.
+                    import logging as _logging
+                    import os as _os
+
+                    _logging.getLogger(__name__).critical(
+                        "aborting worker: %s", exc
+                    )
+                    _os._exit(70)
+                raise
         G.ran_ops.update(op.id for op in G.engine_graph.operators)
     finally:
         telemetry.shutdown()
@@ -133,6 +164,19 @@ def run(
                 pass
         with _executor_lock:
             _current_executor = None
+
+
+def _rank_scoped(config, rank: int):
+    """Copy a persistence Config with the backend rooted under rank{N}/."""
+    import dataclasses
+    import os as _os
+
+    backend = config.backend
+    if backend.path is not None:
+        backend = dataclasses.replace(
+            backend, path=_os.path.join(backend.path, f"rank{rank}")
+        )
+    return dataclasses.replace(config, backend=backend)
 
 
 def _persistence_config_from_env():

@@ -217,16 +217,40 @@ def register_source(
     persistent_id: Optional[str] = None,
     track_value_deletions: bool = False,
     atomic_batches: bool = False,
+    dist_mode: str = "replicated",
 ) -> Table:
     """Create the engine source + api table and schedule ``runner`` to feed it.
 
     ``mode="static"``: runner executes synchronously at run start, session
     closes after (batch).  ``mode="streaming"``: runner executes on a daemon
-    thread; session closes when it returns."""
+    thread; session closes when it returns.
+
+    ``dist_mode`` (multi-process runs; reference ``parallel_readers``,
+    src/engine/dataflow.rs:3317): "replicated" — every rank runs the runner
+    and ingests identical events, the executor keeps each rank's owned-key
+    slice; "partitioned" — ranks read DISJOINT splits (the runner consults
+    ``parallel.distributed.process_id()``), rows are exchanged to their key
+    owner; "broadcast" — one rank reads, every rank receives the full
+    stream."""
     column_names = list(schema.columns().keys())
     dtypes = schema.typehints()
     _source_counter[0] += 1
     salt = _source_counter[0]
+    # env topology, NOT jax.process_count(): graph build happens before
+    # pw.run() joins the cluster, and touching the jax backend here would
+    # break distributed.maybe_initialize()'s first-touch requirement
+    from ..parallel.distributed import topology_from_env
+
+    processes, pid, _addr = topology_from_env()
+    if processes > 1:
+        # collision-free distributed salt scheme: every source stretches its
+        # counter by (processes+1); partitioned sources additionally fold in
+        # the rank (disjoint splits both starting their row counter at 0
+        # must never mint the same key), offset by +1 so a partitioned
+        # source's rank-salts can never equal ANY source's stretched counter
+        salt = salt * (processes + 1)
+        if dist_mode == "partitioned":
+            salt += pid + 1
     session = InputSession(
         upsert=upsert or schema.primary_key_columns() is not None,
         atomic_batches=atomic_batches,
@@ -246,6 +270,7 @@ def register_source(
     )
     op.persistent_id = persistent_id
     op.writer = writer
+    op.dist_mode = dist_mode
 
     if mode == "static":
 

@@ -26,9 +26,12 @@ def subscribe(
     engine_table = table._engine_table
     col_idx = [engine_table.column_names.index(e) for e in engine_names]
 
-    def wrapped(key, row_tuple, time, diff):
-        row = {n: row_tuple[i] for n, i in zip(names, col_idx)}
-        on_change(key=Pointer(key), row=row, time=time, is_addition=diff > 0)
+    wrapped = None
+    if on_change is not None:
+
+        def wrapped(key, row_tuple, time, diff):
+            row = {n: row_tuple[i] for n, i in zip(names, col_idx)}
+            on_change(key=Pointer(key), row=row, time=time, is_addition=diff > 0)
 
     op = SubscribeOperator(
         engine_table,

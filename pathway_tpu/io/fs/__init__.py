@@ -51,6 +51,28 @@ def _expand(path: str) -> List[str]:
     return sorted(_glob.glob(path)) or ([path] if os.path.exists(path) else [])
 
 
+def _my_split(files: List[str]) -> List[str]:
+    """Multi-process runs: each rank owns a deterministic hash-split of the
+    file set (the reference's parallel readers: only workers with index <
+    parallel_readers read a connector, src/engine/dataflow.rs:3317; here
+    EVERY rank reads ITS files and rows are exchanged to their key owner).
+
+    Topology comes from the env, NOT jax: reader threads must never race the
+    main thread into first jax-backend initialization."""
+    from ...parallel.distributed import topology_from_env
+
+    nproc, rank, _addr = topology_from_env()
+    if nproc <= 1:
+        return files
+    import zlib
+
+    return [
+        f
+        for f in files
+        if zlib.crc32(os.path.basename(f).encode()) % nproc == rank
+    ]
+
+
 def _parse_into(
     fpath: str,
     writer: SessionWriter,
@@ -235,7 +257,7 @@ def read(
         def runner(writer: SessionWriter):
             pers = writer.persistence
             seen: Dict[str, float] = dict((pers.offsets() or {}) if pers else {})
-            for fpath in _expand(path):
+            for fpath in _my_split(_expand(path)):
                 try:
                     mtime = os.path.getmtime(fpath)
                 except OSError:
@@ -247,14 +269,19 @@ def read(
             writer.commit_offsets(seen)
 
         return register_source(
-            schema, runner, mode="static", name=name, persistent_id=persistent_id
+            schema,
+            runner,
+            mode="static",
+            name=name,
+            persistent_id=persistent_id,
+            dist_mode="partitioned",
         )
 
     def runner(writer: SessionWriter):
         pers = writer.persistence
         seen: Dict[str, float] = dict((pers.offsets() or {}) if pers else {})
         while True:
-            for fpath in _expand(path):
+            for fpath in _my_split(_expand(path)):
                 try:
                     mtime = os.path.getmtime(fpath)
                 except OSError:
@@ -270,15 +297,31 @@ def read(
             time.sleep(poll_interval_s)
 
     return register_source(
-        schema, runner, mode="streaming", name=name, persistent_id=persistent_id
+        schema,
+        runner,
+        mode="streaming",
+        name=name,
+        persistent_id=persistent_id,
+        dist_mode="partitioned",
     )
 
 
 def write(table: Table, filename: str, *, format: str = "csv", **kwargs) -> None:
     """Write the table's update stream to a file; csv/jsonlines rows carry
     ``time`` and ``diff`` columns (reference output format,
-    src/connectors/data_format.rs DsvFormatter/JsonLinesFormatter)."""
+    src/connectors/data_format.rs DsvFormatter/JsonLinesFormatter).
+
+    Multi-process runs: the sink's input edge gathers to rank 0, so ONLY
+    rank 0 touches the file (exactly-once output); other ranks register the
+    same operator (graph shapes must match across SPMD replicas) with no-op
+    callbacks."""
+    from ...parallel.distributed import topology_from_env
     from .._subscribe import subscribe
+
+    processes, pid, _addr = topology_from_env()
+    if processes > 1 and pid != 0:
+        subscribe(table, on_change=None, on_time_end=None, on_end=None)
+        return
 
     names = table.column_names
     f = open(filename, "w", newline="")

@@ -9,6 +9,7 @@ ICI (SURVEY.md §5.8).
 """
 
 from . import distributed
+from .exchange import ExchangePlane, gather_table_rows, get_plane
 from .mesh import (
     current_mesh,
     data_axis_size,
@@ -25,6 +26,9 @@ from .mesh import (
 
 __all__ = [
     "distributed",
+    "ExchangePlane",
+    "get_plane",
+    "gather_table_rows",
     "make_mesh",
     "current_mesh",
     "set_mesh",

@@ -10,24 +10,25 @@ for the data plane.
 
 Execution model (the honest jax-native design, documented per-layer):
 
-- **SPMD host replicas.** Like the reference — where the user's script runs
-  once per worker and each worker owns a shard (docs/2.developers/4.user-guide/
-  80.advanced/10.worker-architecture.md:37-48) — every process runs the same
-  program.  The host-side control plane (graph build, commit ticks, delta
-  scheduling) is *replicated*: each process executes the identical engine
-  tick loop, so no host-to-host data exchange is needed for control flow.
+- **SPMD program, worker-sharded host plane.** Like the reference — where
+  the user's script runs once per worker and each worker owns a shard
+  (docs/2.developers/4.user-guide/80.advanced/10.worker-architecture.md:
+  37-48) — every process builds the identical graph.  The host relational
+  plane is SHARDED: each rank ingests its owned-key slice of every source
+  (or its file split, for partitioned readers), stateful operators exchange
+  rows by group/join key over the TCP exchange plane
+  (``parallel/exchange.py``), and sinks gather to rank 0 for exactly-once
+  output.  Commit timestamps are agreed per tick: ranks exchange
+  (proposed_ts, moved, finished, stop) and deterministically adopt the max
+  proposal (engine/executor.py ``_step_dist``).
 - **Sharded device data plane.** Device-resident state (the KNN embedding
   matrix, model weights) lives on ONE global mesh spanning every process's
   devices (`global_mesh()`); each process addresses only its local shard.
   Exchange between shards is XLA collectives (all_gather/psum/ppermute)
   inside jit — the analog of timely's exchange channels — riding ICI within
-  a slice and DCN across hosts, never the Python layer.
-- **Deterministic inputs.** SPMD correctness requires every replica to issue
-  the same jit calls with the same replicated operands.  Connectors either
-  read the full input on every process (replicated host state, sharded
-  device state — the default) or split reads by ``process_id()`` and
-  all-gather device-side.  The engine's even-ms commit timestamps are made
-  deterministic by the coordination barrier (`barrier()`).
+  a slice and DCN across hosts, never the Python layer.  Operators that
+  drive a multi-process mesh (external indexes) run REPLICATED on the host
+  plane so every rank issues the same jit calls (SPMD discipline).
 
 Topology env vars (set by ``pathway-tpu spawn`` — cli.py):
   PATHWAY_PROCESSES            total process count (default 1 — no-op)

@@ -1,0 +1,320 @@
+"""Host-side data-exchange plane for multi-process streaming.
+
+The reference exchanges records between workers over timely's zero-copy TCP
+allocator (external/timely-dataflow/communication/src/allocator/zero_copy/
+{tcp,bytes_exchange}.rs) with the topology from CommunicationConfig::Cluster
+(src/engine/dataflow/config.rs:72-82).  The jax-native build keeps the DEVICE
+data plane on XLA collectives (parallel/distributed.py), but the host-side
+relational engine still needs a record exchange: connector reads are split
+across processes and rows must reach the process that owns their key
+(reference ``reshard`` after ingest, src/engine/dataflow.rs:3314).
+
+This module is that exchange: a full TCP mesh between the PATHWAY_PROCESSES
+ranks, carrying pickled ``Delta`` shards as BSP collectives.  Every rank
+executes the SAME sequence of collective calls per commit tick (the engine
+sweeps operators in one global topological order — engine/graph.py), so each
+call is identified by an ``(edge, seq)`` pair and deadlock is structurally
+impossible; out-of-order arrivals park in an inbox keyed by that pair.
+
+Rendezvous rides the jax coordination service's KV store (the ranks already
+share it for jax.distributed), so no extra ports need configuring: each rank
+publishes its listen address once at startup.
+
+A peer dying mid-stream surfaces as a broken connection; every blocked
+collective then raises, aborting this rank's run too — the analog of the
+reference's worker-panic propagation (src/engine/dataflow.rs:5667-5676).
+Recovery is a cluster restart from persisted snapshots (per-rank input logs
++ offsets), mirroring docs/.../10.worker-architecture.md:58-61.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["ExchangePlane", "get_plane", "close_plane"]
+
+_HDR = struct.Struct("!Q")
+
+
+class PeerLost(RuntimeError):
+    """A cluster peer disconnected (crashed or exited early)."""
+
+
+class ExchangePlane:
+    """Full-mesh TCP exchange among ``nproc`` ranks with BSP semantics."""
+
+    def __init__(self, rank: int, nproc: int, kv_set, kv_get, namespace: str = "0"):
+        self.rank = rank
+        self.nproc = nproc
+        self._send: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._inbox: Dict[Tuple[str, int, int], Any] = {}
+        self._cv = threading.Condition()
+        self._dead: Optional[BaseException] = None
+        self._closed = False
+        self._recv_threads: List[threading.Thread] = []
+
+        # rendezvous: publish my listen addr, read everyone else's.  Bind all
+        # interfaces and advertise the address peers can actually reach —
+        # multi-host clusters (PATHWAY_COORDINATOR_ADDRESS on another box)
+        # must not be handed a loopback address.
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(nproc)
+        _, port = self._listener.getsockname()
+        kv_set(
+            f"pathway_tpu/exch/{namespace}/{rank}",
+            f"{_advertise_host()}:{port}",
+        )
+        addrs: Dict[int, Tuple[str, int]] = {}
+        for peer in range(nproc):
+            if peer == self.rank:
+                continue
+            raw = kv_get(f"pathway_tpu/exch/{namespace}/{peer}")
+            h, p = raw.rsplit(":", 1)
+            addrs[peer] = (h, int(p))
+
+        # accept loop (peers dial me), started before dialing out
+        accepted: Dict[int, socket.socket] = {}
+        accept_done = threading.Event()
+
+        def _accept():
+            try:
+                for _ in range(nproc - 1):
+                    conn, _ = self._listener.accept()
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    peer_rank = _HDR.unpack(_recv_exact(conn, _HDR.size))[0]
+                    accepted[int(peer_rank)] = conn
+            finally:
+                accept_done.set()
+
+        acceptor = threading.Thread(target=_accept, daemon=True, name="exch-accept")
+        acceptor.start()
+        for peer, (h, p) in addrs.items():
+            s = socket.create_connection((h, p), timeout=60)
+            # the 60s is a CONNECT timeout only: a permanent per-op timeout
+            # would misread any >60s stall (peer inside a long jit compile
+            # with full TCP buffers) as peer death and abort a healthy cluster
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.sendall(_HDR.pack(self.rank))
+            self._send[peer] = s
+            self._send_locks[peer] = threading.Lock()
+        if not accept_done.wait(timeout=60):  # pragma: no cover - rendezvous hang
+            raise RuntimeError("exchange plane rendezvous timed out")
+        acceptor.join()
+        if len(accepted) != nproc - 1:  # pragma: no cover
+            raise RuntimeError(
+                f"exchange plane rendezvous incomplete: {sorted(accepted)}"
+            )
+        for peer, conn in accepted.items():
+            t = threading.Thread(
+                target=self._recv_loop, args=(peer, conn), daemon=True,
+                name=f"exch-recv-{peer}",
+            )
+            t.start()
+            self._recv_threads.append(t)
+
+    # -- wire ---------------------------------------------------------------
+    def _recv_loop(self, peer: int, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = _recv_exact(conn, _HDR.size)
+                (length,) = _HDR.unpack(hdr)
+                payload = _recv_exact(conn, length)
+                edge, seq, obj = pickle.loads(payload)
+                with self._cv:
+                    self._inbox[(edge, seq, peer)] = obj
+                    self._cv.notify_all()
+        except BaseException as exc:  # noqa: BLE001 - any failure kills the run
+            with self._cv:
+                if not self._closed and self._dead is None:
+                    self._dead = PeerLost(
+                        f"exchange peer {peer} disconnected: {exc!r}"
+                    )
+                self._cv.notify_all()
+
+    def _send_to(self, peer: int, edge: str, seq: int, obj: Any) -> None:
+        payload = pickle.dumps((edge, seq, obj), protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            with self._send_locks[peer]:
+                self._send[peer].sendall(_HDR.pack(len(payload)) + payload)
+        except OSError as exc:
+            raise PeerLost(f"send to exchange peer {peer} failed: {exc!r}") from exc
+
+    def _wait(self, edge: str, seq: int, peers: List[int], timeout: float) -> Dict[int, Any]:
+        out: Dict[int, Any] = {}
+        with self._cv:
+            while True:
+                if self._dead is not None:
+                    raise self._dead
+                for p in peers:
+                    if p not in out and (edge, seq, p) in self._inbox:
+                        out[p] = self._inbox.pop((edge, seq, p))
+                if len(out) == len(peers):
+                    return out
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"exchange {edge!r}#{seq}: timed out waiting for "
+                        f"{[p for p in peers if p not in out]}"
+                    )
+
+    # -- collectives --------------------------------------------------------
+    def all_to_all(
+        self, edge: str, seq: int, parts: List[Any], timeout: float = 600.0
+    ) -> List[Any]:
+        """Send ``parts[j]`` to rank j; return the nproc parts addressed to
+        me (my own part included at position ``rank``)."""
+        for peer in range(self.nproc):
+            if peer != self.rank:
+                self._send_to(peer, edge, seq, parts[peer])
+        got = self._wait(
+            edge, seq, [p for p in range(self.nproc) if p != self.rank], timeout
+        )
+        got[self.rank] = parts[self.rank]
+        return [got[p] for p in range(self.nproc)]
+
+    def gather(
+        self, edge: str, seq: int, obj: Any, root: int = 0, timeout: float = 600.0
+    ) -> Optional[List[Any]]:
+        """Everyone sends to ``root``; root returns all parts, others None."""
+        if self.rank != root:
+            self._send_to(root, edge, seq, obj)
+            return None
+        got = self._wait(
+            edge, seq, [p for p in range(self.nproc) if p != root], timeout
+        )
+        got[root] = obj
+        return [got[p] for p in range(self.nproc)]
+
+    def broadcast(
+        self, edge: str, seq: int, obj: Any = None, root: int = 0, timeout: float = 600.0
+    ) -> Any:
+        if self.rank == root:
+            for peer in range(self.nproc):
+                if peer != root:
+                    self._send_to(peer, edge, seq, obj)
+            return obj
+        return self._wait(edge, seq, [root], timeout)[root]
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        for s in self._send.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _advertise_host() -> str:
+    """The address peers should dial for this rank's exchange listener.
+    PATHWAY_EXCHANGE_HOST overrides; otherwise use the local interface that
+    routes toward the cluster coordinator (loopback for single-host
+    clusters, the reachable NIC for multi-host ones)."""
+    import os
+
+    override = os.environ.get("PATHWAY_EXCHANGE_HOST")
+    if override:
+        return override
+    coord = os.environ.get("PATHWAY_COORDINATOR_ADDRESS") or ""
+    host = coord.rsplit(":", 1)[0] if ":" in coord else coord
+    if host in ("", "localhost", "127.0.0.1", "0.0.0.0"):
+        return "127.0.0.1"
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((host, 9))  # no packets sent; just picks the route
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("exchange connection closed")
+        buf += chunk
+    return bytes(buf)
+
+
+_plane: Optional[ExchangePlane] = None
+_plane_lock = threading.Lock()
+_plane_gen = 0
+
+
+def get_plane() -> Optional[ExchangePlane]:
+    """The process-wide exchange plane (created on first use when running
+    distributed; None in single-process mode)."""
+    global _plane, _plane_gen
+    from . import distributed
+
+    if not distributed.is_distributed():
+        return None
+    with _plane_lock:
+        if _plane is None:
+            client = distributed._client()
+            gen = _plane_gen
+            _plane_gen += 1
+            _plane = ExchangePlane(
+                distributed.process_id(),
+                distributed.process_count(),
+                kv_set=client.key_value_set,
+                kv_get=lambda k: client.blocking_key_value_get(k, 60_000),
+                namespace=str(gen),
+            )
+        return _plane
+
+
+def close_plane() -> None:
+    global _plane
+    with _plane_lock:
+        if _plane is not None:
+            _plane.close()
+            _plane = None
+
+
+_user_seq = 0
+
+
+def gather_table_rows(table):
+    """Union of every rank's local rows for ``table`` — the cross-rank
+    materialize (each rank holds only its shard of a distributed table's
+    rows; reference users see the union through per-worker output
+    connectors).  SPMD: every rank must call this in the same order.
+    Single-process: identical to ``table._materialize()``."""
+    global _user_seq
+    keys, columns = table._materialize()
+    plane = get_plane()
+    if plane is None:
+        return keys, columns
+    seq = _user_seq
+    _user_seq += 1
+    got = plane.all_to_all(
+        "gather_table", seq, [(keys, columns)] * plane.nproc
+    )
+    import numpy as np
+
+    all_keys = np.concatenate([k for k, _c in got])
+    names = list(columns.keys())
+    merged = {}
+    for n in names:
+        cols = [c[n] for _k, c in got]
+        if any(getattr(c, "dtype", None) == object for c in cols):
+            cols = [np.asarray(c, dtype=object) for c in cols]
+        merged[n] = np.concatenate(cols) if cols else columns[n]
+    return all_keys, merged

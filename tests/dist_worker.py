@@ -75,8 +75,9 @@ def scenario_control_plane() -> dict:
 
 def scenario_engine() -> dict:
     """A full pw pipeline under the cluster: pw.run() itself must join the
-    cluster (internals/run.py wiring) — SPMD host replicas computing the
-    identical wordcount result."""
+    cluster (internals/run.py wiring).  The host relational plane is
+    worker-SHARDED: each rank ingests its owned-key slice and reduces its
+    owned groups; the union (gather_table_rows) is the full wordcount."""
     import pathway_tpu as pw
 
     table = pw.debug.table_from_markdown(
@@ -95,17 +96,84 @@ def scenario_engine() -> dict:
     pw.run(monitoring_level=None)
     import jax
 
-    keys, columns = result._materialize()
+    from pathway_tpu.parallel import gather_table_rows
+
+    lkeys, _ = result._materialize()
+    keys, columns = gather_table_rows(result)
     rows = sorted(
         (str(columns["word"][i]), int(columns["total"][i]))
         for i in range(len(keys))
     )
-    from pathway_tpu.parallel import distributed
-
     return {
         "proc": jax.process_index(),
         "nproc": jax.process_count(),
         "rows": rows,
+        "local_rows": len(lkeys),
+    }
+
+
+def scenario_live_stream() -> dict:
+    """LIVE streaming across the cluster: a watched csv directory read with
+    PARTITIONED parallel readers (each rank owns a hash-split of the files),
+    rows exchanged to their key owners, a sharded groupby-count, and ONE
+    exactly-once csv sink written by rank 0 (VERDICT r3 #1 'Done' shape).
+    The parent keeps writing files while the cluster runs; rank 0 requests a
+    coordinated stop once the sink has seen DIST_EXPECTED_TOTAL rows."""
+    import os
+    import threading
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.run import terminate
+    from pathway_tpu.parallel.distributed import topology_from_env
+
+    # graph build happens BEFORE pw.run() joins the cluster — rank comes
+    # from the env topology, never from a premature jax backend touch
+    _nproc, rank, _addr = topology_from_env()
+    data_dir = os.environ["DIST_DATA_DIR"]
+    out_csv = os.environ["DIST_OUT"]
+    expected_total = int(os.environ["DIST_EXPECTED_TOTAL"])
+
+    class Row(pw.Schema):
+        word: str
+
+    docs = pw.io.csv.read(
+        data_dir, schema=Row, mode="streaming", poll_interval_s=0.05,
+        persistent_id="dist_wc",
+    )
+    counts = docs.groupby(docs.word).reduce(
+        word=docs.word, count=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, out_csv)
+
+    # rank 0 owns the sink: watch the current totals and stop the CLUSTER
+    # (terminate() folds into the tick status exchange) once all input rows
+    # are accounted for
+    latest: dict = {}
+    lock = threading.Lock()
+
+    def on_change(key, row, time, is_addition):
+        with lock:
+            if is_addition:
+                latest[row["word"]] = int(row["count"])
+
+    def on_time_end(time):
+        with lock:
+            total = sum(latest.values())
+        if total >= expected_total:
+            terminate()
+
+    if rank == 0:
+        pw.io.subscribe(counts, on_change=on_change, on_time_end=on_time_end)
+    else:
+        pw.io.subscribe(counts, on_change=None, on_time_end=None)
+
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+    import jax
+
+    return {
+        "proc": jax.process_index(),
+        "nproc": jax.process_count(),
+        "stopped": True,
     }
 
 
@@ -113,10 +181,15 @@ SCENARIOS = {
     "knn": scenario_knn,
     "control_plane": scenario_control_plane,
     "engine": scenario_engine,
+    "live_stream": scenario_live_stream,
 }
 
 
 def main() -> int:
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)  # stack dumps for hung-test triage
     scenario = sys.argv[1]
     out = SCENARIOS[scenario]()
     print("RESULT " + json.dumps(out), flush=True)

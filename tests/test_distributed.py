@@ -59,10 +59,133 @@ def test_control_plane_barrier_and_broadcast():
 
 @pytest.mark.slow
 def test_engine_run_joins_cluster():
-    """pw.run() consumes the PATHWAY_* topology (SPMD host replicas): both
-    processes join the cluster and compute the identical wordcount."""
+    """pw.run() consumes the PATHWAY_* topology: both processes join the
+    cluster, the relational plane is worker-sharded (each rank reduces a
+    strict subset of groups), and the gathered union is the full wordcount."""
     results = spawn_cluster("engine", processes=2, local_devices=2)
     expected = [["alpha", 4], ["beta", 7], ["gamma", 4]]
     for r in results:
         assert r["nproc"] == 2
         assert r["rows"] == expected
+        # sharded, not replicated: no rank holds all three groups locally
+        assert r["local_rows"] < len(expected), r
+    assert sum(r["local_rows"] for r in results) == len(expected)
+
+
+# ---------------------------------------------------------------------------
+# live streaming across the cluster (VERDICT r3 #1)
+# ---------------------------------------------------------------------------
+
+import os
+import signal
+import time
+from collections import Counter
+
+from .test_recovery_e2e import final_counts, write_part
+from .utils import collect_cluster, launch_cluster
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon"]
+
+
+def _emit(data_dir, truth, part: int, n: int) -> None:
+    batch = [WORDS[(part * 7 + i) % len(WORDS)] for i in range(n)]
+    truth.update(batch)
+    write_part(str(data_dir), part, batch)
+
+
+@pytest.mark.slow
+def test_two_process_live_streaming_exactly_once(tmp_path):
+    """A LIVE file connector + sink across 2 processes: files are written
+    while the cluster runs, each rank reads its hash-split of the files
+    (partitioned parallel readers), rows are exchanged to their key owners,
+    the groupby is sharded, and the single rank-0 sink sees every input row
+    exactly once."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    out_csv = str(tmp_path / "out.csv")
+    truth: Counter = Counter()
+    _emit(data_dir, truth, 0, 30)
+    _emit(data_dir, truth, 1, 30)
+    total = 60 + 40  # parts 0-1 pre-start, parts 2-3 mid-run
+    procs = launch_cluster(
+        "live_stream",
+        processes=2,
+        local_devices=1,
+        env_extra={
+            "DIST_DATA_DIR": str(data_dir),
+            "DIST_OUT": out_csv,
+            "DIST_EXPECTED_TOTAL": str(total),
+        },
+    )
+    try:
+        # keep the stream LIVE: two more parts while the cluster is running
+        time.sleep(3.0)
+        _emit(data_dir, truth, 2, 20)
+        time.sleep(0.5)
+        _emit(data_dir, truth, 3, 20)
+        results = collect_cluster(procs, timeout=120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert [r["proc"] for r in results] == [0, 1]
+    got = final_counts(out_csv)
+    assert got == truth, f"exactly-once violated:\n got {dict(got)}\nwant {dict(truth)}"
+
+
+@pytest.mark.slow
+def test_cluster_sigkill_one_rank_then_restart_recovers(tmp_path):
+    """Kill one rank mid-stream: the peer must die too (worker-panic
+    propagation); restarting the WHOLE cluster from per-rank snapshots
+    resumes from the persisted offsets and the final output is exactly-once
+    (reference: integration_tests/wordcount/test_recovery.py +
+    docs/.../10.worker-architecture.md:58-61)."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    out_csv = str(tmp_path / "out.csv")
+    truth: Counter = Counter()
+    env_extra = {
+        "DIST_DATA_DIR": str(data_dir),
+        "DIST_OUT": out_csv,
+        "DIST_EXPECTED_TOTAL": str(10**9),  # phase 1 never self-stops
+        "PATHWAY_PERSISTENT_STORAGE": str(tmp_path / "snapshots"),
+        "PATHWAY_PERSISTENCE_MODE": "PERSISTING",
+        "PATHWAY_SNAPSHOT_INTERVAL_MS": "150",
+    }
+    _emit(data_dir, truth, 0, 40)
+    _emit(data_dir, truth, 1, 40)
+    procs = launch_cluster("live_stream", 2, 1, env_extra)
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if sum(final_counts(out_csv).values()) >= 80:
+                break
+            assert all(p.poll() is None for p in procs), "worker died early"
+            time.sleep(0.2)
+        assert sum(final_counts(out_csv).values()) >= 80, "no progress before kill"
+        time.sleep(0.5)  # let a snapshot interval elapse
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait()
+        # the surviving rank must notice the lost peer and abort
+        deadline = time.time() + 30
+        while time.time() < deadline and procs[0].poll() is None:
+            time.sleep(0.2)
+        assert procs[0].poll() is not None, "rank 0 kept running without its peer"
+        assert procs[0].returncode != 0
+
+        # phase 2: more data while down, then restart the whole cluster
+        _emit(data_dir, truth, 2, 40)
+        _emit(data_dir, truth, 3, 40)
+        env_extra["DIST_EXPECTED_TOTAL"] = str(sum(truth.values()))
+        procs = launch_cluster("live_stream", 2, 1, env_extra)
+        results = collect_cluster(procs, timeout=120)
+        assert [r["proc"] for r in results] == [0, 1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    got = final_counts(out_csv)
+    assert got == truth, (
+        f"exactly-once violated after SIGKILL+restart:\n got {dict(got)}\n"
+        f"want {dict(truth)}"
+    )

@@ -24,18 +24,14 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def spawn_cluster(
+def launch_cluster(
     scenario: str,
     processes: int = 2,
     local_devices: int = 4,
-    timeout: float = 180.0,
     env_extra: Optional[Dict[str, str]] = None,
-) -> List[dict]:
-    """Launch `processes` copies of tests/dist_worker.py forming one jax
-    process cluster on virtual CPU devices; returns each process's RESULT
-    payload (sorted by rank).  Mirrors the reference's fork-based
-    multi-process test pattern (tests/utils.py:599-660), with subprocess
-    spawn instead of fork — jax runtime threads do not survive fork."""
+) -> List[subprocess.Popen]:
+    """Start the cluster processes without waiting (live-streaming tests
+    interact with the cluster mid-run: write input files, kill a rank)."""
     port = free_port()
     procs = []
     for pid in range(processes):
@@ -60,6 +56,13 @@ def spawn_cluster(
                 text=True,
             )
         )
+    return procs
+
+
+def collect_cluster(
+    procs: List[subprocess.Popen], timeout: float = 180.0
+) -> List[dict]:
+    """Wait for every rank, parse RESULT payloads, raise on any failure."""
     import time
 
     results = []
@@ -91,6 +94,22 @@ def spawn_cluster(
             results.append(payload)
     assert not failures, "cluster workers failed:\n" + "\n---\n".join(failures)
     return sorted(results, key=lambda r: r.get("proc", 0))
+
+
+def spawn_cluster(
+    scenario: str,
+    processes: int = 2,
+    local_devices: int = 4,
+    timeout: float = 180.0,
+    env_extra: Optional[Dict[str, str]] = None,
+) -> List[dict]:
+    """Launch `processes` copies of tests/dist_worker.py forming one jax
+    process cluster on virtual CPU devices; returns each process's RESULT
+    payload (sorted by rank).  Mirrors the reference's fork-based
+    multi-process test pattern (tests/utils.py:599-660), with subprocess
+    spawn instead of fork — jax runtime threads do not survive fork."""
+    procs = launch_cluster(scenario, processes, local_devices, env_extra)
+    return collect_cluster(procs, timeout)
 
 
 def T(txt: str, **kwargs) -> pw.Table:
