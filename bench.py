@@ -202,9 +202,10 @@ def phase_ingest(backend: str, extras: dict) -> float:
         os.environ.get("BENCH_INGEST_DOCS", "65536" if backend == "tpu" else "4096")
     )
     dim = 384
-    # batch 256 is the measured-good operating point on the tunneled chip
-    # (33k docs/s at the 64k-doc default); BENCH_INGEST_BATCH overrides
-    batch = int(os.environ.get("BENCH_INGEST_BATCH", "256"))
+    # batch 1024 is the measured-good operating point on the tunneled chip
+    # with the native tokenizer (116k docs/s, MFU 0.41 at the 128k-doc
+    # sweep; 256 gives 99k, 2048 gives 113k); BENCH_INGEST_BATCH overrides
+    batch = int(os.environ.get("BENCH_INGEST_BATCH", "1024"))
     # full batches only: a ragged tail would jit-compile a second shape
     # inside the timed region and skew the rate
     n_docs = max(n_docs - n_docs % batch, batch)

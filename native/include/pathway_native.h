@@ -82,6 +82,18 @@ uint32_t pn_crc32(const uint8_t* data, int64_t len, uint32_t crc);
 int64_t pn_frame_scan(const uint8_t* buf, int64_t len, int64_t* offsets,
                       int64_t* lengths, int64_t max_frames, int64_t* consumed);
 
+/* ---- hashing tokenizer (ASCII fast path; models/tokenizer.py) ----
+ * blob = concatenated ASCII texts, offsets[n_texts+1] their boundaries.
+ * Emits word-hash ids ([\w']+ runs and single punctuation chars, lowered,
+ * xxh3 % (vocab_size - reserved) + reserved) into out_ids (capacity >=
+ * blob length: every token spans >= 1 byte) with per-text out_offsets.
+ * Returns 0, or -1 when built without xxhash (caller uses the Python
+ * tokenizer). */
+int32_t pn_tokenize_hash(const uint8_t* blob, const int64_t* offsets,
+                         int64_t n_texts, int32_t vocab_size,
+                         int32_t reserved, int32_t* out_ids,
+                         int64_t* out_offsets);
+
 /* ---- shard routing ----
  * shard(key) = (key & shard_mask) % n_shards (reference
  * src/engine/dataflow/shard.rs:6 + value.rs:38).  Produces per-shard counts

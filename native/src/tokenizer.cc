@@ -1,0 +1,102 @@
+// Hashing tokenizer — the ingest hot loop (models/tokenizer.py).
+//
+// The Python tokenizer does, per word: regex scan, .lower().encode(), one
+// python-xxhash call.  At ~80k docs/s it was the binding constraint on
+// streaming embed+index ingest (bench.py phase_ingest) — the TPU forward
+// pass is >10x faster than the host could feed it.  This native path
+// tokenizes a whole text batch in one call.
+//
+// Semantics are BIT-IDENTICAL to HashTokenizer for ASCII input (the caller
+// routes non-ASCII batches to the Python path):
+//   token pattern [\w']+|[^\w\s] with \w = [A-Za-z0-9_], \s = " \t\n\r\f\v"
+//   id = reserved + xxh3_64(token.lower()) % (vocab_size - reserved)
+#include "../include/pathway_native.h"
+
+#if defined(__has_include)
+#if __has_include(<xxhash.h>)
+#define PN_HAVE_XXHASH 1
+#define XXH_INLINE_ALL
+#include <xxhash.h>
+#endif
+#endif
+
+#ifdef PN_HAVE_XXHASH
+namespace {
+inline bool is_word(uint8_t c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_';
+}
+inline bool is_space(uint8_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v';
+}
+inline uint8_t lower(uint8_t c) {
+  return (c >= 'A' && c <= 'Z') ? (uint8_t)(c + 32) : c;
+}
+}  // namespace
+#endif
+
+extern "C" int32_t pn_tokenize_hash(const uint8_t* blob,
+                                    const int64_t* offsets, int64_t n_texts,
+                                    int32_t vocab_size, int32_t reserved,
+                                    int32_t* out_ids, int64_t* out_offsets) {
+#ifdef PN_HAVE_XXHASH
+  const uint64_t mod = (uint64_t)(vocab_size - reserved);
+  uint8_t word[4096];  // lowered-token scratch; longer tokens hash streamed
+  int64_t out_n = 0;
+  for (int64_t t = 0; t < n_texts; ++t) {
+    out_offsets[t] = out_n;
+    const uint8_t* p = blob + offsets[t];
+    const uint8_t* end = blob + offsets[t + 1];
+    while (p < end) {
+      uint8_t c = *p;
+      if (is_word(c) || c == '\'') {
+        // maximal [\w']+ run, lowered into scratch (or streamed when huge)
+        const uint8_t* start = p;
+        size_t n = 0;
+        while (p < end && (is_word(*p) || *p == '\'')) {
+          if (n < sizeof(word)) word[n] = lower(*p);
+          ++n;
+          ++p;
+        }
+        uint64_t h;
+        if (n <= sizeof(word)) {
+          h = (uint64_t)XXH3_64bits(word, n);
+        } else {
+          XXH3_state_t* st = XXH3_createState();
+          XXH3_64bits_reset(st);
+          uint8_t chunk[4096];
+          for (size_t i = 0; i < n; i += sizeof(chunk)) {
+            size_t m = n - i < sizeof(chunk) ? n - i : sizeof(chunk);
+            for (size_t j = 0; j < m; ++j) chunk[j] = lower(start[i + j]);
+            XXH3_64bits_update(st, chunk, m);
+          }
+          h = (uint64_t)XXH3_64bits_digest(st);
+          XXH3_freeState(st);
+        }
+        out_ids[out_n++] = (int32_t)(reserved + (h % mod));
+      } else if (is_space(c)) {
+        ++p;
+      } else {
+        // single non-word, non-space char ([^\w\s]); ASCII lower is identity
+        // for punctuation but apply it anyway to mirror .lower()
+        uint8_t lc = lower(c);
+        uint64_t h = (uint64_t)XXH3_64bits(&lc, 1);
+        out_ids[out_n++] = (int32_t)(reserved + (h % mod));
+        ++p;
+      }
+    }
+  }
+  out_offsets[n_texts] = out_n;
+  return 0;
+#else
+  (void)blob;
+  (void)offsets;
+  (void)n_texts;
+  (void)vocab_size;
+  (void)reserved;
+  (void)out_ids;
+  (void)out_offsets;
+  return -1;
+#endif
+}

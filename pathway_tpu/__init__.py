@@ -113,9 +113,10 @@ __version__ = "0.1.0"
 def reset() -> None:
     """Clear the global computation graph (fresh build)."""
     G.clear()
-    from .internals.error_log import clear_error_log
+    from .internals.error_log import clear_error_log, reset_local_sinks
 
     clear_error_log()
+    reset_local_sinks()
 
 
 def global_error_log() -> list:

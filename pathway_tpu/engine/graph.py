@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..internals.keys import KEY_DTYPE
+from ..internals.error_log import set_current_operator
 from ..internals.trace import reraise_with_trace
 from .delta import Delta, RowStore, empty_delta
 
@@ -301,15 +302,7 @@ class EngineGraph:
             _, _, op, port, delta = heapq.heappop(heap)
             if delta.n == 0 and port >= 0:
                 continue
-            t0 = _time.perf_counter_ns()
-            try:
-                out = op.process(port, delta, ts)
-            except Exception as exc:
-                reraise_with_trace(op, exc)
-            elapsed = _time.perf_counter_ns() - t0
-            op.process_ns += elapsed
-            op._tick_acc_ns += elapsed
-            op.rows_in += delta.n
+            out = self._run_op(op, port, delta, ts)
             if out is not None and out.n > 0 and op.output is not None:
                 out = out.consolidated()
                 op.rows_out += out.n
@@ -349,21 +342,31 @@ class EngineGraph:
                 merged = merged.consolidated()
                 if merged.n == 0:
                     continue
-                t0 = _time.perf_counter_ns()
-                try:
-                    out = op.process(port, merged, ts)
-                except Exception as exc:
-                    reraise_with_trace(op, exc)
-                elapsed = _time.perf_counter_ns() - t0
-                op.process_ns += elapsed
-                op._tick_acc_ns += elapsed
-                op.rows_in += merged.n
+                out = self._run_op(op, port, merged, ts)
                 if out is not None and out.n > 0 and op.output is not None:
                     out = out.consolidated()
                     op.rows_out += out.n
                     op.output.store.apply(out)
                     for consumer, cport in op.output.consumers:
                         pending.setdefault((consumer.id, cport), []).append(out)
+
+    def _run_op(self, op: EngineOperator, port: int, delta: Delta, ts: int):
+        """Execute one operator on one delta with error attribution + the
+        per-operator latency/row probes (shared by the single-process heap
+        path and the distributed sweep)."""
+        t0 = _time.perf_counter_ns()
+        set_current_operator(op)
+        try:
+            out = op.process(port, delta, ts)
+        except Exception as exc:
+            reraise_with_trace(op, exc)
+        finally:
+            set_current_operator(None)
+        elapsed = _time.perf_counter_ns() - t0
+        op.process_ns += elapsed
+        op._tick_acc_ns += elapsed
+        op.rows_in += delta.n
+        return out
 
     def _collect(self, op, out, pending) -> None:
         """Queue an operator's tick-end/flush output; ``out`` is either a
@@ -390,10 +393,13 @@ class EngineGraph:
         """Run on_tick_end hooks (time-based operators may release buffers)."""
         pending: List[Tuple[EngineOperator, int, Delta]] = []
         for op in sorted(self.operators, key=lambda o: o.topo_index):
+            set_current_operator(op)
             try:
                 out = op.on_tick_end(ts)
             except Exception as exc:
                 reraise_with_trace(op, exc)
+            finally:
+                set_current_operator(None)
             self._collect(op, out, pending)
         if pending or self.plane is not None:
             # distributed: ranks must run the SAME number of propagate rounds
@@ -408,10 +414,13 @@ class EngineGraph:
     def flush_end(self, ts: int) -> None:
         pending: List[Tuple[EngineOperator, int, Delta]] = []
         for op in sorted(self.operators, key=lambda o: o.topo_index):
+            set_current_operator(op)
             try:
                 out = op.on_end()
             except Exception as exc:
                 reraise_with_trace(op, exc)
+            finally:
+                set_current_operator(None)
             self._collect(op, out, pending)
         if pending or self.plane is not None:
             self.propagate(pending, ts)

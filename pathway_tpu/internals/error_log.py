@@ -33,6 +33,21 @@ _lock = threading.Lock()
 _entries: deque = deque(maxlen=_MAX_ENTRIES)
 _local_sinks: list = []
 
+# the engine operator currently executing on this thread (set by
+# EngineGraph around op.process) — lets local_error_log() attribute row
+# errors to the operator that raised them, like the reference's per-operator
+# error-log routing (src/engine/error.rs:337)
+_tls = threading.local()
+
+
+def set_current_operator(op) -> None:
+    _tls.op = op
+
+
+def current_operator_id() -> Optional[int]:
+    op = getattr(_tls, "op", None)
+    return None if op is None else op.id
+
 
 @dataclass(frozen=True)
 class ErrorLogEntry:
@@ -54,40 +69,91 @@ def log_error(
     trace: Optional[Trace] = None,
     **extra,
 ) -> ErrorLogEntry:
+    op_id = current_operator_id()
+    if op_id is not None:
+        extra = {**extra, "op_id": op_id}
     entry = ErrorLogEntry(message, operator, trace, extra)
     with _lock:
         _entries.append(entry)
         for sink in _local_sinks:
-            sink.append(entry)
+            if sink.accepts(entry):
+                sink.append(entry)
     logger.debug("row error: %s", entry)
     return entry
 
 
 class LocalErrorLog(list):
-    """Entries captured while a ``local_error_log()`` context was open."""
+    """Errors belonging to a ``local_error_log()`` context: raised while it
+    was open, or raised at ANY later point by an operator *built* inside it
+    (reference semantics, internals/errors.py:13)."""
+
+    def __init__(self):
+        super().__init__()
+        self._open = True
+        self._op_ids: Optional[range] = None
+
+    def accepts(self, entry: ErrorLogEntry) -> bool:
+        if self._open:
+            return True
+        if self._op_ids is None:
+            return False
+        op_id = entry.extra.get("op_id")
+        return op_id is not None and op_id in self._op_ids
 
 
 def local_error_log():
-    """Context manager yielding a log that captures errors raised while it
-    is open (reference ``pw.local_error_log``, internals/errors.py:13 — there
-    it scopes errors of operators *built* inside the context; with this
-    framework's eager engine the natural scope is errors *raised* inside,
-    so run the computation — e.g. ``pw.debug.compute_and_print`` — within
-    the ``with`` block).  Entries also remain visible in the global log."""
+    """Context manager yielding a log that captures errors of this context:
+    entries raised while it is open, plus entries raised later by operators
+    BUILT inside it (the reference's scoping, internals/errors.py:13 — build
+    the pipeline in the ``with`` block, run afterwards, read the log).
+    Entries also remain visible in the global log."""
     import contextlib
 
     @contextlib.contextmanager
     def _cm():
+        from .parse_graph import G
+
         captured = LocalErrorLog()
+        n0 = len(G.engine_graph.operators)
         with _lock:
             _local_sinks.append(captured)
         try:
             yield captured
         finally:
-            with _lock:
-                _local_sinks.remove(captured)
+            # stay registered: operators built inside keep routing their
+            # errors here when the graph runs after the block exits.  Bound
+            # the registry — a service opening many contexts must not leak
+            # sink scans/memory without limit; oldest closed sinks retire.
+            ops = G.engine_graph.operators
+            lo = ops[n0].id if len(ops) > n0 else 0
+            hi = ops[-1].id + 1 if len(ops) > n0 else 0
+            captured._op_ids = range(lo, hi)
+            captured._open = False
+            if not captured._op_ids:
+                # nothing built inside: nothing can route here later
+                with _lock:
+                    if captured in _local_sinks:
+                        _local_sinks.remove(captured)
+            _prune_sinks()
 
     return _cm()
+
+
+_MAX_CLOSED_SINKS = 256
+
+
+def _prune_sinks() -> None:
+    with _lock:
+        closed = [s for s in _local_sinks if not s._open]
+        for s in closed[:-_MAX_CLOSED_SINKS]:
+            _local_sinks.remove(s)
+
+
+def reset_local_sinks() -> None:
+    """Drop every registered local sink (pw.reset(): the operators they
+    scope are gone with the graph)."""
+    with _lock:
+        _local_sinks.clear()
 
 
 def global_error_log() -> list:

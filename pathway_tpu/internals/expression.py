@@ -725,6 +725,70 @@ class ReducerExpression(ColumnExpression):
         )
 
 
+def collect_reducers(expr) -> list:
+    """All ReducerExpression nodes inside ``expr`` (not descending into
+    them) — compound reduce outputs like ``sum(x) / count()`` contain several
+    (reference: such expressions are legal reduce outputs,
+    internals/groupbys.py)."""
+    found: list = []
+
+    def walk(e):
+        if isinstance(e, ReducerExpression):
+            found.append(e)
+            return
+        if isinstance(e, ColumnExpression):
+            for d in e._deps:
+                walk(d)
+
+    walk(expr)
+    return found
+
+
+def substitute(expr, mapping: dict):
+    """Clone ``expr`` with nodes replaced per ``mapping`` (id(node) ->
+    replacement expression).  Rewrites every expression-valued attribute
+    (including the ``_deps`` mirror) on shallow copies, so arbitrary node
+    classes survive without per-class cases."""
+    import copy as _copy
+
+    def walk(e):
+        if not isinstance(e, ColumnExpression):
+            return e
+        if id(e) in mapping:
+            return mapping[id(e)]
+        if not e._deps:
+            return e
+        clone = _copy.copy(e)
+        for attr, val in vars(e).items():
+            if isinstance(val, ColumnExpression):
+                setattr(clone, attr, walk(val))
+            elif isinstance(val, tuple) and any(
+                isinstance(v, ColumnExpression) for v in val
+            ):
+                setattr(
+                    clone,
+                    attr,
+                    tuple(
+                        walk(v) if isinstance(v, ColumnExpression) else v
+                        for v in val
+                    ),
+                )
+            elif isinstance(val, dict) and any(
+                isinstance(v, ColumnExpression) for v in val.values()
+            ):
+                setattr(
+                    clone,
+                    attr,
+                    {
+                        k: walk(v) if isinstance(v, ColumnExpression) else v
+                        for k, v in val.items()
+                    },
+                )
+        return clone
+
+    return walk(expr)
+
+
 class MakeTupleExpression(ColumnExpression):
     def __init__(self, *args):
         self._args = tuple(smart_coerce(a) for a in args)

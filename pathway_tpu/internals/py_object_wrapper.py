@@ -63,7 +63,26 @@ class PyObjectWrapper(Generic[T]):
         return isinstance(other, PyObjectWrapper) and self.value == other.value
 
     def __hash__(self) -> int:
-        return hash((PyObjectWrapper, self.value))
+        try:
+            return hash((PyObjectWrapper, self.value))
+        except TypeError:
+            # unhashable payloads (dict/list — a primary use case for opaque
+            # wrappers) hash via their serialized bytes, like the reference
+            # (src/engine/py_object_wrapper.rs hashes the pickled payload) —
+            # groupby/join keys on wrapped objects must not TypeError.
+            # Top-level dicts canonicalize by sorted items first: equal dicts
+            # with different insertion order must hash alike (hash/eq
+            # contract); deeper order-sensitivity matches the reference's
+            # serialized-payload hashing.
+            ser = self._serializer if self._serializer is not None else pickle
+            value = self.value
+            if isinstance(value, dict):
+                try:
+                    items = sorted(value.items(), key=lambda kv: repr(kv[0]))
+                    return hash((PyObjectWrapper, "dict", ser.dumps(items)))
+                except Exception:  # noqa: BLE001 - fall through to raw bytes
+                    pass
+            return hash((PyObjectWrapper, ser.dumps(value)))
 
     def __reduce__(self):
         ser = self._serializer if self._serializer is not None else pickle

@@ -38,8 +38,10 @@ from .expression import (
     IdExpression,
     PointerExpression,
     ReducerExpression,
+    collect_reducers,
     smart_coerce,
 )
+from .expression import substitute as expr_substitute
 from .keys import KEY_DTYPE, ref_scalars_batch, sequential_keys
 from .parse_graph import G
 from .schema import Schema, schema_from_dict
@@ -830,6 +832,27 @@ class TableSlice:
         self._refs = refs
 
 
+def _collect_column_refs(expr, stop_at_reducers: bool = False) -> List[ColumnReference]:
+    """ColumnReference leaves of an expression tree; with
+    ``stop_at_reducers`` the walk does not descend into ReducerExpression
+    nodes (compound reduce outputs: refs OUTSIDE reducers must be grouping
+    columns, refs inside belong to the reducer)."""
+    found: List[ColumnReference] = []
+
+    def walk(e):
+        if stop_at_reducers and isinstance(e, ReducerExpression):
+            return
+        if isinstance(e, ColumnReference):
+            found.append(e)
+            return
+        if isinstance(e, ColumnExpression):
+            for d in e._deps:
+                walk(d)
+
+    walk(expr)
+    return found
+
+
 class GroupedTable:
     """Result of table.groupby(...) (reference: internals/groupbys.py:402)."""
 
@@ -874,25 +897,44 @@ class GroupedTable:
         out_dtypes: Dict[str, dt.DType] = {}
         env = {id(table): table._dtypes, id(this_placeholder): table._dtypes}
         post_fns: Dict[str, Callable] = {}
+        # compound outputs (expressions OVER reducers, e.g. sum(x)/count()):
+        # each nested reducer computes into a hidden column, the surrounding
+        # expression is re-applied on the reduced rows by a post-select
+        compounds: Dict[str, ColumnExpression] = {}
+        node_to_hidden: Dict[int, str] = {}
+
+        def add_reducer_spec(name: str, expr: ReducerExpression) -> None:
+            reducer = expr._reducer()
+            args_exprs = list(expr._args)
+            if getattr(expr, "_needs_key_order", False):
+                order_expr = (
+                    self._sort_by if self._sort_by is not None else IdExpression(None)
+                )
+                args_exprs = args_exprs + [order_expr]
+            reducer_specs.append(ReducerSpec(name, reducer, args_exprs))
+            if getattr(expr, "_post", None) is not None:
+                post_fns[name] = expr._post
+            out_dtypes[name] = _reducer_dtype(reducer, args_exprs, env)
+
+        grouping_ref_names = {
+            ge.name for ge in grouping_exprs.values() if isinstance(ge, ColumnReference)
+        }
 
         for out_name, expr in out_exprs.items():
             out_names.append(out_name)
             if isinstance(expr, ReducerExpression):
-                reducer = expr._reducer()
-                args_exprs = list(expr._args)
-                if getattr(expr, "_needs_key_order", False):
-                    order_expr = (
-                        self._sort_by if self._sort_by is not None else IdExpression(None)
-                    )
-                    args_exprs = args_exprs + [order_expr]
-                reducer_specs.append(
-                    ReducerSpec(out_name, reducer, args_exprs)
-                )
-                if getattr(expr, "_post", None) is not None:
-                    post_fns[out_name] = expr._post
-                out_dtypes[out_name] = _reducer_dtype(reducer, args_exprs, env)
+                add_reducer_spec(out_name, expr)
             elif isinstance(expr, ColumnExpression):
-                # must be (an expression of) grouping columns
+                nested = collect_reducers(expr)
+                if nested:
+                    for node in nested:
+                        if id(node) not in node_to_hidden:
+                            hidden = f"_cr{len(node_to_hidden)}"
+                            node_to_hidden[id(node)] = hidden
+                            add_reducer_spec(hidden, node)
+                    compounds[out_name] = expr
+                    continue
+                # plain output: must be (an expression of) grouping columns
                 gname = None
                 if isinstance(expr, ColumnReference):
                     for gn, ge in grouping_exprs.items():
@@ -903,8 +945,22 @@ class GroupedTable:
                             gname = gn
                             break
                 if gname is None:
-                    # allow arbitrary expressions over grouping columns by
-                    # making them part of the grouping key
+                    # expressions over grouping columns fold into the group
+                    # key; anything touching a NON-grouping column must fail
+                    # loudly (the reference raises; silently grouping finer
+                    # would diverge results — round-3 advice)
+                    refs = {
+                        r.name
+                        for r in _collect_column_refs(expr)
+                        if not isinstance(r, IdExpression)
+                    }
+                    stray = refs - grouping_ref_names
+                    if stray:
+                        raise ValueError(
+                            f"reduce output {out_name!r} uses non-grouping "
+                            f"column(s) {sorted(stray)} outside a reducer; "
+                            "wrap them in a reducer or add them to groupby()"
+                        )
                     gname = f"_gexpr_{len(grouping_exprs)}"
                     grouping_exprs[gname] = expr
                 if gname != out_name:
@@ -913,13 +969,34 @@ class GroupedTable:
             else:
                 raise ValueError(f"cannot reduce with {expr!r}")
 
-        # grouping columns not projected out still participate in the key
-        hidden = {
-            gn: ge for gn, ge in grouping_exprs.items() if gn not in out_names
-        }
+        # grouping columns referenced inside compounds (outside reducers)
+        # project through hidden grouping outputs
+        compound_gref_hidden: Dict[str, str] = {}
+        for expr in compounds.values():
+            for ref in _collect_column_refs(expr, stop_at_reducers=True):
+                if isinstance(ref, IdExpression):
+                    continue
+                if ref.name in grouping_ref_names:
+                    compound_gref_hidden.setdefault(
+                        ref.name, f"_cg_{ref.name}"
+                    )
+                elif ref.name not in grouping_ref_names:
+                    raise ValueError(
+                        f"compound reduce output uses non-grouping column "
+                        f"{ref.name!r} outside a reducer"
+                    )
+        for gref_name, hidden in compound_gref_hidden.items():
+            for gn, ge in list(grouping_exprs.items()):
+                if isinstance(ge, ColumnReference) and ge.name == gref_name:
+                    grouping_exprs[hidden] = ge
+                    break
+
         all_grouping = dict(grouping_exprs)
-        # output columns = requested outputs only
-        engine_out_names = [n for n in out_names]
+        # engine output = requested non-compound outputs + hidden columns
+        # feeding the compound post-select
+        engine_out_names = [n for n in out_names if n not in compounds]
+        engine_out_names += list(node_to_hidden.values())
+        engine_out_names += list(compound_gref_hidden.values())
         ctx = table._ctx_cols(placeholders=[this_placeholder])
         input_table, ctx2, env2 = table._with_siblings(
             list(all_grouping.values())
@@ -949,7 +1026,31 @@ class GroupedTable:
         # GroupByOperator emits exactly output.column_names: set them correctly
         et.column_names = engine_out_names
         et.store.column_names = engine_out_names
-        return Table(et, out_dtypes, Universe())
+        red = Table(
+            et,
+            {n: out_dtypes.get(n, dt.ANY) for n in engine_out_names},
+            Universe(),
+        )
+        if not compounds:
+            return red
+        # post-select: re-apply each compound expression on the reduced rows
+        # with reducer nodes -> hidden reducer columns and grouping refs ->
+        # hidden grouping projections (key-preserving rowwise select)
+        mapping: Dict[int, ColumnExpression] = {
+            node_id: red[hidden] for node_id, hidden in node_to_hidden.items()
+        }
+        final_sel: Dict[str, Any] = {}
+        for name in out_names:
+            expr = compounds.get(name)
+            if expr is None:
+                final_sel[name] = red[name]
+                continue
+            ref_map = dict(mapping)
+            for ref in _collect_column_refs(expr, stop_at_reducers=True):
+                if not isinstance(ref, IdExpression):
+                    ref_map[id(ref)] = red[compound_gref_hidden[ref.name]]
+            final_sel[name] = expr_substitute(expr, ref_map)
+        return red.select(**final_sel)
 
 
 class _PostReducer(Reducer):
@@ -1255,32 +1356,102 @@ class GroupedJoinResult:
             sel["_gsort"] = self._sort_by
         if self._instance is not None:
             sel["_ginst"] = self._instance
-        rebind: Dict[Tuple[str, int], str] = {}
+        # every reducer node's args (bare outputs AND reducers nested inside
+        # compound expressions like sum(x)/count() — round-3 advice) become
+        # _r inputs evaluated in the join context; the reducers are then
+        # re-bound onto the intermediate table
+        def grouping_index(ref) -> Optional[int]:
+            if not isinstance(ref, ColumnReference):
+                return None
+            for i, g in enumerate(self._grouping):
+                # table identity matters: the two joined sides may both have
+                # a column of this name — matching by name alone would
+                # silently substitute the grouping side's values
+                if (
+                    isinstance(g, ColumnReference)
+                    and g.name == ref.name
+                    and g._table is ref._table
+                ):
+                    return i
+            return None
+
+        node_rebind: Dict[int, List[str]] = {}
         n_inputs = 0
         for name, expr in out_exprs.items():
-            if isinstance(expr, ReducerExpression):
-                for k, a in enumerate(expr._args):
-                    sel[f"_r{n_inputs}"] = a
-                    rebind[(name, k)] = f"_r{n_inputs}"
-                    n_inputs += 1
-            else:
+            nested = (
+                [expr]
+                if isinstance(expr, ReducerExpression)
+                else collect_reducers(expr)
+            )
+            if nested:
+                for node in nested:
+                    if id(node) in node_rebind:
+                        continue
+                    cols = []
+                    for a in node._args:
+                        sel[f"_r{n_inputs}"] = a
+                        cols.append(f"_r{n_inputs}")
+                        n_inputs += 1
+                    node_rebind[id(node)] = cols
+            elif grouping_index(expr) is None:
+                # plain non-grouping output: reject here with the join-level
+                # name (the reference raises for non-grouping columns in
+                # reduce — silently folding them would group finer and
+                # silently diverge; round-3 advice)
+                refs = _collect_column_refs(expr)
+                stray = [
+                    r.name for r in refs if grouping_index(r) is None
+                ]
+                if stray:
+                    raise ValueError(
+                        f"reduce output {name!r} uses non-grouping "
+                        f"column(s) {sorted(set(stray))} outside a reducer; "
+                        "wrap them in a reducer or add them to groupby()"
+                    )
+                # expression-of-grouping / constant outputs are
+                # group-invariant: selected into the intermediate table and
+                # added to the inner grouping (the fold GroupedTable.reduce
+                # applies to expressions over grouping columns)
                 sel[f"_o_{name}"] = expr
         inter = self._join.select(**sel)
+        passthrough = [c for c in sel if c.startswith("_o_")]
         grouped = inter.groupby(
             *[inter[f"_g{i}"] for i in range(len(self._grouping))],
+            *[inter[c] for c in passthrough],
             id=inter["_gid"] if self._id is not None else None,
             sort_by=inter["_gsort"] if self._sort_by is not None else None,
             instance=inter["_ginst"] if self._instance is not None else None,
         )
+
+        def rebound(node: ReducerExpression) -> ReducerExpression:
+            clone = _copy.copy(node)
+            clone._args = tuple(inter[c] for c in node_rebind[id(node)])
+            clone._deps = clone._args
+            return clone
+
         red_kwargs: Dict[str, Any] = {}
         for name, expr in out_exprs.items():
             if isinstance(expr, ReducerExpression):
-                clone = _copy.copy(expr)
-                clone._args = tuple(
-                    inter[rebind[(name, k)]] for k in range(len(expr._args))
-                )
-                clone._deps = clone._args
-                red_kwargs[name] = clone
+                red_kwargs[name] = rebound(expr)
+            elif collect_reducers(expr):
+                # compound: clone with every nested reducer re-bound; the
+                # grouped reduce handles the surrounding expression
+                mapping = {
+                    id(node): rebound(node) for node in collect_reducers(expr)
+                }
+                for ref in _collect_column_refs(expr, stop_at_reducers=True):
+                    gi = grouping_index(ref)
+                    if gi is None:
+                        raise ValueError(
+                            f"compound reduce output {name!r} uses "
+                            f"non-grouping column {ref.name!r} outside a "
+                            "reducer"
+                        )
+                    mapping[id(ref)] = inter[f"_g{gi}"]
+                red_kwargs[name] = expr_substitute(expr, mapping)
             else:
-                red_kwargs[name] = inter[f"_o_{name}"]
+                gi = grouping_index(expr)
+                red_kwargs[name] = (
+                    inter[f"_g{gi}"] if gi is not None else inter[f"_o_{name}"]
+                )
         return grouped.reduce(**red_kwargs)

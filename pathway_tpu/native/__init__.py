@@ -33,6 +33,7 @@ __all__ = [
     "crc32",
     "frame_scan",
     "shard_rows",
+    "tokenize_hash",
 ]
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
@@ -130,6 +131,13 @@ def _declare(dll: ctypes.CDLL) -> ctypes.CDLL:
     dll.pn_frame_scan.argtypes = [_p_u8, _i64, _p_i64, _p_i64, _i64, _p_i64]
     dll.pn_shard_rows.restype = None
     dll.pn_shard_rows.argtypes = [_p_u64, _i64, _u32, _u64, _p_i64, _p_i64]
+    try:
+        dll.pn_tokenize_hash.restype = _i32
+        dll.pn_tokenize_hash.argtypes = [
+            _p_u8, _p_i64, _i64, _i32, _i32, ctypes.POINTER(_i32), _p_i64,
+        ]
+    except AttributeError:
+        pass  # stale .so without the tokenizer entry point
     return dll
 
 
@@ -425,3 +433,29 @@ def shard_rows(
         _np_ptr(counts, _i64), _np_ptr(order, _i64),
     )
     return counts, order
+
+
+# ---------------------------------------------------------------- tokenizer
+
+
+def tokenize_hash(
+    blob: bytes, offsets: np.ndarray, vocab_size: int, reserved: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Batch hashing tokenizer over concatenated ASCII texts
+    (models/tokenizer.py semantics): returns (ids ragged int32, tok_offsets
+    int64[n+1]), or None when the native path is unavailable (caller keeps
+    the Python tokenizer)."""
+    dll = lib()
+    if dll is None or not hasattr(dll, "pn_tokenize_hash"):
+        return None
+    n_texts = len(offsets) - 1
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    out_ids = np.empty(max(len(blob), 1), dtype=np.int32)
+    out_offsets = np.empty(n_texts + 1, dtype=np.int64)
+    rc = dll.pn_tokenize_hash(
+        _as_u8_ptr(blob), _np_ptr(offsets, _i64), n_texts,
+        vocab_size, reserved, _np_ptr(out_ids, _i32), _np_ptr(out_offsets, _i64),
+    )
+    if rc != 0:
+        return None
+    return out_ids[: out_offsets[n_texts]], out_offsets

@@ -136,3 +136,32 @@ def test_reset_clears_error_log():
     assert pw.global_error_log()
     pw.reset()
     assert pw.global_error_log() == []
+
+
+def test_local_error_log_scopes_operators_built_inside():
+    """Reference semantics (internals/errors.py:13): the local log owns
+    errors of operators BUILT inside the context, even when the graph runs
+    after the block exits — and unrelated later operators don't leak in."""
+    t = pw.debug.table_from_markdown(
+        """
+        x
+        1
+        0
+        """
+    )
+    with pw.local_error_log() as log:
+        t.select(y=pw.apply(lambda x: 1 // x, t.x))
+    pw.run(monitoring_level=None)  # runs AFTER the with block
+    assert len(log) >= 1
+    assert "ZeroDivision" in log[0].message
+
+    before = len(log)
+    t2 = pw.debug.table_from_markdown(
+        """
+        x
+        0
+        """
+    )
+    t2.select(y=pw.apply(lambda x: 2 // x, t2.x))
+    pw.run(monitoring_level=None)
+    assert len(log) == before, "unrelated error leaked into closed local log"

@@ -445,3 +445,88 @@ def test_filter_delete_after_update_transient():
     keys, cols = out._materialize()
     vals = sorted(int(x) for x in cols["v2"])
     assert vals == [18], f"phantom rows: {vals}"
+
+
+def test_reduce_compound_reducer_expressions():
+    """Expressions OVER reducers (sum/count, max-min) are legal reduce
+    outputs (reference supports them; round-3 advice)."""
+    t = T(
+        """
+        word  | cnt
+        alpha | 1
+        beta  | 2
+        alpha | 3
+        beta  | 5
+        """
+    )
+    r = t.groupby(t.word).reduce(
+        word=t.word,
+        avg=pw.reducers.sum(t.cnt) / pw.reducers.count(),
+        spread=pw.reducers.max(t.cnt) - pw.reducers.min(t.cnt),
+        gplus=t.word + "!",
+    )
+    assert_rows(
+        r,
+        [
+            {"word": "alpha", "avg": 2.0, "spread": 2, "gplus": "alpha!"},
+            {"word": "beta", "avg": 3.5, "spread": 3, "gplus": "beta!"},
+        ],
+    )
+
+
+def test_join_groupby_reduce_compound():
+    a = T(
+        """
+        k | x
+        1 | 10
+        2 | 20
+        1 | 30
+        """
+    )
+    b = T(
+        """
+        k | y
+        1 | 2
+        2 | 4
+        """
+    )
+    j = a.join(b, a.k == b.k).groupby(a.k).reduce(
+        k=a.k, ratio=pw.reducers.sum(a.x) / pw.reducers.count()
+    )
+    assert_rows(j, [{"k": 1, "ratio": 20.0}, {"k": 2, "ratio": 20.0}])
+
+
+def test_reduce_non_grouping_column_raises():
+    """A plain non-grouping column in reduce must fail loudly (reference
+    raises; silently folding it into the key would diverge results)."""
+    t = T(
+        """
+        g | v
+        1 | 5
+        """
+    )
+    with pytest.raises(ValueError, match="non-grouping"):
+        t.groupby(t.g).reduce(v=t.v)
+    a = T(
+        """
+        k | x
+        1 | 10
+        """
+    )
+    b = T(
+        """
+        k | y
+        1 | 2
+        """
+    )
+    with pytest.raises(ValueError, match="non-grouping"):
+        a.join(b, a.k == b.k).groupby(a.k).reduce(x=a.x)
+
+
+def test_py_object_wrapper_unhashable_payload():
+    """Wrapping dicts/lists (the primary opaque-wrapper use case) must not
+    TypeError in hashed contexts (reference hashes the serialized payload)."""
+    w1 = pw.PyObjectWrapper({"a": 1})
+    w2 = pw.PyObjectWrapper({"a": 1})
+    assert w1 == w2 and hash(w1) == hash(w2)
+    assert hash(pw.PyObjectWrapper([1, 2])) != hash(pw.PyObjectWrapper([2, 1]))
