@@ -27,8 +27,9 @@ inline bool is_word(uint8_t c) {
          (c >= '0' && c <= '9') || c == '_';
 }
 inline bool is_space(uint8_t c) {
-  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
-         c == '\v';
+  // Python's \s over ASCII: space, \t-\r (0x09-0x0D), AND the separator
+  // controls \x1c-\x1f (unicodedata puts FS/GS/RS/US in the \s class)
+  return c == ' ' || (c >= 0x09 && c <= 0x0D) || (c >= 0x1C && c <= 0x1F);
 }
 inline uint8_t lower(uint8_t c) {
   return (c >= 'A' && c <= 'Z') ? (uint8_t)(c + 32) : c;

@@ -66,6 +66,7 @@ from . import universes  # noqa: E402
 from .internals import udfs  # noqa: E402
 from .internals.udfs import UDF, udf, udf_async  # noqa: E402
 from .internals.yaml_loader import load_yaml  # noqa: E402
+from .internals.export_import import ExportedTable, export_table, import_table  # noqa: E402
 from .internals.sql import sql  # noqa: E402
 from .internals.config import (  # noqa: E402
     PathwayConfig,
@@ -117,6 +118,12 @@ def reset() -> None:
 
     clear_error_log()
     reset_local_sinks()
+    from .internals.export_import import close_all_exports
+
+    close_all_exports()
+    from .internals.universe_solver import get_solver
+
+    get_solver().clear()
 
 
 def global_error_log() -> list:

@@ -744,6 +744,46 @@ def collect_reducers(expr) -> list:
     return found
 
 
+def expr_equal(a, b) -> bool:
+    """Structural equality of expression trees (same node classes, same
+    table/name for references, same constants, same children) — used to
+    recognize a reduce output that RE-STATES a grouping expression
+    (``groupby(t.a % 2).reduce(parity=t.a % 2)``)."""
+    if a is b:
+        return True
+    if type(a) is not type(b):
+        return False
+    if not isinstance(a, ColumnExpression):
+        return a == b
+    if isinstance(a, ColumnReference):
+        return a._table is b._table and a._name == b._name
+    if isinstance(a, ColumnConstExpression):
+        return type(a._value) is type(b._value) and a._value == b._value
+    if len(a._deps) != len(b._deps):
+        return False
+    for attr, val in vars(a).items():
+        other = getattr(b, attr, None)
+        if isinstance(val, ColumnExpression):
+            if not expr_equal(val, other):
+                return False
+        elif isinstance(val, tuple):
+            if not isinstance(other, tuple) or len(val) != len(other):
+                return False
+            for x, y in zip(val, other):
+                if isinstance(x, ColumnExpression):
+                    if not expr_equal(x, y):
+                        return False
+                elif x != y:
+                    return False
+        elif callable(val):
+            if val is not other:
+                return False
+        elif isinstance(val, (str, int, float, bool, type(None))):
+            if val != other:
+                return False
+    return True
+
+
 def substitute(expr, mapping: dict):
     """Clone ``expr`` with nodes replaced per ``mapping`` (id(node) ->
     replacement expression).  Rewrites every expression-valued attribute

@@ -67,22 +67,15 @@ class PyObjectWrapper(Generic[T]):
             return hash((PyObjectWrapper, self.value))
         except TypeError:
             # unhashable payloads (dict/list — a primary use case for opaque
-            # wrappers) hash via their serialized bytes, like the reference
-            # (src/engine/py_object_wrapper.rs hashes the pickled payload) —
-            # groupby/join keys on wrapped objects must not TypeError.
-            # Top-level dicts canonicalize by sorted items first: equal dicts
-            # with different insertion order must hash alike (hash/eq
-            # contract); deeper order-sensitivity matches the reference's
-            # serialized-payload hashing.
-            ser = self._serializer if self._serializer is not None else pickle
-            value = self.value
-            if isinstance(value, dict):
-                try:
-                    items = sorted(value.items(), key=lambda kv: repr(kv[0]))
-                    return hash((PyObjectWrapper, "dict", ser.dumps(items)))
-                except Exception:  # noqa: BLE001 - fall through to raw bytes
-                    pass
-            return hash((PyObjectWrapper, ser.dumps(value)))
+            # wrappers) must not TypeError in hashed contexts.  The hash is
+            # deliberately COARSE (per payload type): any value-derived hash
+            # (pickle bytes, sorted items) breaks the hash/eq contract for
+            # payloads that compare equal but serialize differently
+            # ({True: 1} == {1: 1}, [1] == [1.0]).  Equal values therefore
+            # always collide into the same bucket and resolve via __eq__;
+            # engine keys hash via serialization (internals/keys.py), so
+            # only host-side dict/set use pays the bucket scan.
+            return hash((PyObjectWrapper, type(self.value).__name__))
 
     def __reduce__(self):
         ser = self._serializer if self._serializer is not None else pickle

@@ -41,6 +41,7 @@ from .expression import (
     collect_reducers,
     smart_coerce,
 )
+from .expression import expr_equal
 from .expression import substitute as expr_substitute
 from .keys import KEY_DTYPE, ref_scalars_batch, sequential_keys
 from .parse_graph import G
@@ -770,6 +771,16 @@ class Table(Joinable):
         return Table(et, dtypes, Universe())
 
     def update_cells(self, other: "Table") -> "Table":
+        # build-time universe proof (reference table.py:1509 raises via the
+        # SAT solver; here internals/universe_solver.py transitive closure):
+        # a provably-unrelated key set fails at CONSTRUCTION, not tick time
+        if not other._universe.is_subset_of(self._universe):
+            raise ValueError(
+                "Universe of the argument of update_cells() needs to be a "
+                "subset of the universe of the updated table.  Prove it with "
+                "pw.universes.promise_is_subset_of(other, self) or align it "
+                "with other.with_universe_of(self)."
+            )
         names = self.column_names
         upd = {
             n: other._column_mapping[n]
@@ -942,6 +953,13 @@ class GroupedTable:
                             isinstance(ge, ColumnReference)
                             and ge.name == expr.name
                         ):
+                            gname = gn
+                            break
+                if gname is None:
+                    # re-stating a grouping EXPRESSION (groupby(t.a % 2)
+                    # .reduce(parity=t.a % 2)) binds to it structurally
+                    for gn, ge in grouping_exprs.items():
+                        if expr_equal(ge, expr):
                             gname = gn
                             break
                 if gname is None:

@@ -14,7 +14,7 @@ def promise_are_equal(*tables: Table) -> None:
 
 
 def promise_is_subset_of(subset: Table, superset: Table) -> None:
-    subset._universe = superset._universe.subuniverse()
+    subset._universe.promise_subset_of(superset._universe)
 
 
 def promise_are_pairwise_disjoint(*tables: Table) -> None:

@@ -130,7 +130,7 @@ def _pdf_extract_text(contents: bytes) -> List[str]:
     import zlib
 
     texts: List[str] = []
-    for m in re.finditer(rb"stream\r?\n", contents):
+    for m in re.finditer(rb"(?<!end)stream\r?\n", contents):
         start = m.end()
         end = contents.find(b"endstream", start)
         if end < 0:
@@ -276,11 +276,106 @@ class ImageParser(UDF):
         super().__init__(parse, **kwargs)
 
 
-class SlideParser(UDF):
-    """(reference: parsers.py:569 — slide decks via vision LLM; gated)"""
+def _pdf_slide_scan(contents: bytes):
+    """Walk a PDF's streams in document order, yielding per-slide text and
+    embedded JPEG images: ("text", slide_idx, str) and
+    ("image", slide_idx, jpeg_bytes).  Slide index advances at each
+    text-bearing content stream (one content stream per exported slide is
+    how deck exporters write PDFs)."""
+    import re
+    import zlib
 
-    def __init__(self, **kwargs):
-        raise ImportError(
-            "SlideParser requires vision-LLM tooling unavailable offline; "
-            "use ParseUtf8/PypdfParser"
+    slide = -1
+    for m in re.finditer(rb"(?<!end)stream\r?\n", contents):
+        start = m.end()
+        end = contents.find(b"endstream", start)
+        if end < 0:
+            continue
+        data = contents[start:end].rstrip(b"\r\n")
+        # embedded JPEG (DCTDecode) XObjects pass through undeflated
+        if data[:3] == b"\xff\xd8\xff":
+            yield ("image", max(slide, 0), data)
+            continue
+        try:
+            inflated = zlib.decompress(data)
+        except zlib.error:
+            inflated = data
+        if inflated[:3] == b"\xff\xd8\xff":
+            yield ("image", max(slide, 0), inflated)
+            continue
+        if b"BT" not in inflated:
+            continue
+        slide += 1
+        texts = _pdf_extract_text(
+            b"stream\n" + data + b"\nendstream"
         )
+        yield ("text", slide, " ".join(" ".join(t.split()) for t in texts))
+
+
+class SlideParser(UDF):
+    """Slide decks (PDF exports) parsed fully offline — the TPU-first
+    redesign of the reference's vision-LLM SlideParser (parsers.py:569,
+    which rasterizes slides and asks a remote vision model to describe
+    them): per-slide text chunks come from the pure-python PDF extractor,
+    and embedded slide images are zero-shot labeled with the local CLIP
+    model (like ImageParser) so image-only slides stay searchable."""
+
+    def __init__(
+        self,
+        labels: Optional[List[str]] = None,
+        clip_model=None,
+        top_k_labels: int = 3,
+        downsize_to: int = 64,
+        **kwargs,
+    ):
+        clip = clip_model
+        if labels and clip is None:
+            from ...models.clip import ClipModel
+
+            clip = ClipModel(image_size=downsize_to)
+        label_vecs = None
+
+        def parse(contents: bytes) -> List[Chunk]:
+            import io as _io
+
+            slide_text: Dict[int, List[str]] = {}
+            slide_labels: Dict[int, List[str]] = {}
+            for kind, slide, payload in _pdf_slide_scan(bytes(contents)):
+                if kind == "text":
+                    if payload:
+                        slide_text.setdefault(slide, []).append(payload)
+                    continue
+                if not labels:
+                    continue
+                try:
+                    from PIL import Image
+
+                    import numpy as np
+
+                    img = Image.open(_io.BytesIO(payload)).convert("RGB")
+                except Exception:  # noqa: BLE001 - undecodable image
+                    continue
+                img = img.resize((downsize_to, downsize_to))
+                arr = np.asarray(img, dtype=np.float32) / 255.0
+                nonlocal label_vecs
+                if label_vecs is None:
+                    label_vecs = clip.encode_text(list(labels))
+                img_vec = clip.encode_image([arr])[0]
+                order = (label_vecs @ img_vec).argsort()[::-1][:top_k_labels]
+                slide_labels.setdefault(slide, []).extend(
+                    labels[i] for i in order
+                )
+            out: List[Chunk] = []
+            for slide in sorted(set(slide_text) | set(slide_labels)):
+                text = " ".join(slide_text.get(slide, []))
+                picked = slide_labels.get(slide, [])
+                if picked:
+                    text = (text + " " if text else "") + ", ".join(picked)
+                meta: Dict[str, Any] = {"slide": slide}
+                if picked:
+                    meta["labels"] = picked
+                if text:
+                    out.append((text, meta))
+            return out
+
+        super().__init__(parse, **kwargs)

@@ -1,0 +1,62 @@
+"""Export/import table surface — two graphs exchanging a table
+(reference: trait ExportedTable, src/engine/graph.rs:629-662; VERDICT r3
+Missing #7)."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+
+from .utils import T, assert_rows
+
+
+def test_two_graphs_exchange_a_table():
+    # graph 1: aggregate and export
+    t = T(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+    agg = t.groupby(t.k).reduce(k=t.k, s=pw.reducers.sum(t.v))
+    handle = pw.export_table(agg)
+    pw.run(monitoring_level=None)
+    assert handle.frontier > 0
+    assert sorted(row for _key, row in handle.snapshot()) == [
+        ("a", 3),
+        ("b", 5),
+    ]
+
+    # graph 2: a FRESH graph imports the stream and keeps computing
+    pw.reset()
+    imported = pw.import_table(handle)
+    doubled = imported.select(k=pw.this.k, d=pw.this.s * 2)
+    pw.run(monitoring_level=None)
+    assert_rows(doubled, [{"k": "a", "d": 6}, {"k": "b", "d": 10}])
+
+
+def test_import_replays_retractions():
+    """The exported stream carries retractions; the importer's state ends at
+    the exporter's final state, not the union of all versions."""
+
+    class Row(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time
+
+            self.next(k="x", v=1)
+            time.sleep(0.3)
+            self.next(k="x", v=7)  # upsert: retract v=1, insert v=7
+
+    src = pw.io.python.read(Subj(), schema=Row)
+    handle = pw.export_table(src)
+    pw.run(monitoring_level=None, commit_duration_ms=100)
+
+    pw.reset()
+    imported = pw.import_table(handle)
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+    assert_rows(imported, [{"k": "x", "v": 7}])

@@ -1,0 +1,227 @@
+"""pw.sql — the reference's documented SELECT subset
+(/root/reference/python/pathway/internals/sql.py:640-668: projections,
+WHERE, GROUP BY, HAVING, JOIN, UNION, INTERSECT, WITH, subqueries) plus
+this framework's ORDER BY / LIMIT extension (the reference rejects ordering
+ops, sql.py:661)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import T, assert_rows
+
+
+def test_select_where_projection():
+    t = T(
+        """
+        k | v
+        a | 3
+        b | 1
+        a | 2
+        """
+    )
+    r = pw.sql("SELECT k, v + 1 AS w FROM t WHERE v > 1", t=t)
+    assert_rows(r, [{"k": "a", "w": 4}, {"k": "a", "w": 3}])
+
+
+def test_group_by_having():
+    t = T(
+        """
+        k | v
+        a | 3
+        b | 1
+        a | 2
+        b | 9
+        c | 1
+        """
+    )
+    r = pw.sql(
+        "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM t GROUP BY k HAVING SUM(v) > 2",
+        t=t,
+    )
+    assert_rows(r, [{"k": "a", "s": 5, "n": 2}, {"k": "b", "s": 10, "n": 2}])
+
+
+def test_join_on():
+    a = T(
+        """
+        k | x
+        1 | 10
+        2 | 20
+        """
+    )
+    b = T(
+        """
+        k | y
+        1 | 7
+        3 | 9
+        """
+    )
+    r = pw.sql("SELECT x, y FROM a JOIN b ON a.k = b.k", a=a, b=b)
+    assert_rows(r, [{"x": 10, "y": 7}])
+
+
+def test_order_by_limit_offset():
+    t = T(
+        """
+        k | v
+        a | 3
+        b | 1
+        a | 2
+        c | 5
+        b | 4
+        """
+    )
+    r = pw.sql("SELECT k, v FROM t ORDER BY v DESC LIMIT 2", t=t)
+    assert_rows(r, [{"k": "c", "v": 5}, {"k": "b", "v": 4}])
+
+
+def test_order_by_multi_key_asc_desc():
+    t = T(
+        """
+        k | v
+        a | 2
+        b | 2
+        a | 1
+        """
+    )
+    r = pw.sql("SELECT k, v FROM t ORDER BY v DESC, k ASC LIMIT 2", t=t)
+    assert_rows(r, [{"k": "a", "v": 2}, {"k": "b", "v": 2}])
+
+
+def test_limit_window_tracks_streaming_updates():
+    """Rows entering/leaving the LIMIT window under live updates — the
+    incremental top-k the reference cannot express (it rejects ORDER BY)."""
+    import time
+
+    class Row(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=3)
+            self.next(k="b", v=1)
+            time.sleep(0.4)
+            self.next(k="c", v=9)  # evicts b from top-2
+
+    src = pw.io.python.read(Subj(), schema=Row)
+    top2 = pw.sql("SELECT k, v FROM src ORDER BY v DESC LIMIT 2", src=src)
+    pw.run(monitoring_level=None, commit_duration_ms=100)
+    keys, cols = top2._materialize()
+    got = sorted(zip(cols["k"], cols["v"]))
+    assert got == [("a", 3), ("c", 9)], got
+
+
+def test_subquery_in_from():
+    t = T(
+        """
+        k | v
+        a | 3
+        b | 1
+        a | 2
+        """
+    )
+    r = pw.sql(
+        "SELECT k, s FROM (SELECT k, SUM(v) AS s FROM t GROUP BY k) sub "
+        "WHERE s > 2",
+        t=t,
+    )
+    assert_rows(r, [{"k": "a", "s": 5}])
+
+
+def test_with_cte():
+    t = T(
+        """
+        k | v
+        a | 3
+        b | 1
+        """
+    )
+    r = pw.sql(
+        "WITH big AS (SELECT k, v FROM t WHERE v > 2), "
+        "named AS (SELECT k FROM big) SELECT k FROM named",
+        t=t,
+    )
+    assert_rows(r, [{"k": "a"}])
+
+
+def test_scalar_aggregate_subquery():
+    t = T(
+        """
+        k | v
+        a | 3
+        b | 1
+        c | 5
+        """
+    )
+    r = pw.sql("SELECT k, v FROM t WHERE v > (SELECT AVG(v) FROM t)", t=t)
+    assert_rows(r, [{"k": "c", "v": 5}])
+
+
+def test_union_intersect_except():
+    a = T(
+        """
+        x
+        1
+        2
+        2
+        """
+    )
+    b = T(
+        """
+        x
+        2
+        3
+        """
+    )
+    assert_rows(
+        pw.sql("SELECT x FROM a UNION SELECT x FROM b", a=a, b=b),
+        [{"x": 1}, {"x": 2}, {"x": 3}],
+    )
+    assert_rows(
+        pw.sql("SELECT x FROM a UNION ALL SELECT x FROM b", a=a, b=b),
+        [{"x": 1}, {"x": 2}, {"x": 2}, {"x": 2}, {"x": 3}],
+    )
+    assert_rows(
+        pw.sql("SELECT x FROM a INTERSECT SELECT x FROM b", a=a, b=b),
+        [{"x": 2}],
+    )
+    assert_rows(
+        pw.sql("SELECT x FROM a EXCEPT SELECT x FROM b", a=a, b=b),
+        [{"x": 1}],
+    )
+
+
+def test_case_when():
+    t = T(
+        """
+        v
+        1
+        5
+        """
+    )
+    r = pw.sql(
+        "SELECT CASE WHEN v > 3 THEN 'big' ELSE 'small' END AS size FROM t",
+        t=t,
+    )
+    assert_rows(r, [{"size": "small"}, {"size": "big"}])
+
+
+def test_union_mismatched_columns_raises():
+    a = T(
+        """
+        x
+        1
+        """
+    )
+    b = T(
+        """
+        y
+        2
+        """
+    )
+    with pytest.raises(ValueError, match="matching column names"):
+        pw.sql("SELECT x FROM a UNION SELECT y FROM b", a=a, b=b)

@@ -240,8 +240,46 @@ def test_update_cells():
       | b
     1 | z
     """)
+    # reference usage (tests/test_common.py:3500): the subset relation must
+    # be promised (or provable) — the static solver gates update_cells
+    pw.universes.promise_is_subset_of(t2, t1)
     out = t1.update_cells(t2)
     assert_rows(out, [{"a": 1, "b": "z"}, {"a": 2, "b": "y"}])
+
+
+def test_update_cells_unrelated_universe_raises_at_build():
+    """Provably-unrelated key sets fail at graph CONSTRUCTION (reference:
+    SAT-backed universe solver; here internals/universe_solver.py)."""
+    t1 = T("""
+      | a | b
+    1 | 1 | x
+    """)
+    t2 = T("""
+      | b
+    5 | z
+    """)
+    with pytest.raises(ValueError, match="[Uu]niverse"):
+        t1.update_cells(t2)
+    # the with_universe_of escape hatch restores buildability
+    out = t1.update_cells(t2.with_universe_of(t1))
+    assert out is not None
+
+
+def test_universe_solver_transitive_subset():
+    """filter ⊂ filter ⊂ base chains prove transitively, so derived tables
+    update_cells into ancestors without explicit promises."""
+    t = T("""
+      | a | b
+    1 | 1 | x
+    2 | 5 | y
+    3 | 9 | z
+    """)
+    sub = t.filter(pw.this.a > 2).filter(pw.this.a > 6)
+    patched = t.update_cells(sub.select(b=pw.this.b + "!"))
+    assert_rows(
+        patched,
+        [{"a": 1, "b": "x"}, {"a": 5, "b": "y"}, {"a": 9, "b": "z!"}],
+    )
 
 
 def test_flatten():
@@ -529,4 +567,7 @@ def test_py_object_wrapper_unhashable_payload():
     w1 = pw.PyObjectWrapper({"a": 1})
     w2 = pw.PyObjectWrapper({"a": 1})
     assert w1 == w2 and hash(w1) == hash(w2)
-    assert hash(pw.PyObjectWrapper([1, 2])) != hash(pw.PyObjectWrapper([2, 1]))
+    # hash/eq contract survives equal-but-serialize-differently payloads
+    assert pw.PyObjectWrapper({True: 1}) == pw.PyObjectWrapper({1: 1})
+    assert hash(pw.PyObjectWrapper({True: 1})) == hash(pw.PyObjectWrapper({1: 1}))
+    assert pw.PyObjectWrapper([1, 2]) != pw.PyObjectWrapper([2, 1])
