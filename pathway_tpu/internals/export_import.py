@@ -108,6 +108,7 @@ def export_table(table: Table) -> ExportedTable:
             name="export",
         )
     )
+    handle._graph = G.engine_graph  # same-graph import guard
     with _handles_lock:
         _open_handles.append(handle)
     return handle
@@ -125,6 +126,15 @@ def import_table(
         {n: handle.dtypes.get(n, Any) for n in handle.column_names},
         name="Imported",
     )
+
+    if getattr(handle, "_graph", None) is G.engine_graph:
+        # same-graph import would deadlock: the import source waits for the
+        # handle to close, which happens only when THIS run ends
+        raise ValueError(
+            "import_table: the handle was exported from the CURRENT graph; "
+            "run the exporting graph first (or pw.reset() to start the "
+            "importing graph), as with the reference's separate scopes"
+        )
 
     def runner(writer) -> None:
         pos = 0

@@ -192,7 +192,31 @@ class _Parser:
                 right = _align_columns(left, self.parse_select(), "EXCEPT")
                 left = _distinct(left).difference(_distinct(right))
             else:
-                return left
+                break
+        # ORDER BY / LIMIT / OFFSET bind to the whole (possibly set-op
+        # combined) query result, per standard SQL
+        order_items: List[Tuple[Any, bool]] = []
+        limit_n: Optional[int] = None
+        offset_n = 0
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                key_fn = self.parse_expr_lazy()
+                desc = False
+                if self.accept("kw", "desc"):
+                    desc = True
+                else:
+                    self.accept("kw", "asc")
+                order_items.append((key_fn, desc))
+                if not self.accept("op", ","):
+                    break
+        if self.accept("kw", "limit"):
+            limit_n = int(self.expect("num"))
+        if self.accept("kw", "offset"):
+            offset_n = int(self.expect("num"))
+        if order_items or limit_n is not None or offset_n:
+            left = _order_limit(left, order_items, limit_n, offset_n)
+        return left
 
     def parse_select(self) -> Table:
         # aggregate registry is PER SELECT: a subquery's aggregates must not
@@ -251,29 +275,9 @@ class _Parser:
                 having_fn = self.parse_expr_lazy()
             finally:
                 self.in_having = False
-        order_items: List[Tuple[Any, bool]] = []
-        limit_n: Optional[int] = None
-        offset_n: int = 0
-        if self.accept("kw", "order"):
-            self.expect("kw", "by")
-            while True:
-                key_fn = self.parse_expr_lazy()
-                desc = False
-                if self.accept("kw", "desc"):
-                    desc = True
-                else:
-                    self.accept("kw", "asc")
-                order_items.append((key_fn, desc))
-                if not self.accept("op", ","):
-                    break
-        if self.accept("kw", "limit"):
-            limit_n = int(self.expect("num"))
-        if self.accept("kw", "offset"):
-            offset_n = int(self.expect("num"))
-
         # scalars registered by SELECT/WHERE were cross-joined above; any
-        # still pending came from GROUP BY/HAVING/ORDER BY, where they have
-        # no application point
+        # still pending came from GROUP BY/HAVING, where they have no
+        # application point
         if self.pending_scalars:
             raise NotImplementedError(
                 "SQL: scalar subqueries are supported in the SELECT list and "
@@ -287,8 +291,6 @@ class _Parser:
             )
 
         def finish(result: Table) -> Table:
-            if order_items or limit_n is not None or offset_n:
-                result = _order_limit(result, order_items, limit_n, offset_n)
             return result
 
         if group_exprs or self._has_aggregates(projections):
@@ -311,13 +313,16 @@ class _Parser:
                     result = result.select(**{n: result[n] for n in visible})
             return finish(result)
 
-        # plain projection
+        # plain projection (bare * must not leak internal _sq scalar cols)
+        visible_cols = [n for n in table.column_names if not n.startswith("_sq")]
         if len(projections) == 1 and projections[0][2]:
+            if len(visible_cols) != len(table.column_names):
+                table = table.select(**{n: table[n] for n in visible_cols})
             return finish(table)
         out_kwargs = {}
         for i, (alias, expr_fn, is_star) in enumerate(projections):
             if is_star:
-                for n in table.column_names:
+                for n in visible_cols:
                     out_kwargs[n] = table[n]
                 continue
             expr = expr_fn(table)

@@ -15,8 +15,10 @@ shapes); the TPU-idiomatic redesign is IVF:
   — all inside one jitted function.
 
 Scoring FLOPs drop from B·N·d to B·(C + n_probe·M)·d: with the default
-C≈sqrt(N)·2 and n_probe=C/10 the shortlist is ~N/5 of the matrix at ≥0.95
-recall@10 on clustered embeddings (tests/test_ivf.py).  The exact
+C≈8·sqrt(N) and the probe fraction from ``_default_probe`` the shortlist is
+~N/5 for small corpora, tapering to a bounded ~16k rows (≈1.6% of 1M) so
+the per-query [B, n_probe·M, d] rescore gather stays HBM-friendly; ≥0.95
+recall@10 on real text embeddings (tests/test_ivf.py).  The exact
 DeviceKnnIndex stays the default below ~1M rows where brute force already
 meets the latency budget on the MXU.
 """
@@ -178,11 +180,17 @@ class IvfKnnIndex:
                 return
             keys = list(self._rows.keys())
             data = np.stack([self._rows[k] for k in keys])
+            # more, smaller clusters as N grows: the serving-path shortlist
+            # gather materializes [B, n_probe*M, d], so n_probe*M must stay
+            # bounded (~16k rows) — with C ~ 8*sqrt(N) and the probe
+            # fraction from _default_probe the shortlist is ~N/5 for small
+            # corpora and caps at ~1.6% of 1M (where brute force over the
+            # full matrix would be 20 GB of gather at B=64)
             C = self.n_clusters or int(
-                np.clip(2 * np.sqrt(n), 16, 65536)
+                np.clip(8 * np.sqrt(n), 16, 65536)
             )
             rng = np.random.default_rng(self.seed)
-            sample_n = min(n, self.train_sample)
+            sample_n = min(n, max(self.train_sample, 8 * C))
             C = min(C, n, sample_n)
             sample = data[rng.choice(n, size=sample_n, replace=False)]
             self._centroids = _kmeans(sample, C, self.kmeans_iters, self.seed)
@@ -193,11 +201,31 @@ class IvfKnnIndex:
             # rows competing for one cluster are ranked by sort position and
             # the first (cap - fill) win; losers retry at the next rank.
             cap = max(1, int(np.ceil(2.0 * n / C)))
-            scores = np.asarray(
-                jnp.dot(jnp.asarray(data), jnp.asarray(self._centroids.T))
-            )
             n_pref = min(8, C)
-            order = np.argsort(-scores, axis=1)[:, :n_pref]
+            # per-row top centroids computed ON DEVICE, fetched as [N, 8]
+            # indices — the full [N, C] score matrix is 8 GB at 1M x 2000
+            # and must never cross the host link
+            cents_dev = jnp.asarray(self._centroids.T)
+
+            @jax.jit
+            def _prefs(chunk_rows):
+                s = jnp.dot(
+                    chunk_rows, cents_dev, preferred_element_type=jnp.float32
+                )
+                _, idx = jax.lax.top_k(s, n_pref)
+                return idx
+
+            parts = []
+            step = 131072
+            for start in range(0, n, step):
+                chunk = data[start : start + step]
+                if chunk.shape[0] < step and n > step:
+                    pad = np.zeros((step - chunk.shape[0], data.shape[1]), data.dtype)
+                    got = np.asarray(_prefs(jnp.asarray(np.concatenate([chunk, pad]))))
+                    parts.append(got[: chunk.shape[0]])
+                else:
+                    parts.append(np.asarray(_prefs(jnp.asarray(chunk))))
+            order = np.concatenate(parts) if len(parts) > 1 else parts[0]
             counts = np.zeros(C, np.int64)
             assignment = np.full(n, -1, np.int64)
             unassigned = np.arange(n)
@@ -235,6 +263,15 @@ class IvfKnnIndex:
             self._built_n = n
             self._search_fns.clear()
 
+    def _default_probe(self) -> int:
+        """Probe count bounding the rescore shortlist: ~10% of clusters for
+        small corpora, tapering so n_probe*M (the gathered candidate rows
+        per query) stays ≈ min(N/5, 16k)."""
+        C = self._centroids.shape[0]
+        n = max(self._built_n, 1)
+        frac = min(0.1, 8192.0 / n)
+        return max(1, min(C, int(np.ceil(C * frac))))
+
     # -- search ------------------------------------------------------------
     def search(
         self, queries: np.ndarray, k: int, n_probe: Optional[int] = None
@@ -250,7 +287,7 @@ class IvfKnnIndex:
                 norms = np.linalg.norm(queries, axis=1, keepdims=True)
                 queries = queries / np.where(norms == 0, 1.0, norms)
             C = self._centroids.shape[0]
-            p = n_probe or self.n_probe or max(1, int(np.ceil(C / 10)))
+            p = n_probe or self.n_probe or self._default_probe()
             p = min(p, C)
             b = _bucket(nq)
             if b > nq:
@@ -390,6 +427,6 @@ class IvfKnnIndex:
             return 1.0
         C = self._centroids.shape[0]
         M = self._members.shape[1]
-        p = self.n_probe or max(1, int(np.ceil(C / 10)))
+        p = self.n_probe or self._default_probe()
         n = self._matrix.shape[0]
         return (C + min(p, C) * M + len(self._tail)) / max(n, 1)

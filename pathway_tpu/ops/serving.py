@@ -25,9 +25,12 @@ __all__ = ["FusedEncodeSearch"]
 
 
 class FusedEncodeSearch:
-    """Callable serving path over a ``SentenceEncoder`` + ``DeviceKnnIndex``.
+    """Callable serving path over a ``SentenceEncoder`` plus either a
+    ``DeviceKnnIndex`` (exact) or an ``IvfKnnIndex`` (approximate): encode,
+    score — full matmul or centroid-probe + shortlist rescore — and top-k
+    compile into ONE dispatch either way.
 
-    Recompiles per (batch bucket, sequence length, k, index capacity) —
+    Recompiles per (batch bucket, sequence length, k, index shape) —
     a handful of shapes in steady state; index *content* changes (add/
     remove) never recompile."""
 
@@ -36,7 +39,9 @@ class FusedEncodeSearch:
         self.index = index
         self.k = k
         self._lock = threading.Lock()
-        self._fns: Dict[Tuple[int, int, int, int], Any] = {}
+        self._fns: Dict[Tuple, Any] = {}
+        # IVF indexes lack device key planes; winners map slot->key on host
+        self._ivf = hasattr(index, "_centroids")
 
     def _compiled(self, B: int, L: int, k: int, capacity: int):
         key = (B, L, k, capacity)
@@ -84,6 +89,127 @@ class FusedEncodeSearch:
         self._fns[key] = fused
         return fused
 
+    def _compiled_ivf(self, B: int, L: int, k: int):
+        """Returns (fused_fn, k_main) — the kernel's output is [B, 2*k_main]
+        (k_main score bit-patterns, then k_main slots)."""
+        index = self.index
+        module = self.encoder.module
+        normalize = index.metric == "cos"
+        M = index._members.shape[1]
+        C = index._centroids.shape[0]
+        p = index.n_probe or index._default_probe()
+        p = min(p, C)
+        k_main = min(k, p * M)
+        shape_key = (
+            "ivf", B, L, k, p,
+            index._matrix.shape[0],
+            C,
+            M,
+        )
+        fn = self._fns.get(shape_key)
+        if fn is not None:
+            return fn, k_main
+
+        @jax.jit
+        def fused(params, ids, mask, matrix, valid, centroids, members):
+            z = module.apply({"params": params}, ids, mask)
+            z = z.astype(jnp.float32)
+            if normalize:
+                z = z / jnp.maximum(
+                    jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
+                )
+            cscores = jnp.dot(
+                z.astype(centroids.dtype), centroids.T,
+                preferred_element_type=jnp.float32,
+            )
+            _, probe = jax.lax.top_k(cscores, p)
+            cand = members[probe].reshape(B, p * M)
+            safe = jnp.maximum(cand, 0)
+            rows = matrix[safe]  # [B, L, d] shortlist gather
+            scores = jnp.einsum(
+                "bld,bd->bl",
+                rows.astype(jnp.float32),
+                z,
+                preferred_element_type=jnp.float32,
+            )
+            ok = (cand >= 0) & valid[safe]
+            scores = jnp.where(ok, scores, -jnp.inf)
+            s, i = jax.lax.top_k(scores, k_main)
+            slots = jnp.where(
+                jnp.isfinite(s), jnp.take_along_axis(cand, i, axis=1), -1
+            )
+            s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
+            return jnp.concatenate([s_bits, slots], axis=1)
+
+        self._fns[shape_key] = fused
+        return fused, k_main
+
+    def _submit_ivf(self, texts: Sequence[str], k: int):
+        """IVF flavor of submit (holds both locks): encode + centroid probe
+        + shortlist rescore + top-k in one dispatch; winners come back as
+        built-index SLOTS and map to keys on host (O(B*k))."""
+        index = self.index
+        if index._needs_rebuild():
+            index.build()
+        if len(index) == 0 or index._matrix is None:
+            empty: List[List[Tuple[int, float]]] = [[] for _ in texts]
+            return lambda: empty
+        if index._tail:
+            # unbuilt recent rows would be invisible to the fused probe;
+            # fold them in before serving (as-of-now contract)
+            index.build()
+        k_eff = min(k, len(index))
+        ids, mask = self.encoder.tokenizer.encode_batch(texts)
+        ids = np.asarray(ids)
+        mask = np.asarray(mask)
+        n_real = ids.shape[0]
+        b = _bucket(n_real)
+        if b > n_real:
+            ids = np.concatenate(
+                [ids, np.zeros((b - n_real, ids.shape[1]), ids.dtype)]
+            )
+            mask = np.concatenate(
+                [mask, np.zeros((b - n_real, mask.shape[1]), mask.dtype)]
+            )
+        fn, k_main = self._compiled_ivf(ids.shape[0], ids.shape[1], k_eff)
+        out = fn(
+            self.encoder.params,
+            ids,
+            mask,
+            index._matrix,
+            index._valid,
+            index._centroids
+            if isinstance(index._centroids, jax.Array)
+            else jnp.asarray(index._centroids),
+            index._members,
+        )
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        built_keys = index._built_keys  # rebuilds REPLACE the list (no mutation)
+        live = index._rows
+
+        def complete() -> List[List[Tuple[int, float]]]:
+            arr = np.asarray(out)[:n_real]
+            # the kernel emits 2*k_main columns (k_main <= k_eff when the
+            # probed shortlist is smaller than the requested k)
+            scores = np.ascontiguousarray(arr[:, :k_main]).view(np.float32)
+            slots = arr[:, k_main:]
+            results: List[List[Tuple[int, float]]] = []
+            for qi in range(len(texts)):
+                row: List[Tuple[int, float]] = []
+                for j in range(slots.shape[1]):
+                    s = float(scores[qi, j])
+                    slot = int(slots[qi, j])
+                    if not np.isfinite(s) or slot < 0:
+                        continue
+                    key = built_keys[slot]
+                    if key in live:
+                        row.append((key, s))
+                results.append(row[:k])
+            return results
+
+        return complete
+
     def submit(self, texts: Sequence[str], k: Optional[int] = None):
         """Dispatch one serve batch WITHOUT waiting for the result; returns a
         zero-arg callable that completes it (blocking on the async host
@@ -92,6 +218,11 @@ class FusedEncodeSearch:
         of one host RTT per call."""
         k = k or self.k
         index = self.index
+        if self._ivf:
+            with index._lock, self._lock:
+                if not texts:
+                    return lambda: []
+                return self._submit_ivf(texts, k)
         with index._lock, self._lock:
             n_items = len(index.key_to_slot)
             if not texts:

@@ -1,0 +1,610 @@
+"""Protocol-level tests for the client-gated transport connectors
+(VERDICT r3 #5: every gated module exercised without the real service, the
+way the reference tests its readers/writers in tests/integration/).  Fake
+client libraries are injected into sys.modules (or monkeypatched onto real
+ones); each test drives a full pw pipeline through the connector's
+parse/offset/commit logic."""
+
+from __future__ import annotations
+
+import json
+import sys
+import types
+
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import assert_rows
+
+
+class KV(pw.Schema):
+    k: str = pw.column_definition(primary_key=True)
+    v: int
+
+
+def _collect(table):
+    rows = []
+
+    def on_change(key, row, time, is_addition):
+        rows.append((tuple(row[c] for c in table.column_names), is_addition))
+
+    pw.io.subscribe(table, on_change=on_change)
+    return rows
+
+
+def _run():
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+
+
+def _module(name: str, **attrs) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    return mod
+
+
+# ---------------------------------------------------------------- kafka
+
+
+def test_kafka_read_json(monkeypatch):
+    class Msg:
+        def __init__(self, value):
+            self.value = value
+
+    class FakeConsumer:
+        def __init__(self, topic, **kw):
+            assert topic == "events"
+            assert kw["bootstrap_servers"] == "broker:9092"
+            self._msgs = [
+                Msg(json.dumps({"k": "a", "v": 1}).encode()),
+                Msg(b"not json"),  # malformed messages are skipped
+                Msg(json.dumps({"k": "b", "v": 2}).encode()),
+            ]
+
+        def __iter__(self):
+            return iter(self._msgs)
+
+    monkeypatch.setitem(
+        sys.modules, "kafka", _module("kafka", KafkaConsumer=FakeConsumer)
+    )
+    t = pw.io.kafka.read(
+        {"bootstrap.servers": "broker:9092", "group.id": "g"},
+        "events",
+        schema=KV,
+        format="json",
+    )
+    counts = t.groupby().reduce(total=pw.reducers.sum(t.v))
+    _run()
+    assert_rows(counts, [{"total": 3}])
+
+
+def test_kafka_write_produces_update_stream(monkeypatch):
+    sent = []
+
+    class FakeProducer:
+        def __init__(self, **kw):
+            assert kw["bootstrap_servers"] == "broker:9092"
+
+        def send(self, topic, payload):
+            sent.append((topic, json.loads(payload)))
+
+        def flush(self):
+            sent.append(("flush", None))
+
+    monkeypatch.setitem(
+        sys.modules, "kafka", _module("kafka", KafkaProducer=FakeProducer)
+    )
+    t = pw.debug.table_from_rows(KV, [("a", 1), ("b", 2)])
+    pw.io.kafka.write(
+        t, {"bootstrap.servers": "broker:9092"}, topic_name="out"
+    )
+    _run()
+    payloads = [p for topic, p in sent if topic == "out"]
+    assert sorted((p["k"], p["v"], p["diff"]) for p in payloads) == [
+        ("a", 1, 1),
+        ("b", 2, 1),
+    ]
+    assert all("time" in p for p in payloads)
+    assert ("flush", None) in sent  # per-tick flush
+
+
+def test_debezium_over_fake_kafka(monkeypatch):
+    envelopes = [
+        {"payload": {"op": "c", "after": {"k": "a", "v": 1}}},
+        {"payload": {"op": "c", "after": {"k": "b", "v": 2}}},
+        {"payload": {"op": "u", "before": {"k": "a", "v": 1},
+                     "after": {"k": "a", "v": 9}}},
+        {"payload": {"op": "d", "before": {"k": "b", "v": 2}}},
+    ]
+
+    class Msg:
+        def __init__(self, value):
+            self.value = value
+
+    class FakeConsumer:
+        def __init__(self, topic, **kw):
+            self._msgs = [Msg(json.dumps(e).encode()) for e in envelopes]
+
+        def __iter__(self):
+            return iter(self._msgs)
+
+    monkeypatch.setitem(
+        sys.modules, "kafka", _module("kafka", KafkaConsumer=FakeConsumer)
+    )
+
+    class Row(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.debezium.read(
+        {"bootstrap.servers": "b:9092"}, "cdc", schema=Row
+    )
+    _run()
+    assert_rows(t, [{"k": "a", "v": 9}])
+
+
+# ---------------------------------------------------------------- s3
+
+
+def test_s3_read_csv_with_etag_offsets(monkeypatch):
+    downloads = []
+
+    class FakePaginator:
+        def paginate(self, Bucket, Prefix):
+            assert Bucket == "bkt" and Prefix == "data/"
+            return [
+                {
+                    "Contents": [
+                        {"Key": "data/part0.csv", "ETag": "e0"},
+                        {"Key": "data/part1.csv", "ETag": "e1"},
+                    ]
+                }
+            ]
+
+    class FakeClient:
+        def get_paginator(self, op):
+            assert op == "list_objects_v2"
+            return FakePaginator()
+
+        def download_file(self, bucket, key, local):
+            downloads.append(key)
+            body = {
+                "data/part0.csv": "k,v\na,1\n",
+                "data/part1.csv": "k,v\nb,2\n",
+            }[key]
+            with open(local, "w") as f:
+                f.write(body)
+
+    fake_boto3 = _module("boto3", client=lambda svc, **kw: FakeClient())
+    monkeypatch.setitem(sys.modules, "boto3", fake_boto3)
+
+    t = pw.io.s3.read(
+        "s3://bkt/data/", format="csv", schema=KV, mode="static"
+    )
+    _run()
+    assert_rows(t, [{"k": "a", "v": 1}, {"k": "b", "v": 2}])
+    assert sorted(downloads) == ["data/part0.csv", "data/part1.csv"]
+
+
+# ---------------------------------------------------------------- deltalake
+
+
+def test_deltalake_read_and_write(monkeypatch, tmp_path):
+    class FakeDeltaTable:
+        def __init__(self, uri):
+            assert uri == "dl://tbl"
+
+        def version(self):
+            return 0
+
+        def to_pyarrow_table(self):
+            class _T:
+                def to_pylist(self):
+                    return [{"k": "a", "v": 1}, {"k": "b", "v": 2}]
+
+            return _T()
+
+    written = []
+
+    def fake_write_deltalake(uri, batch, mode):
+        written.append((uri, mode, batch.to_pylist()))
+
+    monkeypatch.setitem(
+        sys.modules,
+        "deltalake",
+        _module(
+            "deltalake",
+            DeltaTable=FakeDeltaTable,
+            write_deltalake=fake_write_deltalake,
+        ),
+    )
+    t = pw.io.deltalake.read("dl://tbl", schema=KV, mode="static")
+    pw.io.deltalake.write(t, "dl://out")
+    _run()
+    assert_rows(t, [{"k": "a", "v": 1}, {"k": "b", "v": 2}])
+    rows = [r for _uri, _mode, batch in written for r in batch]
+    assert sorted((r["k"], r["v"], r["diff"]) for r in rows) == [
+        ("a", 1, 1),
+        ("b", 2, 1),
+    ]
+
+
+# ---------------------------------------------------------------- bigquery
+
+
+def test_bigquery_write_batches(monkeypatch):
+    inserted = []
+
+    class FakeClient:
+        project = "proj"
+
+        def insert_rows_json(self, table_ref, batch):
+            inserted.append((table_ref, list(batch)))
+            return []  # no per-row errors
+
+    import google.cloud.bigquery as bq
+
+    monkeypatch.setattr(bq, "Client", lambda: FakeClient())
+    t = pw.debug.table_from_rows(KV, [("a", 1), ("b", 2)])
+    pw.io.bigquery.write(t, "ds", "tbl")
+    _run()
+    assert inserted and inserted[0][0] == "proj.ds.tbl"
+    rows = [r for _ref, batch in inserted for r in batch]
+    assert sorted((r["k"], r["v"], r["diff"]) for r in rows) == [
+        ("a", 1, 1),
+        ("b", 2, 1),
+    ]
+
+
+# ---------------------------------------------------------------- postgres
+
+
+class _FakePgCursor:
+    def __init__(self, log):
+        self.log = log
+
+    def execute(self, sql, params):
+        self.log.append((" ".join(sql.split()), list(params)))
+
+
+class _FakePgConn:
+    def __init__(self, log):
+        self.log = log
+        self.commits = 0
+
+    def cursor(self):
+        return _FakePgCursor(self.log)
+
+    def commit(self):
+        self.commits += 1
+
+    def close(self):
+        self.log.append(("CLOSE", []))
+
+
+def test_postgres_write_updates(monkeypatch):
+    log = []
+    conns = []
+
+    def connect(**settings):
+        assert settings == {"host": "h", "dbname": "d"}
+        conn = _FakePgConn(log)
+        conns.append(conn)
+        return conn
+
+    monkeypatch.setitem(
+        sys.modules, "psycopg2", _module("psycopg2", connect=connect)
+    )
+    t = pw.debug.table_from_rows(KV, [("a", 1)])
+    pw.io.postgres.write(t, {"host": "h", "dbname": "d"}, "events")
+    _run()
+    inserts = [(sql, p) for sql, p in log if sql.startswith("INSERT")]
+    assert len(inserts) == 1
+    sql, params = inserts[0]
+    assert "INSERT INTO events (k, v, time, diff)" in sql
+    assert params[:2] == ["a", 1] and params[3] == 1
+    assert conns[0].commits >= 1
+
+
+def test_postgres_write_snapshot_upsert_delete(monkeypatch):
+    log = []
+
+    def connect(**settings):
+        return _FakePgConn(log)
+
+    monkeypatch.setitem(
+        sys.modules, "psycopg2", _module("psycopg2", connect=connect)
+    )
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time
+
+            self.next(k="a", v=1)
+            time.sleep(0.3)
+            self.next(k="a", v=2)  # upsert: retract + insert
+
+    t = pw.io.python.read(Subj(), schema=KV)
+    pw.io.postgres.write_snapshot(t, {}, "snap", primary_key=["k"])
+    _run()
+    sqls = [sql for sql, _p in log]
+    assert any("ON CONFLICT (k) DO UPDATE" in s for s in sqls)
+    assert any(s.startswith("DELETE FROM snap WHERE k = ") for s in sqls)
+
+
+# ---------------------------------------------------------------- mongodb
+
+
+def test_mongodb_write(monkeypatch):
+    inserted = []
+
+    class FakeCollection:
+        def insert_many(self, docs):
+            inserted.extend(docs)
+
+    class FakeDb(dict):
+        def __getitem__(self, name):
+            return FakeCollection()
+
+    class FakeMongoClient:
+        def __init__(self, conn_str):
+            assert conn_str == "mongodb://h"
+
+        def __getitem__(self, name):
+            assert name == "db"
+            return FakeDb()
+
+    monkeypatch.setitem(
+        sys.modules, "pymongo", _module("pymongo", MongoClient=FakeMongoClient)
+    )
+    t = pw.debug.table_from_rows(KV, [("a", 1), ("b", 2)])
+    pw.io.mongodb.write(t, "mongodb://h", "db", "coll")
+    _run()
+    assert sorted((d["k"], d["v"], d["diff"]) for d in inserted) == [
+        ("a", 1, 1),
+        ("b", 2, 1),
+    ]
+    assert all(d["_pw_key"] for d in inserted)
+
+
+# ---------------------------------------------------------------- nats
+
+
+def test_nats_read_and_write(monkeypatch):
+    published = []
+
+    class FakeSub:
+        def __init__(self, msgs):
+            self._msgs = msgs
+
+        @property
+        def messages(self):
+            msgs = list(self._msgs)
+
+            class _It:
+                def __aiter__(self):
+                    return self
+
+                async def __anext__(self):
+                    if not msgs:
+                        raise StopAsyncIteration
+                    return msgs.pop(0)
+
+            return _It()
+
+    class FakeMsg:
+        def __init__(self, data):
+            self.data = data
+
+    class FakeNc:
+        async def subscribe(self, topic):
+            assert topic == "events"
+            return FakeSub(
+                [
+                    FakeMsg(json.dumps({"k": "a", "v": 1}).encode()),
+                    FakeMsg(json.dumps({"k": "b", "v": 2}).encode()),
+                ]
+            )
+
+        async def publish(self, topic, payload):
+            published.append((topic, json.loads(payload)))
+
+    async def fake_connect(uri):
+        assert uri == "nats://h:4222"
+        return FakeNc()
+
+    monkeypatch.setitem(
+        sys.modules, "nats", _module("nats", connect=fake_connect)
+    )
+    t = pw.io.nats.read("nats://h:4222", "events", schema=KV)
+    pw.io.nats.write(t, "nats://h:4222", "out")
+    _run()
+    assert_rows(t, [{"k": "a", "v": 1}, {"k": "b", "v": 2}])
+    assert sorted((p["k"], p["v"]) for _t, p in published) == [
+        ("a", 1),
+        ("b", 2),
+    ]
+
+
+# ---------------------------------------------------------------- pubsub
+
+
+def test_pubsub_write_with_injected_publisher():
+    published = []
+
+    class FakeFuture:
+        def result(self):
+            return "msgid"
+
+    class FakePublisher:
+        def topic_path(self, project, topic):
+            return f"projects/{project}/topics/{topic}"
+
+        def publish(self, path, payload, **attrs):
+            published.append((path, json.loads(payload), attrs))
+            return FakeFuture()
+
+    t = pw.debug.table_from_rows(KV, [("a", 1)])
+    pw.io.pubsub.write(t, FakePublisher(), "proj", "topic")
+    _run()
+    assert published[0][0] == "projects/proj/topics/topic"
+    assert published[0][1] == {"k": "a", "v": 1}
+    assert published[0][2]["diff"] == "1"
+
+
+# ---------------------------------------------------------------- gdrive
+
+
+def test_gdrive_read(monkeypatch, tmp_path):
+    class FakeFiles:
+        def list(self, q, fields):
+            assert "'folder123' in parents" in q
+
+            class _Exec:
+                def execute(self):
+                    return {
+                        "files": [
+                            {"id": "f1", "name": "a.txt", "modifiedTime": "t1"},
+                            {"id": "f2", "name": "b.txt", "modifiedTime": "t2"},
+                        ]
+                    }
+
+            return _Exec()
+
+        def get_media(self, fileId):
+            class _Exec:
+                def execute(self_inner):
+                    return f"contents of {fileId}".encode()
+
+            return _Exec()
+
+    class FakeService:
+        def files(self):
+            return FakeFiles()
+
+    class FakeCreds:
+        @classmethod
+        def from_service_account_file(cls, path, scopes):
+            return cls()
+
+    creds_file = tmp_path / "creds.json"
+    creds_file.write_text("{}")
+    monkeypatch.setitem(
+        sys.modules, "googleapiclient", _module("googleapiclient")
+    )
+    monkeypatch.setitem(
+        sys.modules,
+        "googleapiclient.discovery",
+        _module(
+            "googleapiclient.discovery",
+            build=lambda api, ver, credentials: FakeService(),
+        ),
+    )
+    monkeypatch.setitem(
+        sys.modules,
+        "google.oauth2.service_account",
+        _module("google.oauth2.service_account", Credentials=FakeCreds),
+    )
+    t = pw.io.gdrive.read(
+        "folder123",
+        mode="static",
+        service_user_credentials_file=str(creds_file),
+    )
+    rows = _collect(t)
+    _run()
+    assert sorted(r[0] for r, add in rows if add) == [
+        b"contents of f1",
+        b"contents of f2",
+    ]
+
+
+# ---------------------------------------------------------------- slack
+
+
+def test_slack_send_alerts(monkeypatch):
+    posted = []
+
+    class FakeResp:
+        def raise_for_status(self):
+            pass
+
+    def fake_post(url, json=None, headers=None):
+        posted.append((url, json, headers))
+        return FakeResp()
+
+    import requests
+
+    monkeypatch.setattr(requests, "post", fake_post)
+
+    class Alert(pw.Schema):
+        message: str
+
+    t = pw.debug.table_from_rows(Alert, [("disk full",)])
+    pw.io.slack.send_alerts(t, "C0CHAN", "xoxb-token")
+    _run()
+    assert posted[0][0].endswith("chat.postMessage")
+    assert posted[0][1] == {"channel": "C0CHAN", "text": "disk full"}
+    assert posted[0][2]["Authorization"] == "Bearer xoxb-token"
+
+
+# ---------------------------------------------------------------- logstash
+
+
+def test_logstash_write(monkeypatch):
+    posted = []
+
+    class FakeResp:
+        def raise_for_status(self):
+            pass
+
+    class FakeSession:
+        def post(self, endpoint, data=None, headers=None):
+            posted.append((endpoint, json.loads(data)))
+            return FakeResp()
+
+    import requests
+
+    monkeypatch.setattr(requests, "Session", FakeSession)
+    t = pw.debug.table_from_rows(KV, [("a", 1)])
+    pw.io.logstash.write(t, "http://ls:8080")
+    _run()
+    assert posted[0][0] == "http://ls:8080"
+    assert posted[0][1]["k"] == "a" and posted[0][1]["diff"] == 1
+
+
+# ---------------------------------------------------------------- elasticsearch
+
+
+def test_elasticsearch_write_bulk(monkeypatch):
+    bulks = []
+
+    class FakeResp:
+        def raise_for_status(self):
+            pass
+
+        def json(self):
+            return {"errors": False, "items": []}
+
+    class FakeSession:
+        headers: dict = {}
+
+        def __init__(self):
+            self.headers = {}
+
+        def post(self, url, data=None, headers=None):
+            bulks.append((url, data))
+            return FakeResp()
+
+    import requests
+
+    monkeypatch.setattr(requests, "Session", FakeSession)
+    t = pw.debug.table_from_rows(KV, [("a", 1), ("b", 2)])
+    pw.io.elasticsearch.write(t, "http://es:9200", index_name="idx")
+    _run()
+    assert bulks and bulks[0][0] == "http://es:9200/_bulk"
+    lines = [json.loads(line) for line in bulks[0][1].strip().splitlines()]
+    ops = [line for line in lines if "index" in line]
+    docs = [line for line in lines if "k" in line]
+    assert len(ops) == 2 and all(op["index"]["_index"] == "idx" for op in ops)
+    assert sorted((d["k"], d["v"]) for d in docs) == [("a", 1), ("b", 2)]

@@ -1,0 +1,54 @@
+"""Live visualization — the bokeh/panel capability rebuilt dependency-free
+(reference: python/pathway/stdlib/viz/; VERDICT r3 Missing #6)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pathway_tpu as pw
+
+
+def test_live_plot_streams_updates():
+    class Row(pw.Schema):
+        t: int = pw.column_definition(primary_key=True)
+        v: float
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(5):
+                self.next(t=i, v=float(i * i))
+                time.sleep(0.3)
+
+    src = pw.io.python.read(Subj(), schema=Row)
+    server = pw.viz.live_plot(src, x="t", y="v")
+    done = threading.Event()
+
+    def run():
+        pw.run(monitoring_level=None, commit_duration_ms=50)
+        done.set()
+
+    threading.Thread(target=run).start()
+    # the dashboard must show a PARTIAL state mid-run (live, not post-hoc)
+    mid = None
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        snap = json.loads(
+            urllib.request.urlopen(server.url + "data", timeout=5).read()
+        )
+        if 0 < len(snap["rows"]) < 5:
+            mid = snap
+            break
+        time.sleep(0.05)
+    page = urllib.request.urlopen(server.url, timeout=5).read().decode()
+    assert "<svg" in page and "fetch(\"/data\")" in page
+    assert done.wait(20)
+    final = json.loads(
+        urllib.request.urlopen(server.url + "data", timeout=5).read()
+    )
+    server.close()
+    assert mid is not None, "never observed a partial live snapshot"
+    assert sorted(r["v"] for r in final["rows"]) == [0.0, 1.0, 4.0, 9.0, 16.0]
+    assert mid["time"] > 0
