@@ -249,11 +249,17 @@ class RestServerSubject:
 
 
 class _ResponseWriter:
-    def __init__(self, subject: RestServerSubject):
+    def __init__(self, subject: Optional[RestServerSubject]):
+        # subject is None on non-frontend cluster ranks: the subscriber edge
+        # gathers response rows to rank 0, so only rank 0 resolves futures —
+        # but every rank must register the SAME operator (SPMD graph shape)
         self.subject = subject
 
     def __call__(self, response_table: Table) -> None:
         names = response_table.column_names
+        if self.subject is None:
+            subscribe(response_table, on_change=None)
+            return
 
         def on_change(key, row, time, is_addition):
             if not is_addition:
@@ -284,37 +290,58 @@ def rest_connector(
     documentation: Optional[EndpointDocumentation] = None,
 ) -> Tuple[Table, Any]:
     """Expose a REST endpoint as a (queries_table, response_writer) pair
-    (reference: io/http/_server.py:624)."""
-    if webserver is None:
-        webserver = PathwayWebserver(host=host or "0.0.0.0", port=port or 8080)
+    (reference: io/http/_server.py:624).
+
+    Multi-process runs: rank 0 binds the HTTP frontend; incoming query rows
+    BROADCAST to every rank (source dist_mode="broadcast"), so replicated
+    pipelines — including device-mesh retrieval, whose jit calls must stay
+    SPMD across processes — serve the query on the whole cluster, and the
+    response stream gathers back to rank 0 where the HTTP futures resolve."""
     if schema is None:
         schema = schema_from_types(query=str)
     if keep_queries is not None:
         delete_completed_queries = not keep_queries
 
-    # sequential keys: each request row is unique
-    import types
+    from ...parallel.distributed import topology_from_env
 
-    plain_schema_cols = {
-        name: col for name, col in schema.columns().items()
-    }
-    subject = RestServerSubject(
-        webserver,
-        route,
-        methods,
-        schema,
-        delete_completed_queries,
-        request_validator,
-        documentation,
-    )
-
+    processes, pid, _addr = topology_from_env()
+    frontend = processes <= 1 or pid == 0
     stop_event = threading.Event()
 
-    def runner(writer: SessionWriter):
-        subject.attach_writer(writer)
-        # keep the session open for the lifetime of the run
-        stop_event.wait()
+    if frontend:
+        if webserver is None:
+            webserver = PathwayWebserver(
+                host=host or "0.0.0.0", port=port or 8080
+            )
+        subject = RestServerSubject(
+            webserver,
+            route,
+            methods,
+            schema,
+            delete_completed_queries,
+            request_validator,
+            documentation,
+        )
+
+        def runner(writer: SessionWriter):
+            subject.attach_writer(writer)
+            # keep the session open for the lifetime of the run
+            stop_event.wait()
+
+    else:
+        # non-frontend rank: same graph shape (source + subscriber must line
+        # up across SPMD replicas), no socket; rows arrive via the broadcast
+        subject = None
+
+        def runner(writer: SessionWriter):
+            stop_event.wait()
 
     G.post_run_hooks.append(stop_event.set)
-    table = register_source(schema, runner, mode="streaming", name=f"rest{route.replace('/', '_')}")
+    table = register_source(
+        schema,
+        runner,
+        mode="streaming",
+        name=f"rest{route.replace('/', '_')}",
+        dist_mode="broadcast",
+    )
     return table, _ResponseWriter(subject)

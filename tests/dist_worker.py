@@ -177,11 +177,64 @@ def scenario_live_stream() -> dict:
     }
 
 
+def scenario_rest() -> dict:
+    """Distributed REST serving: rank 0 binds the HTTP frontend, query rows
+    broadcast to every rank (replicated pipeline — the SPMD discipline that
+    lets device-mesh retrieval serve on the whole cluster), responses gather
+    back to rank 0 where the futures resolve."""
+    import os
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.run import terminate
+    from pathway_tpu.parallel.distributed import topology_from_env
+
+    _nproc, rank, _addr = topology_from_env()
+    port = int(os.environ["DIST_REST_PORT"])
+    expected = int(os.environ["DIST_REST_EXPECTED"])
+
+    class Q(pw.Schema):
+        value: int
+
+    queries, writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, schema=Q, delete_completed_queries=True
+    )
+    responses = queries.select(result=pw.this.value * 2)
+    writer(responses)
+
+    # count DISTINCT query values (a timed-out client retry re-serves the
+    # same value and must not double-count), and stop a couple of ticks
+    # AFTER the target so the last in-flight HTTP response drains before
+    # the webserver's post-run shutdown
+    served: set = set()
+    linger = [0]
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            served.add(row["result"])
+
+    def on_time_end(time):
+        if len(served) >= expected:
+            linger[0] += 1
+            if linger[0] >= 3:
+                terminate()
+
+    if rank == 0:
+        pw.io.subscribe(responses, on_change=on_change, on_time_end=on_time_end)
+    else:
+        pw.io.subscribe(responses, on_change=None, on_time_end=None)
+
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+    import jax
+
+    return {"proc": jax.process_index(), "served": len(served)}
+
+
 SCENARIOS = {
     "knn": scenario_knn,
     "control_plane": scenario_control_plane,
     "engine": scenario_engine,
     "live_stream": scenario_live_stream,
+    "rest": scenario_rest,
 }
 
 

@@ -134,6 +134,53 @@ def test_two_process_live_streaming_exactly_once(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_rest_serving(tmp_path):
+    """REST on the cluster: rank 0 fronts HTTP, queries broadcast to every
+    rank, responses gather back — valid answers over the wire while both
+    ranks run the replicated pipeline."""
+    import json
+    import urllib.request
+
+    from .utils import free_port
+
+    port = free_port()
+    n_requests = 6
+    procs = launch_cluster(
+        "rest",
+        processes=2,
+        local_devices=1,
+        env_extra={
+            "DIST_REST_PORT": str(port),
+            "DIST_REST_EXPECTED": str(n_requests),
+        },
+    )
+    try:
+        url = f"http://127.0.0.1:{port}/"
+        deadline = time.time() + 60
+        got = []
+        i = 0
+        while len(got) < n_requests and time.time() < deadline:
+            try:
+                body = json.dumps({"value": i}).encode()
+                resp = urllib.request.urlopen(
+                    urllib.request.Request(url, data=body), timeout=5
+                )
+                got.append((i, json.loads(resp.read())))
+                i += 1
+            except Exception:
+                time.sleep(0.3)  # server not up yet
+        assert len(got) == n_requests, f"only {len(got)} responses"
+        assert all(r == v * 2 for v, r in got), got
+        results = collect_cluster(procs, timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert [r["proc"] for r in results] == [0, 1]
+    assert results[0]["served"] >= n_requests
+
+
+@pytest.mark.slow
 def test_cluster_sigkill_one_rank_then_restart_recovers(tmp_path):
     """Kill one rank mid-stream: the peer must die too (worker-panic
     propagation); restarting the WHOLE cluster from per-rank snapshots
