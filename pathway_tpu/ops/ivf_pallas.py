@@ -1,0 +1,117 @@
+"""Pallas TPU kernel for the IVF shortlist rescore.
+
+XLA expresses the rescore as a per-row gather (`matrix[cand]` with cand =
+probed members) — HBM-random access that measured ~220 ms per 64-query
+batch at 1M x 384, 40x slower than the exact full-matrix sweep, because
+gathers cannot stream.  The TPU-native fix is LAYOUT + DMA: the index is
+stored cluster-sorted as padded slabs ``[C, M, d]`` (rows of one cluster
+contiguous), and this kernel walks grid (p, B) with the probed cluster ids
+scalar-prefetched, so each program's slab arrives as ONE contiguous
+[M, d] DMA (the ``BlockSpec`` index_map reads the prefetched probe table —
+the standard Mosaic pattern for data-dependent block fetches) and is scored
+on the MXU.  HBM traffic becomes sequential slab streams instead of
+row-granular chaos.
+
+Mosaic tiling (last two block dims % (8, 128)) shapes the layout choices:
+queries ride in groups of 8 rows (each program selects its own row), M and
+d are padded to 128 multiples at build, the additive bias (0 live /
+-inf pad+removed) rides in 8-row blocks selected by ``probe % 8``, and the
+output lands as [p, B/8, 8, M] blocks revisited by the 8 consecutive
+b-fastest programs, then transposed back outside.
+
+``interpret=True`` runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["ivf_rescore"]
+
+
+def _rescore_kernel(probe_ref, q_ref, slab_ref, bias_ref, out_ref):
+    j = pl.program_id(0)
+    b = pl.program_id(1)
+    row = jax.lax.rem(b, 8)
+    q = q_ref[pl.ds(row, 1), :]  # [1, d]
+    slab = slab_ref[0]  # [M, d]
+    # matmul form ([M, d] x [d, 1]) — Mosaic's mat-vec reduction lowering
+    # rejects non-constant accumulators, the MXU matmul path does not
+    s = jnp.dot(
+        slab.astype(jnp.float32),
+        q.astype(jnp.float32).T,
+        preferred_element_type=jnp.float32,
+    )  # [M, 1]
+    ci = probe_ref[b, j]
+    bias = bias_ref[pl.ds(jax.lax.rem(ci, 8), 1), :]  # [1, M]
+    out_ref[0, 0, pl.ds(row, 1), :] = s.T + bias
+
+
+def rescore_shortlist(probe, q, slabs, bias, *, use_pallas: bool):
+    """Backend-dispatching rescore shared by IvfKnnIndex.search and the
+    fused serving path: handles the kernel's B % 8 == 0 requirement and
+    falls back to an XLA slab gather off-TPU.  Traceable (call inside jit).
+
+    probe [B, p] int32, q [B, d_pad] f32 -> [B, p, M] f32.
+    """
+    B, p = probe.shape
+    if not use_pallas:
+        rows = slabs[probe]  # [B, p, M, d_pad] gather (non-TPU path)
+        return (
+            jnp.einsum(
+                "bpmd,bd->bpm",
+                rows.astype(jnp.float32),
+                q.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            + bias[probe]
+        )
+    B8 = ((B + 7) // 8) * 8
+    if B8 != B:
+        q = jnp.concatenate([q, jnp.zeros((B8 - B, q.shape[1]), q.dtype)])
+        probe = jnp.concatenate(
+            [probe, jnp.zeros((B8 - B, p), probe.dtype)]
+        )
+        return ivf_rescore(probe, q, slabs, bias)[:B]
+    return ivf_rescore(probe, q, slabs, bias)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ivf_rescore(probe, q, slabs, bias, *, interpret: bool = False):
+    """scores[b, j, :] = q[b] . slabs[probe[b, j]].T + bias[probe[b, j]].
+
+    probe [B, p] int32 (B % 8 == 0), q [B, d] f32 (d % 128 == 0),
+    slabs [C, M, d] (M % 128 == 0), bias [C, M] f32 (C % 8 == 0)
+    -> [B, p, M] f32 (padded/removed rows carry -inf from the bias).
+    """
+    B, p = probe.shape
+    C, M, d = slabs.shape
+    out = pl.pallas_call(
+        _rescore_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(p, B),  # b fastest: the 8-row output block is revisited
+            # by consecutive programs, written back once
+            in_specs=[
+                pl.BlockSpec((8, d), lambda j, b, probe: (b // 8, 0)),
+                pl.BlockSpec(
+                    (1, M, d), lambda j, b, probe: (probe[b, j], 0, 0)
+                ),
+                pl.BlockSpec(
+                    (8, M), lambda j, b, probe: (probe[b, j] // 8, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, 8, M), lambda j, b, probe: (j, b // 8, 0, 0)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((p, B // 8, 8, M), jnp.float32),
+        interpret=interpret,
+    )(probe, q, slabs, bias)
+    # [p, B/8, 8, M] -> [B, p, M]
+    return out.transpose(1, 2, 0, 3).reshape(B, p, M)

@@ -95,23 +95,25 @@ class FusedEncodeSearch:
         index = self.index
         module = self.encoder.module
         normalize = index.metric == "cos"
-        M = index._members.shape[1]
+        M = index._M_pad
         C = index._centroids.shape[0]
+        d = index.dimension
         p = index.n_probe or index._default_probe()
         p = min(p, C)
         k_main = min(k, p * M)
         shape_key = (
             "ivf", B, L, k, p,
-            index._matrix.shape[0],
+            index._slabs.shape[0],
             C,
             M,
         )
         fn = self._fns.get(shape_key)
         if fn is not None:
             return fn, k_main
+        use_pallas = jax.default_backend() == "tpu"
 
         @jax.jit
-        def fused(params, ids, mask, matrix, valid, centroids, members):
+        def fused(params, ids, mask, slabs, bias, centroids):
             z = module.apply({"params": params}, ids, mask)
             z = z.astype(jnp.float32)
             if normalize:
@@ -123,21 +125,24 @@ class FusedEncodeSearch:
                 preferred_element_type=jnp.float32,
             )
             _, probe = jax.lax.top_k(cscores, p)
-            cand = members[probe].reshape(B, p * M)
-            safe = jnp.maximum(cand, 0)
-            rows = matrix[safe]  # [B, L, d] shortlist gather
-            scores = jnp.einsum(
-                "bld,bd->bl",
-                rows.astype(jnp.float32),
-                z,
-                preferred_element_type=jnp.float32,
+            probe = probe.astype(jnp.int32)
+            d_pad = slabs.shape[2]
+            zq = z
+            if d_pad > d:
+                zq = jnp.concatenate(
+                    [z, jnp.zeros((B, d_pad - d), z.dtype)], axis=1
+                )
+            from .ivf_pallas import rescore_shortlist
+
+            scores3 = rescore_shortlist(
+                probe, zq, slabs, bias, use_pallas=use_pallas
             )
-            ok = (cand >= 0) & valid[safe]
-            scores = jnp.where(ok, scores, -jnp.inf)
+            scores = scores3.reshape(B, p * M)
             s, i = jax.lax.top_k(scores, k_main)
-            slots = jnp.where(
-                jnp.isfinite(s), jnp.take_along_axis(cand, i, axis=1), -1
-            )
+            jj = i // M
+            mm = i % M
+            slots = jnp.take_along_axis(probe, jj, axis=1) * M + mm
+            slots = jnp.where(jnp.isfinite(s), slots, -1)
             s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
             return jnp.concatenate([s_bits, slots], axis=1)
 
@@ -151,7 +156,7 @@ class FusedEncodeSearch:
         index = self.index
         if index._needs_rebuild():
             index.build()
-        if len(index) == 0 or index._matrix is None:
+        if len(index) == 0 or index._slabs is None:
             empty: List[List[Tuple[int, float]]] = [[] for _ in texts]
             return lambda: empty
         if index._tail:
@@ -176,16 +181,15 @@ class FusedEncodeSearch:
             self.encoder.params,
             ids,
             mask,
-            index._matrix,
-            index._valid,
+            index._slabs,
+            index._bias,
             index._centroids
             if isinstance(index._centroids, jax.Array)
             else jnp.asarray(index._centroids),
-            index._members,
         )
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
-        built_keys = index._built_keys  # rebuilds REPLACE the list (no mutation)
+        keys_by_slot = index._keys_by_slot  # rebuilds REPLACE the array
         live = index._rows
 
         def complete() -> List[List[Tuple[int, float]]]:
@@ -202,7 +206,7 @@ class FusedEncodeSearch:
                     slot = int(slots[qi, j])
                     if not np.isfinite(s) or slot < 0:
                         continue
-                    key = built_keys[slot]
+                    key = int(keys_by_slot[slot])
                     if key in live:
                         row.append((key, s))
                 results.append(row[:k])
