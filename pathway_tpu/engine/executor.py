@@ -120,7 +120,7 @@ class Executor:
                 if self._terminate.is_set():
                     break
                 moved = self.step()
-                finished = all(src.finished for src in self.graph.sources)
+                finished = self._sources_finished()
             if finished and not moved:
                 # final flush for buffered/time-based operators
                 if plane is not None:
@@ -133,6 +133,28 @@ class Executor:
                 break
             if not moved:
                 self._terminate.wait(self.commit_duration_ms / 1000.0)
+
+    def _sources_finished(self) -> bool:
+        """Batch-run completion: every source is finished, where a
+        loop-back source (AsyncTransformer results) counts as finished when
+        QUIESCED — session drained and its quiesce_check reports no queued
+        or in-flight work.  Its upstream feeders are ordinary sources in
+        this same conjunction, so pending upstream data keeps the loop
+        alive."""
+        for src in self.graph.sources:
+            if src.finished:
+                continue
+            check = getattr(src, "quiesce_check", None)
+            # order matters (TOCTOU): confirm no queued/in-flight work FIRST
+            # — once both are zero no new insert can start (feeding more work
+            # requires a live upstream source, which fails this conjunction
+            # on its own) — and only then require the session drained.  The
+            # reverse order could observe an empty session, lose the race to
+            # a completing invocation, and terminate with its row undrained.
+            if check is not None and check() and not src.session.has_pending:
+                continue
+            return False
+        return True
 
     # -- distributed tick protocol ------------------------------------------
     def _step_dist(self, plane) -> Tuple[bool, bool, bool]:
@@ -171,7 +193,7 @@ class Executor:
                 if delta is not None and delta.n:
                     local_moved = True
                 polled.append(delta)
-        finished_local = all(src.finished for src in self.graph.sources)
+        finished_local = self._sources_finished()
         proposal = (
             next_timestamp(),
             local_moved,

@@ -218,6 +218,7 @@ def register_source(
     track_value_deletions: bool = False,
     atomic_batches: bool = False,
     dist_mode: str = "replicated",
+    quiesce_check=None,
 ) -> Table:
     """Create the engine source + api table and schedule ``runner`` to feed it.
 
@@ -271,6 +272,12 @@ def register_source(
     op.persistent_id = persistent_id
     op.writer = writer
     op.dist_mode = dist_mode
+    # loop-back sources (AsyncTransformer results re-entering the graph)
+    # never close their session themselves; they count as drained for
+    # batch-run termination when this callable reports no queued/in-flight
+    # work (the feeding sources' liveness is checked separately by the
+    # executor)
+    op.quiesce_check = quiesce_check
 
     if mode == "static":
 

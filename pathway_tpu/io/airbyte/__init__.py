@@ -203,11 +203,24 @@ def read(
                 return
             time.sleep(refresh_interval_ms / 1000.0)
 
+    def dist_runner(writer: SessionWriter) -> None:
+        # distributed: ONE rank runs the external source (a docker/exec
+        # Airbyte connector per rank would duplicate reads and side
+        # effects); rows are disjoint-by-construction and re-scatter to
+        # their key owners via the partitioned source exchange
+        from ...parallel.distributed import topology_from_env
+
+        processes, pid, _addr = topology_from_env()
+        if processes > 1 and pid != 0:
+            return
+        runner(writer)
+
     return register_source(
         schema,
-        runner,
+        dist_runner,
         mode=mode,
         upsert=True,
         name=name,
         persistent_id=persistent_id,
+        dist_mode="partitioned",
     )

@@ -89,8 +89,19 @@ def read(
                     continue
                 writer.insert({c: obj.get(c) for c in columns})
 
+    # distributed placement depends on the consumer-group config: WITH a
+    # group.id, brokers hand each rank a DISJOINT partition subset —
+    # partitioned, true parallel consumption.  WITHOUT one, every rank's
+    # consumer reads ALL partitions (identical streams) — replicated, the
+    # engine keeps each rank's owned-key slice.
+    has_group = bool((rdkafka_settings or {}).get("group.id"))
     return register_source(
-        schema, runner, mode="streaming", name=name, persistent_id=persistent_id
+        schema,
+        runner,
+        mode="streaming",
+        name=name,
+        persistent_id=persistent_id,
+        dist_mode="partitioned" if has_group else "replicated",
     )
 
 

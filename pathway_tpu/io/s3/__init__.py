@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import zlib
 import tempfile
 import time
 from typing import Optional, Type
@@ -79,11 +80,18 @@ def read(
         seen = dict((pers.offsets() or {}) if pers else {})
         from ..fs import _parse_into  # shared single-file parser
 
+        from ...parallel.distributed import topology_from_env
+
+        nproc, rank, _addr = topology_from_env()
         while True:
             paginator = client.get_paginator("list_objects_v2")
             for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
                 for obj in page.get("Contents", []):
                     key, etag = obj["Key"], obj.get("ETag", "")
+                    if nproc > 1 and (
+                        zlib.crc32(key.encode()) % nproc != rank
+                    ):
+                        continue  # another rank owns this object (parallel readers)
                     if seen.get(key) == etag:
                         continue
                     # hash-suffixed cache name: '/'-flattening alone is not
@@ -102,7 +110,12 @@ def read(
             time.sleep(poll_interval_s)
 
     return register_source(
-        schema, runner, mode=mode, name=name, persistent_id=persistent_id
+        schema,
+        runner,
+        mode=mode,
+        name=name,
+        persistent_id=persistent_id,
+        dist_mode="partitioned",
     )
 
 

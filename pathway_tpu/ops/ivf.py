@@ -303,9 +303,10 @@ class IvfKnnIndex:
             self._search_fns.clear()
 
     def _default_probe(self) -> int:
-        """Probe count bounding the rescore shortlist: ~10% of clusters for
-        small corpora, tapering so n_probe*M (the gathered candidate rows
-        per query) stays ≈ min(N/5, 16k)."""
+        """Probe count bounding the rescore shortlist: up to 20% of
+        clusters for small corpora (coarse clusters need generous probing
+        for recall; exact search owns that regime anyway), tapering so
+        n_probe*M_pad (the rescored rows per query) stays ~16k at large N."""
         C = self._centroids.shape[0]
         n = max(self._built_n, 1)
         # generous at small N (coarse clusters need more probes for recall;

@@ -62,6 +62,12 @@ class AsyncTransformer:
 
         transformer = self
 
+        # shared in-flight counter: incremented UNDER the queue lock when
+        # items leave the queue, decremented only after the result row is in
+        # the session — the executor's quiescence probe must never observe
+        # "empty queue, zero in flight" while an invocation is pending
+        inflight_n = [0]
+
         def runner(writer: SessionWriter):
             writer_holder["w"] = writer
             transformer.open()
@@ -71,6 +77,7 @@ class AsyncTransformer:
                 while not stop.is_set() or queue_items or in_flight:
                     with queue_lock:
                         items, queue_items[:] = queue_items[:], []
+                        inflight_n[0] += len(items)
                     for key, row in items:
                         async def one(key=key, row=row):
                             try:
@@ -83,6 +90,9 @@ class AsyncTransformer:
                                 logging.getLogger(__name__).exception(
                                     "AsyncTransformer.invoke failed"
                                 )
+                            finally:
+                                with queue_lock:
+                                    inflight_n[0] -= 1
 
                         in_flight.add(asyncio.ensure_future(one()))
                     if in_flight:
@@ -95,7 +105,24 @@ class AsyncTransformer:
             asyncio.run(work())
             transformer.close()
 
-        result = register_source(schema, runner, mode="streaming", name="async_transformer")
+        def quiesced() -> bool:
+            with queue_lock:
+                return not queue_items and inflight_n[0] == 0
+
+        # distributed: the input subscriber GATHERS to rank 0, so invoke()
+        # runs once per row cluster-wide; the loop-back source is therefore
+        # disjoint-by-construction (only rank 0 produces) and registers as
+        # "partitioned" so results re-scatter to their key owners — the
+        # default replicated-filter would silently drop rows owned by other
+        # ranks
+        result = register_source(
+            schema,
+            runner,
+            mode="streaming",
+            name="async_transformer",
+            dist_mode="partitioned",
+            quiesce_check=quiesced,
+        )
 
         def on_change(key, row, time, is_addition):
             if not is_addition:

@@ -229,12 +229,58 @@ def scenario_rest() -> dict:
     return {"proc": jax.process_index(), "served": len(served)}
 
 
+def scenario_async_transformer() -> dict:
+    """AsyncTransformer on the cluster: input gathers to rank 0 (invoke runs
+    ONCE per row cluster-wide), results re-scatter to their key owners via
+    the partitioned loop-back source — the replicated-filter default would
+    silently drop rows owned by non-producing ranks."""
+    import pathway_tpu as pw
+    from pathway_tpu.parallel import gather_table_rows
+
+    class Out(pw.Schema):
+        word: str
+        doubled: int
+
+    class Doubler(pw.AsyncTransformer):
+        output_schema = Out
+
+        async def invoke(self, word, cnt):
+            return {"word": word, "doubled": cnt * 2}
+
+    table = pw.debug.table_from_markdown(
+        """
+        word  | cnt
+        alpha | 1
+        beta  | 2
+        gamma | 3
+        delta | 4
+        eps   | 5
+        """
+    )
+    result = Doubler(input_table=table).successful
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+    import jax
+
+    lkeys, _ = result._materialize()
+    keys, cols = gather_table_rows(result)
+    rows = sorted(
+        (str(cols["word"][i]), int(cols["doubled"][i]))
+        for i in range(len(keys))
+    )
+    return {
+        "proc": jax.process_index(),
+        "rows": rows,
+        "local_rows": len(lkeys),
+    }
+
+
 SCENARIOS = {
     "knn": scenario_knn,
     "control_plane": scenario_control_plane,
     "engine": scenario_engine,
     "live_stream": scenario_live_stream,
     "rest": scenario_rest,
+    "async_transformer": scenario_async_transformer,
 }
 
 

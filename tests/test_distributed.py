@@ -236,3 +236,19 @@ def test_cluster_sigkill_one_rank_then_restart_recovers(tmp_path):
         f"exactly-once violated after SIGKILL+restart:\n got {dict(got)}\n"
         f"want {dict(truth)}"
     )
+
+
+@pytest.mark.slow
+def test_async_transformer_partitioned_loopback():
+    """AsyncTransformer results compute once (rank-0 gather) and re-scatter
+    to their key owners; the union is complete and neither rank holds
+    everything locally."""
+    results = spawn_cluster("async_transformer", processes=2, local_devices=1)
+    expected = [
+        ["alpha", 2], ["beta", 4], ["delta", 8], ["eps", 10], ["gamma", 6],
+    ]
+    for r in results:
+        assert r["rows"] == expected
+    locals_ = [r["local_rows"] for r in results]
+    assert sum(locals_) == len(expected), locals_
+    assert all(lr < len(expected) for lr in locals_), locals_

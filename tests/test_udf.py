@@ -310,3 +310,40 @@ def test_helper_primitives():
         assert await opts(4) == 5
 
     asyncio.run(drive())
+
+
+def test_async_transformer_batch_run_terminates():
+    """AsyncTransformer in a BATCH run must quiesce and let pw.run return
+    (regression: the loop-back source waited for on_end, which only fires
+    after all sources finish — a termination circularity)."""
+    import pathway_tpu as pw
+
+    class Out(pw.Schema):
+        word: str
+        doubled: int
+
+    class Doubler(pw.AsyncTransformer):
+        output_schema = Out
+
+        async def invoke(self, word, cnt):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return {"word": word, "doubled": cnt * 2}
+
+    table = pw.debug.table_from_markdown(
+        """
+        word  | cnt
+        alpha | 1
+        beta  | 2
+        gamma | 3
+        """
+    )
+    result = Doubler(input_table=table).successful
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+    keys, cols = result._materialize()
+    assert sorted(zip(cols["word"], (int(v) for v in cols["doubled"]))) == [
+        ("alpha", 2),
+        ("beta", 4),
+        ("gamma", 6),
+    ]
