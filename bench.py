@@ -366,8 +366,9 @@ def phase_wordcount(backend: str, extras: dict) -> float:
     t0 = time.perf_counter()
     for start in range(0, n_rows, batch):
         part = words[start : start + batch]
-        session.insert_batch(
-            range(start, start + len(part)), [(w,) for w in part]
+        session.insert_columnar(
+            np.arange(start, start + len(part), dtype=np.uint64),
+            {"word": part},
         )
         ex.step()
     elapsed = time.perf_counter() - t0

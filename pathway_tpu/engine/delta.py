@@ -80,7 +80,9 @@ def as_column(values: Sequence[Any], dtype: Optional[dt.DType] = None) -> np.nda
     if npdt is not None:
         try:
             return np.asarray(values, dtype=npdt)
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
+            # OverflowError: out-of-int64 values stay python big ints in an
+            # object column (the row-path behavior)
             pass
     return _object_array(list(values))
 

@@ -19,6 +19,7 @@ from typing import Callable, List, Optional, Tuple
 from .delta import Delta
 from .graph import EngineGraph, EngineOperator
 from .operators.io import SourceOperator
+from .operators.io import _COLUMNAR
 
 __all__ = ["Executor", "Timestamp", "next_timestamp"]
 
@@ -222,6 +223,26 @@ class Executor:
                 events = polled_item or []
                 parts: List[list] = [[] for _ in range(plane.nproc)]
                 for ev in events:
+                    if ev[0] == _COLUMNAR:
+                        # split one columnar batch into per-owner columnar
+                        # sub-batches (vectorized; stays tuple-free)
+                        keys, cols = ev[2]
+                        owners = shards_of(keys, plane.nproc)
+                        for peer in range(plane.nproc):
+                            mask = owners == peer
+                            m = int(mask.sum())
+                            if m:
+                                parts[peer].append(
+                                    (
+                                        _COLUMNAR,
+                                        m,
+                                        (
+                                            keys[mask],
+                                            {c: v[mask] for c, v in cols.items()},
+                                        ),
+                                    )
+                                )
+                        continue
                     parts[shard_of(ev[1], plane.nproc)].append(ev)
                 got = plane.all_to_all(f"src{src.id}", rnd, parts)
                 merged = [ev for part in got for ev in part]

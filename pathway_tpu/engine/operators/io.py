@@ -26,6 +26,11 @@ _REMOVE = 1
 _UPSERT = 2
 _DELETE_BY_KEY = 3
 _BATCH_MARK = 4
+# one event carrying a whole COLUMNAR insert batch: (kind, n_rows,
+# (keys uint64[n], {col: np.ndarray[n]})) — the bulk-ingest hot path skips
+# per-row python tuples entirely (reference: connectors hand the engine
+# parsed batches, not rows)
+_COLUMNAR = 5
 
 
 class InputSession:
@@ -77,6 +82,21 @@ class InputSession:
             if self.recorder is not None:
                 for event in events:
                     self.recorder(event)
+
+    def insert_columnar(self, keys, columns: Dict[str, Any]) -> None:
+        """Bulk insert of a whole columnar batch as ONE event (no per-row
+        tuples anywhere on the path; drains into a Delta directly).  Only
+        for plain-insert streams — upsert sessions need per-row chain
+        resolution."""
+        if self.upsert:
+            raise ValueError("insert_columnar requires a non-upsert session")
+        keys = np.asarray(keys, dtype=np.uint64)
+        event = (_COLUMNAR, len(keys), (keys, columns))
+        with self._lock:
+            self._events.append(event)
+            self._since_mark += len(keys)
+            if self.recorder is not None:
+                self.recorder(event)
 
     def remove(self, key: int, row: Optional[Tuple[Any, ...]] = None) -> None:
         event = (_DELETE_BY_KEY if row is None else _REMOVE, key, row)
@@ -169,6 +189,41 @@ class SourceOperator(EngineOperator):
             return None
         names = self.output.column_names
         store = self.output.store
+        if any(e[0] == _COLUMNAR for e in events):
+            if all(e[0] in (_INSERT, _COLUMNAR) for e in events):
+                # pure inserts: columnar batches become Deltas verbatim, row
+                # inserts batch separately; order is immaterial for +1 rows
+                deltas = []
+                rows_ev = [e for e in events if e[0] == _INSERT]
+                if rows_ev:
+                    deltas.append(self.events_to_delta(rows_ev))
+                for kind, n, (keys, cols) in (
+                    e for e in events if e[0] == _COLUMNAR
+                ):
+                    deltas.append(
+                        Delta(
+                            keys=np.asarray(keys, dtype=KEY_DTYPE),
+                            diffs=np.ones(n, dtype=np.int64),
+                            columns={
+                                name: as_column(cols[name], self.dtypes.get(name))
+                                for name in names
+                            },
+                        )
+                    )
+                return Delta.concat([d for d in deltas if d is not None], names)
+            # mixed with upserts/removals: decompose to row events (rare)
+            flat = []
+            for e in events:
+                if e[0] != _COLUMNAR:
+                    flat.append(e)
+                    continue
+                _kind, n, (keys, cols) = e
+                col_list = [cols[name] for name in names]
+                for i in range(n):
+                    flat.append(
+                        (_INSERT, int(keys[i]), tuple(c[i] for c in col_list))
+                    )
+            events = flat
         if all(e[0] == _INSERT for e in events):
             # pure-insert batch (the bulk-ingest shape): no upsert chains to
             # resolve — build the delta columnar without the per-event loop

@@ -137,6 +137,43 @@ class SessionWriter:
         self.session.insert_batch(keys, rows)
         self.monitor.on_insert(len(rows))
 
+    def insert_columns(self, columns: Mapping[str, Any], n: Optional[int] = None) -> None:
+        """Columnar bulk insert: whole columns (lists/arrays of equal
+        length) go through vectorized coercion and ONE keying pass, then
+        land in the session as a single columnar event — no per-row python
+        tuples anywhere (the wordcount-shape hot path).  Falls back to
+        insert_rows for sessions that need per-row treatment."""
+        cols = {c: columns.get(c) for c in self.column_names}
+        if n is None:
+            present = [v for v in cols.values() if v is not None]
+            if not present:
+                raise ValueError(
+                    "insert_columns: no schema column present and no n given"
+                )
+            n = len(present[0])
+        if n == 0:
+            return
+        if self.track_value_deletions or self.session.upsert:
+            # per-row semantics needed (upsert chains / value-tracked
+            # deletions — primary-key schemas always open upsert sessions,
+            # so PK keying happens in insert_rows)
+            rows = [
+                {c: (cols[c][i] if cols[c] is not None else None) for c in cols}
+                for i in range(n)
+            ]
+            self.insert_rows(rows)
+            return
+        coerced = {
+            c: _coerce_column(cols[c], self.dtypes.get(c), n)
+            for c in self.column_names
+        }
+        with self._lock:
+            start = self._counter
+            self._counter += n
+        keys = sequential_keys(start, n, salt=self._salt)
+        self.session.insert_columnar(keys, coerced)
+        self.monitor.on_insert(n)
+
     def remove(self, values: Mapping[str, Any], key: Optional[int] = None) -> None:
         values = coerce_row_types(values, self.dtypes)
         if key is None:
@@ -179,6 +216,44 @@ class SessionWriter:
     def close(self) -> None:
         self.monitor.on_finish()
         self.session.close()
+
+
+def _coerce_column(col, t: Optional[dt.DType], n: int) -> np.ndarray:
+    """Vectorized flavor of coerce_row_types for one whole column."""
+    if col is None:
+        out = np.empty(n, dtype=object)
+        return out
+    t = dt.unoptionalize(t) if t is not None else None
+    try:
+        if t is dt.INT:
+            arr = np.asarray(col)
+            if np.issubdtype(arr.dtype, np.integer):
+                return arr.astype(np.int64, copy=False)
+            return arr.astype(np.int64)
+        if t is dt.FLOAT:
+            return np.asarray(col).astype(np.float64)
+        if t is dt.STR:
+            arr = np.asarray(col, dtype=object)
+            # one full type scan — a first-element sample would let mixed
+            # columns skip str() and hash/group differently than the row path
+            if arr.size and not all(type(v) is str for v in arr.flat):
+                return np.array(
+                    [v if type(v) is str else str(v) for v in col],
+                    dtype=object,
+                )
+            return arr
+    except (ValueError, TypeError, OverflowError):
+        # mixed/unparseable (numpy raises OverflowError for out-of-int64
+        # values the row path keeps as python big ints): per-value below
+        pass
+    arr = np.empty(n, dtype=object)
+    for i, v in enumerate(col):
+        arr[i] = v
+    dtypes = {"c": t} if t is not None else {}
+    if t is not None:
+        for i in range(n):
+            arr[i] = coerce_row_types({"c": arr[i]}, dtypes)["c"]
+    return arr
 
 
 def coerce_row_types(

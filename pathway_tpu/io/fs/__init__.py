@@ -115,8 +115,23 @@ def _parse_into(
             writer.insert_rows(_buf)
             _buf.clear()
 
+    emit_columns = None
+    if not writer.track_value_deletions and not writer.session.upsert:
+        if with_metadata:
+
+            def emit_columns(cols, n):
+                writer.insert_columns({**cols, "_metadata": [meta] * n}, n)
+
+        else:
+
+            def emit_columns(cols, n):
+                writer.insert_columns(cols, n)
+
     try:
-        _dispatch_format(fpath, format, columns, emit, csv_settings=csv_settings)
+        _dispatch_format(
+            fpath, format, columns, emit, csv_settings=csv_settings,
+            emit_columns=emit_columns,
+        )
     finally:
         # flush even when a malformed row raises mid-file, so every
         # successfully parsed row reaches the session (the pre-buffering
@@ -124,7 +139,9 @@ def _parse_into(
         flush()
 
 
-def _dispatch_format(fpath, format, columns, emit, csv_settings=None) -> None:
+def _dispatch_format(
+    fpath, format, columns, emit, csv_settings=None, emit_columns=None
+) -> None:
 
     if format == "csv" and csv_settings is not None:
         # general DSV: python csv module honouring the parser settings
@@ -169,13 +186,32 @@ def _dispatch_format(fpath, format, columns, emit, csv_settings=None) -> None:
         if rows:
             header = rows[0]
             idx = {c: header.index(c) if c in header else None for c in columns}
-            for row in rows[1:]:
-                emit(
+            body = rows[1:]
+            if emit_columns is not None and body:
+                # columnar hand-off: whole columns to the session in one
+                # event — no per-row dicts/tuples on the hot path
+                emit_columns(
                     {
-                        c: (row[i] if i is not None and i < len(row) else None)
+                        c: (
+                            [
+                                (row[i] if i < len(row) else None)
+                                for row in body
+                            ]
+                            if i is not None
+                            else [None] * len(body)
+                        )
                         for c, i in idx.items()
-                    }
+                    },
+                    len(body),
                 )
+            else:
+                for row in body:
+                    emit(
+                        {
+                            c: (row[i] if i is not None and i < len(row) else None)
+                            for c, i in idx.items()
+                        }
+                    )
     elif format in ("json", "jsonlines"):
         with open(fpath) as f:
             for line in f:
