@@ -274,6 +274,41 @@ def scenario_async_transformer() -> dict:
     }
 
 
+def scenario_temporal() -> dict:
+    """Temporal layer on the cluster: a tumbling-window aggregation —
+    window-instance keys shard like any group key — must match the
+    single-process oracle."""
+    import pathway_tpu as pw
+    from pathway_tpu.parallel import gather_table_rows
+
+    events = pw.debug.table_from_markdown(
+        """
+        t  | v
+        1  | 1
+        3  | 2
+        5  | 3
+        7  | 4
+        11 | 5
+        13 | 6
+        """
+    )
+    windowed = pw.temporal.windowby(
+        events, events.t, window=pw.temporal.tumbling(duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    pw.run(monitoring_level=None)
+    import jax
+
+    keys, cols = gather_table_rows(windowed)
+    rows = sorted(
+        (int(cols["start"][i]), int(cols["total"][i]))
+        for i in range(len(keys))
+    )
+    return {"proc": jax.process_index(), "rows": rows}
+
+
 SCENARIOS = {
     "knn": scenario_knn,
     "control_plane": scenario_control_plane,
@@ -281,6 +316,7 @@ SCENARIOS = {
     "live_stream": scenario_live_stream,
     "rest": scenario_rest,
     "async_transformer": scenario_async_transformer,
+    "temporal": scenario_temporal,
 }
 
 
