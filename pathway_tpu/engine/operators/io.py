@@ -51,6 +51,7 @@ class InputSession:
         # mark_batch() (or close) — a mid-batch poll can never split a batch
         self.atomic_batches = atomic_batches
         self.finished = False
+        self._error: Optional[BaseException] = None
         # persistence hook: called with each raw event as it is appended
         # (persistence/engine_state.py SourcePersistence.record); replayed
         # events injected via push_raw are deliberately not re-recorded
@@ -124,10 +125,21 @@ class InputSession:
         with self._lock:
             self.finished = True
 
+    def fail(self, exc: BaseException) -> None:
+        """A connector runner crashed: surface the exception at the next
+        engine drain instead of letting the daemon thread's death read as a
+        clean end-of-stream (the reference's reader-thread errors likewise
+        fail the run, src/connectors/mod.rs error channel)."""
+        with self._lock:
+            self._error = exc
+            self.finished = True
+
     def drain(self) -> List[Tuple[int, int, Optional[Tuple[Any, ...]]]]:
         """Take the next sealed batch, or (non-atomic / finished) the
         unsealed tail."""
         with self._lock:
+            if self._error is not None:
+                raise self._error
             for i, (kind, _k, _r) in enumerate(self._events):
                 if kind == _BATCH_MARK:
                     events = self._events[:i]
@@ -154,6 +166,8 @@ class InputSession:
     @property
     def has_pending(self) -> bool:
         with self._lock:
+            if self._error is not None:
+                return True  # force a drain so the failure surfaces
             if self.atomic_batches and not self.finished:
                 return any(kind == _BATCH_MARK for kind, _k, _r in self._events)
             return bool(self._events)

@@ -67,9 +67,14 @@ def log_error(
     *,
     operator: Optional[str] = None,
     trace: Optional[Trace] = None,
+    op_id: Optional[int] = None,
     **extra,
 ) -> ErrorLogEntry:
-    op_id = current_operator_id()
+    # explicit op_id wins: executor threads (async UDFs, pool workers) have
+    # no engine-thread-local operator, so dispatch sites capture identity
+    # up front and pass it through (ADVICE r4 low #5)
+    if op_id is None:
+        op_id = current_operator_id()
     if op_id is not None:
         extra = {**extra, "op_id": op_id}
     entry = ErrorLogEntry(message, operator, trace, extra)
@@ -90,7 +95,7 @@ class LocalErrorLog(list):
     def __init__(self):
         super().__init__()
         self._open = True
-        self._op_ids: Optional[range] = None
+        self._op_ids: Optional[frozenset] = None
 
     def accepts(self, entry: ErrorLogEntry) -> bool:
         if self._open:
@@ -124,10 +129,12 @@ def local_error_log():
             # errors here when the graph runs after the block exits.  Bound
             # the registry — a service opening many contexts must not leak
             # sink scans/memory without limit; oldest closed sinks retire.
+            # The EXACT id set (not an id range) scopes the capture; graph
+            # building is assumed single-threaded (as in the reference —
+            # the ParseGraph is a process-global built by the user script),
+            # so ops[n0:] are precisely the ones built inside the block.
             ops = G.engine_graph.operators
-            lo = ops[n0].id if len(ops) > n0 else 0
-            hi = ops[-1].id + 1 if len(ops) > n0 else 0
-            captured._op_ids = range(lo, hi)
+            captured._op_ids = frozenset(op.id for op in ops[n0:])
             captured._open = False
             if not captured._op_ids:
                 # nothing built inside: nothing can route here later

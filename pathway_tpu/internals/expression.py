@@ -416,14 +416,14 @@ class ApplyExpression(ColumnExpression):
 
         self._trace = trace_user_frame()
 
-    def _row_error(self, exc: Exception):
+    def _row_error(self, exc: Exception, op_id: int | None = None):
         from .error_log import log_error
         from .error_value import Error
 
         fn_name = getattr(self._fun, "__name__", "<udf>")
         loc = f" (udf {fn_name} applied at {self._trace})" if self._trace else ""
         message = f"{type(exc).__name__}: {exc}{loc}"
-        log_error(message, operator="apply", trace=self._trace)
+        log_error(message, operator="apply", trace=self._trace, op_id=op_id)
         return Error(message)
 
     def _eval(self, ctx: EvalContext) -> np.ndarray:
@@ -528,10 +528,15 @@ class AsyncApplyExpression(ApplyExpression):
             return await asyncio.gather(*coros, return_exceptions=True)
 
         if run_rows:
+            # operator identity captured BEFORE dispatch: completions may be
+            # handled off the engine thread, where the thread-local is unset
+            from .error_log import current_operator_id
+
+            op_id = current_operator_id()
             results = asyncio.run(run_all())
             for (i, _, _), r in zip(run_rows, results):
                 if isinstance(r, Exception):
-                    out[i] = self._row_error(r)
+                    out[i] = self._row_error(r, op_id=op_id)
                 elif isinstance(r, BaseException):
                     raise r  # cancellation/system exit must not become data
                 else:

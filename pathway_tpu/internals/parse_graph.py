@@ -29,6 +29,11 @@ class GraphHolder:
         self.hooks_started: int = 0
         # callables invoked after run finishes
         self.post_run_hooks: List = []
+        # per-graph build ordinals (e.g. kafka read #) — deterministic
+        # across ranks because every rank builds the same graph in the same
+        # order, and reset with the graph (unlike module-level counters,
+        # which would drift on notebook re-runs / second graphs)
+        self.io_ordinals: dict = {}
 
     def clear(self) -> None:
         self.engine_graph = EngineGraph()
@@ -37,6 +42,12 @@ class GraphHolder:
         self.pre_run_hooks = []
         self.hooks_started = 0
         self.post_run_hooks = []
+        self.io_ordinals = {}
+
+    def claim_io_ordinal(self, kind: str) -> int:
+        n = self.io_ordinals.get(kind, 0)
+        self.io_ordinals[kind] = n + 1
+        return n
 
 
 G = GraphHolder()

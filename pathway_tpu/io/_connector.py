@@ -368,6 +368,10 @@ def register_source(
             def target():
                 try:
                     runner(writer)
+                except BaseException as exc:  # noqa: BLE001
+                    # re-raised on the engine thread at the next drain —
+                    # a crashed reader must fail the run, not end the stream
+                    session.fail(exc)
                 finally:
                     writer.close()
 

@@ -128,7 +128,7 @@ def read(
     from ..kafka import _consume_raw  # gated on a kafka client library
 
     def runner(writer: SessionWriter):
-        for raw in _consume_raw(rdkafka_settings, topic_name):
+        for _partition, _offset, raw in _consume_raw(rdkafka_settings, topic_name):
             apply_message(writer, raw)
 
     return register_source(

@@ -44,7 +44,9 @@ def _make_consumer(rdkafka_settings: Dict, topic: str):
         ) from e
 
 
-def _consume_raw(rdkafka_settings: Dict, topic: str) -> Iterable[bytes]:
+def _consume_raw(rdkafka_settings: Dict, topic: str):
+    """Yield ``(partition, offset, payload)``; partition/offset are None
+    only for clients that do not expose them (both real clients do)."""
     consumer = _make_consumer(rdkafka_settings or {}, topic)
     if hasattr(consumer, "poll") and not hasattr(consumer, "subscription"):
         # confluent_kafka style
@@ -52,10 +54,14 @@ def _consume_raw(rdkafka_settings: Dict, topic: str) -> Iterable[bytes]:
             msg = consumer.poll(0.2)
             if msg is None or msg.error():
                 continue
-            yield msg.value()
+            yield msg.partition(), msg.offset(), msg.value()
     else:  # kafka-python style iterator
         for msg in consumer:
-            yield msg.value
+            yield (
+                getattr(msg, "partition", None),
+                getattr(msg, "offset", None),
+                msg.value,
+            )
 
 
 def read(
@@ -75,26 +81,61 @@ def read(
     elif schema is None:
         raise ValueError(f"schema is required for format={format!r}")
     columns = list(schema.columns().keys())
-
-    def runner(writer: SessionWriter):
-        for raw in _consume_raw(rdkafka_settings, topic):
-            if format == "raw":
-                writer.insert({"data": raw})
-            elif format == "plaintext":
-                writer.insert({"data": raw.decode(errors="replace")})
-            else:
-                try:
-                    obj = json.loads(raw)
-                except ValueError:
-                    continue
-                writer.insert({c: obj.get(c) for c in columns})
+    has_pk = schema.primary_key_columns() is not None
 
     # distributed placement depends on the consumer-group config: WITH a
     # group.id, brokers hand each rank a DISJOINT partition subset —
     # partitioned, true parallel consumption.  WITHOUT one, every rank's
     # consumer reads ALL partitions (identical streams) — replicated, the
-    # engine keeps each rank's owned-key slice.
+    # engine keeps each rank's owned-key slice.  Replicated mode only works
+    # if every rank mints the SAME key for the same record, but brokers
+    # interleave partitions nondeterministically, so per-rank sequential
+    # keys would diverge — keys for non-PK rows are instead derived from
+    # (topic, partition, offset), which is order-independent (the analog of
+    # the reference's offset-based snapshot identity, src/connectors/offset.rs).
     has_group = bool((rdkafka_settings or {}).get("group.id"))
+
+    from ...internals.keys import ref_scalar
+    from ...parallel.distributed import topology_from_env
+
+    nproc, _rank, _addr = topology_from_env()
+    replicated_multiproc = (not has_group) and nproc > 1
+    # per-read() ordinal, identical across ranks (same script, same build
+    # order): folded into the derived key so two no-PK reads of the SAME
+    # topic stay key-disjoint (concat-safe), like the per-source salt does
+    # for sequential keys.  Scoped to the graph, not the module, so a
+    # rank that happens to have built an earlier graph in-process does not
+    # drift from fresh ranks.
+    from ...internals.parse_graph import G
+
+    ordinal = G.claim_io_ordinal("kafka")
+
+    def runner(writer: SessionWriter):
+        for partition, offset, raw in _consume_raw(rdkafka_settings, topic):
+            key = None
+            if not has_pk and partition is not None and offset is not None:
+                key = int(
+                    ref_scalar(
+                        "kafka", ordinal, topic or "", int(partition), int(offset)
+                    )
+                )
+            elif key is None and not has_pk and replicated_multiproc:
+                raise ValueError(
+                    "pw.io.kafka: replicated (group-id-less) consumption in a "
+                    "multi-process run needs deterministic record identity, "
+                    "but this client exposes no partition/offset — set a "
+                    "group.id (partitioned mode) or add a primary key"
+                )
+            if format == "raw":
+                writer.insert({"data": raw}, key=key)
+            elif format == "plaintext":
+                writer.insert({"data": raw.decode(errors="replace")}, key=key)
+            else:
+                try:
+                    obj = json.loads(raw)
+                except ValueError:
+                    continue
+                writer.insert({c: obj.get(c) for c in columns}, key=key)
     return register_source(
         schema,
         runner,

@@ -20,24 +20,49 @@ Rendezvous rides the jax coordination service's KV store (the ranks already
 share it for jax.distributed), so no extra ports need configuring: each rank
 publishes its listen address once at startup.
 
-A peer dying mid-stream surfaces as a broken connection; every blocked
-collective then raises, aborting this rank's run too — the analog of the
-reference's worker-panic propagation (src/engine/dataflow.rs:5667-5676).
+A peer dying mid-stream surfaces as a broken connection; a peer that HANGS
+(SIGSTOP, network partition with the socket still open) is caught by the
+heartbeat: every rank pings every peer each ``PATHWAY_EXCHANGE_HEARTBEAT``
+seconds (default 2), and a collective waiting on a peer that has been silent
+for ``PATHWAY_EXCHANGE_HEARTBEAT_TIMEOUT`` seconds (default 8) raises
+``PeerLost`` instead of stalling for the full collective timeout.  Every
+blocked collective then raises, aborting this rank's run too — the analog of
+the reference's worker-panic propagation (src/engine/dataflow.rs:5667-5676).
 Recovery is a cluster restart from persisted snapshots (per-rank input logs
 + offsets), mirroring docs/.../10.worker-architecture.md:58-61.
+
+Transport hardening: the listener binds ONLY the advertised interface
+(loopback for single-host clusters), and every connection must open with a
+32-byte session secret minted by rank 0 and distributed over the jax
+coordination KV — frames are pickled, so an unauthenticated listener would
+hand arbitrary-code-execution to anyone who could reach the port.
 """
 
 from __future__ import annotations
 
+import hmac
+import os
 import pickle
+import secrets
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["ExchangePlane", "get_plane", "close_plane"]
 
 _HDR = struct.Struct("!Q")
+_TOKEN_LEN = 32
+_HB_EDGE = "__hb__"
+
+
+def _hb_interval() -> float:
+    return float(os.environ.get("PATHWAY_EXCHANGE_HEARTBEAT", "2.0"))
+
+
+def _hb_timeout() -> float:
+    return float(os.environ.get("PATHWAY_EXCHANGE_HEARTBEAT_TIMEOUT", "8.0"))
 
 
 class PeerLost(RuntimeError):
@@ -57,20 +82,30 @@ class ExchangePlane:
         self._dead: Optional[BaseException] = None
         self._closed = False
         self._recv_threads: List[threading.Thread] = []
+        self._last_recv: Dict[int, float] = {}
 
-        # rendezvous: publish my listen addr, read everyone else's.  Bind all
-        # interfaces and advertise the address peers can actually reach —
-        # multi-host clusters (PATHWAY_COORDINATOR_ADDRESS on another box)
-        # must not be handed a loopback address.
+        # session secret: rank 0 mints it, everyone reads it from the jax
+        # coordination KV (which only cluster members share).  Connections
+        # that cannot present it are dropped before any pickle.loads runs.
+        if rank == 0:
+            self._token = secrets.token_bytes(_TOKEN_LEN)
+            kv_set(f"pathway_tpu/exch/{namespace}/token", self._token.hex())
+        else:
+            self._token = bytes.fromhex(
+                kv_get(f"pathway_tpu/exch/{namespace}/token")
+            )
+
+        # rendezvous: publish my listen addr, read everyone else's.  Bind
+        # ONLY the advertised interface (loopback for single-host clusters,
+        # the NIC that routes to the coordinator for multi-host) — frames
+        # are pickled, so the listener must not face the open network.
+        host = _advertise_host()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("0.0.0.0", 0))
+        self._listener.bind((host, 0))
         self._listener.listen(nproc)
         _, port = self._listener.getsockname()
-        kv_set(
-            f"pathway_tpu/exch/{namespace}/{rank}",
-            f"{_advertise_host()}:{port}",
-        )
+        kv_set(f"pathway_tpu/exch/{namespace}/{rank}", f"{host}:{port}")
         addrs: Dict[int, Tuple[str, int]] = {}
         for peer in range(nproc):
             if peer == self.rank:
@@ -79,17 +114,30 @@ class ExchangePlane:
             h, p = raw.rsplit(":", 1)
             addrs[peer] = (h, int(p))
 
-        # accept loop (peers dial me), started before dialing out
+        # accept loop (peers dial me), started before dialing out.  Junk or
+        # unauthenticated connections are closed and do not consume a slot.
         accepted: Dict[int, socket.socket] = {}
         accept_done = threading.Event()
 
         def _accept():
+            deadline = time.monotonic() + 60
             try:
-                for _ in range(nproc - 1):
+                while len(accepted) < nproc - 1 and time.monotonic() < deadline:
                     conn, _ = self._listener.accept()
-                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    peer_rank = _HDR.unpack(_recv_exact(conn, _HDR.size))[0]
-                    accepted[int(peer_rank)] = conn
+                    try:
+                        conn.settimeout(10)
+                        peer_rank = _HDR.unpack(_recv_exact(conn, _HDR.size))[0]
+                        offered = _recv_exact(conn, _TOKEN_LEN)
+                        if not hmac.compare_digest(offered, self._token):
+                            raise PermissionError("bad exchange token")
+                        conn.settimeout(None)
+                        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                        accepted[int(peer_rank)] = conn
+                    except Exception:
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
             finally:
                 accept_done.set()
 
@@ -102,7 +150,7 @@ class ExchangePlane:
             # with full TCP buffers) as peer death and abort a healthy cluster
             s.settimeout(None)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            s.sendall(_HDR.pack(self.rank))
+            s.sendall(_HDR.pack(self.rank) + self._token)
             self._send[peer] = s
             self._send_locks[peer] = threading.Lock()
         if not accept_done.wait(timeout=60):  # pragma: no cover - rendezvous hang
@@ -112,24 +160,38 @@ class ExchangePlane:
             raise RuntimeError(
                 f"exchange plane rendezvous incomplete: {sorted(accepted)}"
             )
+        now = time.monotonic()
         for peer, conn in accepted.items():
+            self._last_recv[peer] = now
             t = threading.Thread(
                 target=self._recv_loop, args=(peer, conn), daemon=True,
                 name=f"exch-recv-{peer}",
             )
             t.start()
             self._recv_threads.append(t)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="exch-heartbeat"
+        )
+        self._hb_thread.start()
 
     # -- wire ---------------------------------------------------------------
     def _recv_loop(self, peer: int, conn: socket.socket) -> None:
+        def alive() -> None:
+            # refresh per CHUNK, not per frame: a frame larger than the
+            # link can carry in hb_timeout seconds must still count as
+            # liveness, or slow bulk transfers would read as a hung peer
+            self._last_recv[peer] = time.monotonic()
+
         try:
             while True:
-                hdr = _recv_exact(conn, _HDR.size)
+                hdr = _recv_exact(conn, _HDR.size, on_chunk=alive)
                 (length,) = _HDR.unpack(hdr)
-                payload = _recv_exact(conn, length)
+                payload = _recv_exact(conn, length, on_chunk=alive)
                 edge, seq, obj = pickle.loads(payload)
                 with self._cv:
-                    self._inbox[(edge, seq, peer)] = obj
+                    self._last_recv[peer] = time.monotonic()
+                    if edge != _HB_EDGE:
+                        self._inbox[(edge, seq, peer)] = obj
                     self._cv.notify_all()
         except BaseException as exc:  # noqa: BLE001 - any failure kills the run
             with self._cv:
@@ -143,12 +205,84 @@ class ExchangePlane:
         payload = pickle.dumps((edge, seq, obj), protocol=pickle.HIGHEST_PROTOCOL)
         try:
             with self._send_locks[peer]:
-                self._send[peer].sendall(_HDR.pack(len(payload)) + payload)
+                self._send_frame(peer, _HDR.pack(len(payload)) + payload)
         except OSError as exc:
             raise PeerLost(f"send to exchange peer {peer} failed: {exc!r}") from exc
 
+    def _send_frame(self, peer: int, frame: bytes, best_effort: bool = False) -> bool:
+        """Chunked send with stall detection (caller holds the send lock).
+
+        A plain ``sendall`` with no timeout would block forever on a hung
+        receiver with full TCP buffers — BEFORE this rank ever reaches
+        ``_wait``'s heartbeat check.  Send in timed slices instead; a slice
+        that makes no progress while the peer has ALSO been silent past the
+        heartbeat timeout means the peer is hung, not merely slow (a slow
+        but healthy peer keeps heartbeating us the whole time).
+
+        ``best_effort`` (heartbeat pings): give up quietly if the socket
+        won't take the first byte — data is queued, which proves our
+        liveness to the peer anyway.  Once a frame is partially written it
+        MUST complete or the stream would corrupt."""
+        s = self._send[peer]
+        hb_timeout = _hb_timeout()
+        view = memoryview(frame)
+        s.settimeout(max(0.5, _hb_interval()))
+        try:
+            while view:
+                try:
+                    sent = s.send(view)
+                except socket.timeout:
+                    if best_effort and len(view) == len(frame):
+                        return False
+                    if time.monotonic() - self._last_recv.get(peer, 0.0) > hb_timeout:
+                        raise PeerLost(
+                            f"send to exchange peer {peer} stalled >{hb_timeout}s "
+                            "with no heartbeat from it (hung or partitioned)"
+                        )
+                    continue
+                view = view[sent:]
+            return True
+        finally:
+            try:
+                s.settimeout(None)
+            except OSError:
+                pass
+
+    def _heartbeat_loop(self) -> None:
+        """Ping every peer each interval so silence means the PEER stalled,
+        not that traffic happens to be idle.  A busy data socket is fine:
+        any frame (data or ping) refreshes the receiver's liveness clock.
+        Skips peers whose send lock is held — a large in-flight send already
+        proves this side is alive to them."""
+        interval = _hb_interval()
+        ping = pickle.dumps((_HB_EDGE, 0, None), protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _HDR.pack(len(ping)) + ping
+        while True:
+            time.sleep(interval)
+            with self._cv:
+                if self._closed or self._dead is not None:
+                    return
+            for peer, lock in self._send_locks.items():
+                if lock.acquire(blocking=False):
+                    try:
+                        self._send_frame(peer, frame, best_effort=True)
+                    except PeerLost as exc:
+                        # a ping that got partially written and then stalled
+                        # against a silent peer: surface it to the engine
+                        with self._cv:
+                            if not self._closed and self._dead is None:
+                                self._dead = exc
+                            self._cv.notify_all()
+                        return
+                    except OSError:
+                        pass  # recv loop surfaces the death with context
+                    finally:
+                        lock.release()
+
     def _wait(self, edge: str, seq: int, peers: List[int], timeout: float) -> Dict[int, Any]:
         out: Dict[int, Any] = {}
+        hb_timeout = _hb_timeout()
+        deadline = time.monotonic() + timeout
         with self._cv:
             while True:
                 if self._dead is not None:
@@ -158,11 +292,29 @@ class ExchangePlane:
                         out[p] = self._inbox.pop((edge, seq, p))
                 if len(out) == len(peers):
                     return out
-                if not self._cv.wait(timeout=timeout):
-                    raise TimeoutError(
+                now = time.monotonic()
+                stalled = [
+                    p
+                    for p in peers
+                    if p not in out and now - self._last_recv[p] > hb_timeout
+                ]
+                if stalled:
+                    # hung-not-dead peer (SIGSTOP, partition with open
+                    # socket): heartbeats stopped but TCP never reset.
+                    # PeerLost (not TimeoutError) so run.py hard-aborts
+                    # instead of unwinding into jax's shutdown barrier.
+                    self._dead = PeerLost(
+                        f"exchange {edge!r}#{seq}: peers {stalled} silent for "
+                        f">{hb_timeout}s (heartbeat lost; stalled or partitioned)"
+                    )
+                    self._cv.notify_all()
+                    raise self._dead
+                if now >= deadline:
+                    raise PeerLost(
                         f"exchange {edge!r}#{seq}: timed out waiting for "
                         f"{[p for p in peers if p not in out]}"
                     )
+                self._cv.wait(timeout=min(1.0, hb_timeout / 4))
 
     # -- collectives --------------------------------------------------------
     def all_to_all(
@@ -242,13 +394,15 @@ def _advertise_host() -> str:
         return socket.gethostbyname(socket.gethostname())
 
 
-def _recv_exact(conn: socket.socket, n: int) -> bytes:
+def _recv_exact(conn: socket.socket, n: int, on_chunk=None) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         chunk = conn.recv(n - len(buf))
         if not chunk:
             raise ConnectionError("exchange connection closed")
         buf += chunk
+        if on_chunk is not None:
+            on_chunk()
     return bytes(buf)
 
 

@@ -166,3 +166,24 @@ def test_dsv_general_delimiter_and_comments(tmp_path):
     pw.run(monitoring_level=None)
     got = sorted((r["word"], r["count"]) for r in rows)
     assert got == [("alpha", 1), ('quo"ted', 2)]
+
+
+def test_streaming_runner_crash_fails_the_run():
+    """A connector reader thread that crashes must fail pw.run(), not read
+    as a clean end-of-stream (silent data loss).  Reference: reader-thread
+    errors propagate through the connector error channel
+    (src/connectors/mod.rs)."""
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            raise RuntimeError("reader exploded")
+
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.python.read(Subj(), schema=S)
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: None)
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        pw.run(monitoring_level=None, commit_duration_ms=50)
