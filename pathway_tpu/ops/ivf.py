@@ -78,16 +78,49 @@ def _kmeans(
     return centroids.astype(np.float32)
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _tail_prefs(rows, centroids, n_pref):
+    """Per-row top-``n_pref`` centroid preferences for absorb assignment."""
+    s = jnp.dot(
+        rows, centroids.T.astype(rows.dtype), preferred_element_type=jnp.float32
+    )
+    _, idx = jax.lax.top_k(s, n_pref)
+    return idx
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _absorb_scatter(slabs, bias, slots, vecs):
+    """Scatter absorbed rows into free slots; donated buffers so XLA can
+    update the (possibly GB-scale) slabs in place instead of copying."""
+    C_pad, M_pad, d_pad = slabs.shape
+    flat = slabs.reshape(C_pad * M_pad, d_pad).at[slots].set(vecs)
+    b = bias.reshape(-1).at[slots].set(jnp.float32(0.0))
+    return flat.reshape(C_pad, M_pad, d_pad), b.reshape(C_pad, M_pad)
+
+
 class IvfKnnIndex:
     """Incrementally maintained approximate KNN (same host API as
     DeviceKnnIndex: add / remove / search / __len__).
 
-    Adds are buffered host-side; the device structures (centroids, padded
-    inverted lists, row matrix) are (re)built lazily at search time when the
-    index grew by more than ``rebuild_fraction`` since the last build.
-    Between rebuilds, fresh rows are still searchable: they are appended to a
-    small exact tail that is brute-force scored alongside the probed
-    shortlist (so results never miss recent writes — the as-of-now contract).
+    Streaming maintenance — NO stop-the-world rebuild on the serve path
+    (VERDICT r4 #2; reference behavior to match: usearch streaming
+    add/remove, src/external_integration/usearch_integration.rs:53-99):
+
+    - **tail**: fresh rows are exact-scored alongside the probed shortlist
+      (the as-of-now contract — results never miss recent writes);
+    - **absorb**: once the tail passes ``absorb_threshold``, rows are
+      assigned to their nearest centroid WITH spare slab capacity and
+      scattered into free slots in one donated device update — a few ms,
+      no retrain, runs in ``add()`` (ingest), never in search/submit;
+    - **background retrain**: when the index has grown/churned past
+      ``rebuild_fraction``, a daemon thread re-trains k-means and lays out
+      fresh slabs from a snapshot, then atomically swaps them in under the
+      lock; serving continues on the old slabs throughout.  Rows
+      added/removed/upserted DURING the retrain are reconciled at install
+      (masked or kept in the tail).
     """
 
     def __init__(
@@ -100,6 +133,7 @@ class IvfKnnIndex:
         train_sample: int = 32768,
         kmeans_iters: int = 8,
         rebuild_fraction: float = 0.25,
+        absorb_threshold: int = 4096,
         seed: int = 0,
     ):
         self.dimension = dimension
@@ -114,6 +148,7 @@ class IvfKnnIndex:
         self.train_sample = train_sample
         self.kmeans_iters = kmeans_iters
         self.rebuild_fraction = rebuild_fraction
+        self.absorb_threshold = absorb_threshold
         self.seed = seed
         self._lock = threading.RLock()
         # host-of-record row store (rebuild source)
@@ -131,6 +166,19 @@ class IvfKnnIndex:
         self._tail: Dict[int, None] = {}  # keys added since last build
         self._built_n = 0
         self._search_fns: Dict[tuple, Any] = {}
+        # host mirror of slot occupancy (True = live row), for absorb's
+        # free-slot allocation without a device fetch
+        self._live_mask: Optional[np.ndarray] = None
+        self._retraining = False
+        # damping for absorb re-attempts: when an absorb could place
+        # NOTHING (preferred clusters full), remember the tail size so
+        # every subsequent add() doesn't pay a futile tail x C matmul;
+        # re-arm once the tail grows another threshold, a slot frees, or
+        # a retrain rebalances the layout
+        self._absorb_stuck_at: Optional[int] = None
+        # maintenance counters (observable by tests/bench: the serve path
+        # must show sync_builds frozen while absorbs/retrains advance)
+        self.stats = {"sync_builds": 0, "retrains": 0, "absorbs": 0}
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -150,6 +198,17 @@ class IvfKnnIndex:
                 key = int(key)
                 self._rows[key] = vec
                 self._tail[key] = None
+            if (
+                self._slabs is not None
+                and len(self._tail) >= self.absorb_threshold
+                and (
+                    self._absorb_stuck_at is None
+                    or len(self._tail)
+                    >= self._absorb_stuck_at + self.absorb_threshold
+                )
+            ):
+                self._absorb_tail()
+            self.maybe_retrain_async()
 
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:
@@ -172,6 +231,9 @@ class IvfKnnIndex:
             self._bias = self._bias.at[
                 arr // self._M_pad, arr % self._M_pad
             ].set(-np.inf)
+            if self._live_mask is not None:
+                self._live_mask[arr] = False  # freed: absorb may reuse
+            self._absorb_stuck_at = None  # capacity changed: re-arm absorb
 
     # -- build -------------------------------------------------------------
     def _needs_rebuild(self) -> bool:
@@ -181,126 +243,331 @@ class IvfKnnIndex:
         return grown > max(64, self.rebuild_fraction * max(self._built_n, 1))
 
     def build(self) -> None:
-        """(Re)train + assign: k-means on a sample, balanced inverted lists,
-        device upload.  Called automatically from search when stale."""
+        """Synchronous full (re)train + install — the explicit BULK path
+        (initial load, tests, bench setup).  The serve path never calls
+        this; streaming maintenance goes through ``_absorb_tail`` and the
+        background retrain instead."""
         with self._lock:
-            n = len(self._rows)
-            if n == 0:
+            if not self._rows:
                 self._slabs = None
                 self._tail = {}
                 return
-            keys = list(self._rows.keys())
-            data = np.stack([self._rows[k] for k in keys])
-            # cluster count targets ~240 rows at the balance CAP; since
-            # the cap is 2x the mean fill, slab occupancy is structurally
-            # ~50% (bf16 slabs ≈ a dense f32 matrix in HBM — the padding
-            # buys contiguous per-cluster DMA for the Pallas rescore).  The
-            # probe fraction from _default_probe keeps the rescored
-            # shortlist ≈ min(N/5, 16k) padded rows/query at any N
-            C = self.n_clusters or int(
-                np.clip(np.ceil(n / 120.0), 16, 65536)
+            snapshot = dict(self._rows)
+            self.stats["sync_builds"] += 1
+        built = self._train_layout(snapshot)
+        with self._lock:
+            self._install(built, snapshot)
+
+    def maybe_retrain_async(self) -> None:
+        """Kick a background retrain when the index has churned past
+        ``rebuild_fraction`` since the last build.  Returns immediately;
+        at most one retrain runs at a time.  Caller may hold the lock."""
+        with self._lock:
+            if (
+                self._slabs is None
+                or self._retraining
+                or not self._needs_rebuild()
+            ):
+                return
+            self._retraining = True
+        threading.Thread(
+            target=self._retrain_bg, daemon=True, name="ivf-retrain"
+        ).start()
+
+    def _retrain_bg(self) -> None:
+        try:
+            with self._lock:
+                snapshot = dict(self._rows)
+            if not snapshot:
+                return
+            # the expensive part (k-means + layout + upload) runs WITHOUT
+            # the lock: serving continues on the old slabs throughout
+            built = self._train_layout(snapshot)
+            with self._lock:
+                self._install(built, snapshot)
+                self.stats["retrains"] += 1
+        finally:
+            self._retraining = False
+
+    def _train_layout(self, rows: Dict[int, np.ndarray]) -> Dict[str, Any]:
+        """Train k-means + balanced assignment + slab layout + device upload
+        for a snapshot of rows.  Lock-free: touches only its arguments."""
+        n = len(rows)
+        keys = list(rows.keys())
+        data = np.stack([rows[k] for k in keys])
+        return self._layout_from_data(keys, data)
+
+    def _layout_from_data(self, keys: List[int], data: np.ndarray) -> Dict[str, Any]:
+        n = len(keys)
+        # cluster count targets ~240 rows at the balance CAP; since
+        # the cap is 2x the mean fill, slab occupancy is structurally
+        # ~50% (bf16 slabs ≈ a dense f32 matrix in HBM — the padding
+        # buys contiguous per-cluster DMA for the Pallas rescore).  The
+        # probe fraction from _default_probe keeps the rescored
+        # shortlist ≈ min(N/5, 16k) padded rows/query at any N
+        C = self.n_clusters or int(
+            np.clip(np.ceil(n / 120.0), 16, 65536)
+        )
+        rng = np.random.default_rng(self.seed)
+        sample_n = min(n, max(self.train_sample, 8 * C))
+        C = min(C, n, sample_n)
+        sample = data[rng.choice(n, size=sample_n, replace=False)]
+        centroids = _kmeans(sample, C, self.kmeans_iters, self.seed)
+
+        # balanced assignment: nearest centroid with a 2N/C cap; overflow
+        # rows fall to their next-best centroid (keeps M bounded so the
+        # gather shapes stay small).  Vectorized per preference rank —
+        # rows competing for one cluster are ranked by sort position and
+        # the first (cap - fill) win; losers retry at the next rank.
+        cap = max(1, int(np.ceil(2.0 * n / C)))
+        n_pref = min(8, C)
+        # per-row top centroids computed ON DEVICE, fetched as [N, 8]
+        # indices — the full [N, C] score matrix is 8 GB at 1M x 2000
+        # and must never cross the host link
+        cents_dev = jnp.asarray(centroids.T)
+
+        @jax.jit
+        def _prefs(chunk_rows):
+            s = jnp.dot(
+                chunk_rows, cents_dev, preferred_element_type=jnp.float32
             )
-            rng = np.random.default_rng(self.seed)
-            sample_n = min(n, max(self.train_sample, 8 * C))
-            C = min(C, n, sample_n)
-            sample = data[rng.choice(n, size=sample_n, replace=False)]
-            self._centroids = _kmeans(sample, C, self.kmeans_iters, self.seed)
+            _, idx = jax.lax.top_k(s, n_pref)
+            return idx
 
-            # balanced assignment: nearest centroid with a 2N/C cap; overflow
-            # rows fall to their next-best centroid (keeps M bounded so the
-            # gather shapes stay small).  Vectorized per preference rank —
-            # rows competing for one cluster are ranked by sort position and
-            # the first (cap - fill) win; losers retry at the next rank.
-            cap = max(1, int(np.ceil(2.0 * n / C)))
-            n_pref = min(8, C)
-            # per-row top centroids computed ON DEVICE, fetched as [N, 8]
-            # indices — the full [N, C] score matrix is 8 GB at 1M x 2000
-            # and must never cross the host link
-            cents_dev = jnp.asarray(self._centroids.T)
-
-            @jax.jit
-            def _prefs(chunk_rows):
-                s = jnp.dot(
-                    chunk_rows, cents_dev, preferred_element_type=jnp.float32
-                )
-                _, idx = jax.lax.top_k(s, n_pref)
-                return idx
-
-            parts = []
-            step = 131072
-            for start in range(0, n, step):
-                chunk = data[start : start + step]
-                if chunk.shape[0] < step and n > step:
-                    pad = np.zeros((step - chunk.shape[0], data.shape[1]), data.dtype)
-                    got = np.asarray(_prefs(jnp.asarray(np.concatenate([chunk, pad]))))
-                    parts.append(got[: chunk.shape[0]])
-                else:
-                    parts.append(np.asarray(_prefs(jnp.asarray(chunk))))
-            order = np.concatenate(parts) if len(parts) > 1 else parts[0]
-            counts = np.zeros(C, np.int64)
-            assignment = np.full(n, -1, np.int64)
-            unassigned = np.arange(n)
-            for r in range(n_pref):
-                if unassigned.size == 0:
-                    break
-                cand = order[unassigned, r]
-                sort_ix = np.argsort(cand, kind="stable")
-                cand_sorted = cand[sort_ix]
-                # within-cluster arrival rank of each competing row
-                starts = np.searchsorted(cand_sorted, cand_sorted, side="left")
-                within = np.arange(cand_sorted.size) - starts
-                accept = within < (cap - counts[cand_sorted])
-                winners = unassigned[sort_ix[accept]]
-                assignment[winners] = cand_sorted[accept]
-                np.add.at(counts, cand_sorted[accept], 1)
-                unassigned = unassigned[sort_ix[~accept]]
-            for i in unassigned:  # rare: all 8 preferred clusters full
-                c = int(np.argmin(counts))
-                assignment[i] = c
-                counts[c] += 1
-            # CLUSTER-SORTED SLAB LAYOUT: rows of one cluster are contiguous
-            # and padded to [C_pad, M_pad, d_pad], so the rescore reads each
-            # probed cluster as ONE sequential DMA (ops/ivf_pallas.py) —
-            # per-row gathers measured 40x slower than this layout on TPU.
-            # Padding follows Mosaic tiling: M_pad % 128 (also the output
-            # block's lane dim), d_pad % 128, C_pad % 8 (bias block rows).
-            M = int(counts.max())
-            M_pad = max(128, ((M + 127) // 128) * 128)
-            d = data.shape[1]
-            d_pad = ((d + 127) // 128) * 128
-            C_pad = ((C + 7) // 8) * 8
-            keys_arr = np.asarray(keys, dtype=np.uint64)
-            order_by_cluster = np.argsort(assignment, kind="stable")
-            sorted_cluster = assignment[order_by_cluster]
-            starts = np.searchsorted(sorted_cluster, sorted_cluster, "left")
-            j_within = np.arange(n) - starts
-            slots = sorted_cluster * M_pad + j_within
-            slabs = np.zeros((C_pad * M_pad, d_pad), np.float32)
-            slabs[slots, :d] = data[order_by_cluster]
-            bias = np.full(C_pad * M_pad, -np.inf, np.float32)
-            bias[slots] = 0.0
-            keys_by_slot = np.zeros(C_pad * M_pad, dtype=np.uint64)
-            sorted_keys = keys_arr[order_by_cluster]
-            keys_by_slot[slots] = sorted_keys
-            slot_of_key = dict(
-                zip(sorted_keys.tolist(), slots.tolist())
-            )
-            slabs = slabs.reshape(C_pad, M_pad, d_pad)
-            bias = bias.reshape(C_pad, M_pad)
-
-            self._keys_by_slot = keys_by_slot
-            self._slot_of_key = slot_of_key
-            self._slabs = jnp.asarray(slabs, self.dtype)
-            self._bias = jnp.asarray(bias)
+        parts = []
+        step = 131072
+        for start in range(0, n, step):
+            chunk = data[start : start + step]
+            if chunk.shape[0] < step and n > step:
+                pad = np.zeros((step - chunk.shape[0], data.shape[1]), data.dtype)
+                got = np.asarray(_prefs(jnp.asarray(np.concatenate([chunk, pad]))))
+                parts.append(got[: chunk.shape[0]])
+            else:
+                parts.append(np.asarray(_prefs(jnp.asarray(chunk))))
+        order = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        counts = np.zeros(C, np.int64)
+        assignment = np.full(n, -1, np.int64)
+        unassigned = np.arange(n)
+        for r in range(n_pref):
+            if unassigned.size == 0:
+                break
+            cand = order[unassigned, r]
+            sort_ix = np.argsort(cand, kind="stable")
+            cand_sorted = cand[sort_ix]
+            # within-cluster arrival rank of each competing row
+            starts = np.searchsorted(cand_sorted, cand_sorted, side="left")
+            within = np.arange(cand_sorted.size) - starts
+            accept = within < (cap - counts[cand_sorted])
+            winners = unassigned[sort_ix[accept]]
+            assignment[winners] = cand_sorted[accept]
+            np.add.at(counts, cand_sorted[accept], 1)
+            unassigned = unassigned[sort_ix[~accept]]
+        for i in unassigned:  # rare: all 8 preferred clusters full
+            c = int(np.argmin(counts))
+            assignment[i] = c
+            counts[c] += 1
+        # CLUSTER-SORTED SLAB LAYOUT: rows of one cluster are contiguous
+        # and padded to [C_pad, M_pad, d_pad], so the rescore reads each
+        # probed cluster as ONE sequential DMA (ops/ivf_pallas.py) —
+        # per-row gathers measured 40x slower than this layout on TPU.
+        # Padding follows Mosaic tiling: M_pad % 128 (also the output
+        # block's lane dim), d_pad % 128, C_pad % 8 (bias block rows).
+        M = int(counts.max())
+        M_pad = max(128, ((M + 127) // 128) * 128)
+        d = data.shape[1]
+        d_pad = ((d + 127) // 128) * 128
+        C_pad = ((C + 7) // 8) * 8
+        keys_arr = np.asarray(keys, dtype=np.uint64)
+        order_by_cluster = np.argsort(assignment, kind="stable")
+        sorted_cluster = assignment[order_by_cluster]
+        starts = np.searchsorted(sorted_cluster, sorted_cluster, "left")
+        j_within = np.arange(n) - starts
+        slots = sorted_cluster * M_pad + j_within
+        slabs = np.zeros((C_pad * M_pad, d_pad), np.float32)
+        slabs[slots, :d] = data[order_by_cluster]
+        bias = np.full(C_pad * M_pad, -np.inf, np.float32)
+        bias[slots] = 0.0
+        keys_by_slot = np.zeros(C_pad * M_pad, dtype=np.uint64)
+        sorted_keys = keys_arr[order_by_cluster]
+        keys_by_slot[slots] = sorted_keys
+        slot_of_key = dict(zip(sorted_keys.tolist(), slots.tolist()))
+        live_mask = np.zeros(C_pad * M_pad, dtype=bool)
+        live_mask[slots] = True
+        return {
+            "keys_by_slot": keys_by_slot,
+            "slot_of_key": slot_of_key,
+            "live_mask": live_mask,
+            # uploads happen here, OFF the lock (install just swaps refs);
             # centroids live ON DEVICE: a host-resident copy would re-upload
             # C x d floats on every dispatch (12.8 MB ~= 213 ms through the
             # tunnel at 1M-doc scale — measured as the entire serve latency)
-            self._centroids = jnp.asarray(self._centroids)
-            self._M_pad = M_pad
-            self._d_pad = d_pad
-            self._tail = {}
-            self._built_n = n
-            self._search_fns.clear()
+            "slabs": jnp.asarray(
+                slabs.reshape(C_pad, M_pad, d_pad), self.dtype
+            ),
+            "bias": jnp.asarray(bias.reshape(C_pad, M_pad)),
+            "centroids": jnp.asarray(centroids),
+            "M_pad": M_pad,
+            "d_pad": d_pad,
+            "n": n,
+        }
+
+    def _install(self, built: Dict[str, Any], snapshot: Dict[int, np.ndarray]) -> None:
+        """Swap freshly built structures in (caller holds the lock),
+        reconciling rows that changed while the build ran off-lock:
+        removed/upserted keys are masked out of the new slabs; keys the
+        snapshot never saw stay in the exact tail."""
+        slot_of_key = built["slot_of_key"]
+        # a built key is stale iff it was removed, or UPSERTED since the
+        # snapshot (add() binds a fresh array per key, so object identity
+        # of the stored vector is an exact change detector)
+        stale = [
+            k
+            for k in slot_of_key
+            if self._rows.get(k) is not snapshot[k]
+        ]
+        if stale:
+            slots = np.asarray(
+                [slot_of_key.pop(k) for k in stale], np.int64
+            )
+            M_pad = built["M_pad"]
+            built["bias"] = built["bias"].at[
+                slots // M_pad, slots % M_pad
+            ].set(-np.inf)
+            built["live_mask"][slots] = False
+        self._keys_by_slot = built["keys_by_slot"]
+        self._slot_of_key = slot_of_key
+        self._live_mask = built["live_mask"]
+        self._slabs = built["slabs"]
+        self._bias = built["bias"]
+        self._centroids = built["centroids"]
+        self._M_pad = built["M_pad"]
+        self._d_pad = built["d_pad"]
+        self._tail = {
+            k: None for k in self._rows if k not in slot_of_key
+        }
+        self._built_n = built["n"]
+        self._absorb_stuck_at = None  # fresh layout: re-arm absorb
+        self._search_fns.clear()
+
+    def _absorb_tail(self) -> None:
+        """Fold tail rows into FREE slab slots at their nearest centroid
+        with spare capacity — one donated device scatter, no retrain
+        (caller holds the lock).  Rows whose preferred clusters are all
+        full stay in the exact tail until the next background retrain
+        rebalances the layout."""
+        tail_keys = [k for k in self._tail if k in self._rows]
+        if not tail_keys or self._slabs is None:
+            return
+        data = np.stack([self._rows[k] for k in tail_keys])
+        t = len(tail_keys)
+        M_pad = self._M_pad
+        C_pad = self._bias.shape[0]
+        C = self._centroids.shape[0]
+        n_pref = min(4, C)
+        tb = _bucket(t)  # bucketed batch: a handful of compile shapes
+        data_p = (
+            np.concatenate([data, np.zeros((tb - t, data.shape[1]), np.float32)])
+            if tb > t
+            else data
+        )
+        prefs = np.asarray(
+            _tail_prefs(jnp.asarray(data_p), self._centroids, n_pref)
+        )[:t]
+        live = self._live_mask
+        free_count = M_pad - np.add.reduceat(
+            live.astype(np.int64), np.arange(0, C_pad * M_pad, M_pad)
+        )
+        target = np.full(t, -1, np.int64)
+        fill = np.zeros(C_pad, np.int64)
+        for r in range(n_pref):
+            todo = target < 0
+            if not todo.any():
+                break
+            cand = prefs[todo, r]
+            room = free_count[cand] - fill[cand] > 0
+            # rank competing rows within each cluster (same trick as build)
+            idxs = np.flatnonzero(todo)[room]
+            cand = cand[room]
+            order = np.argsort(cand, kind="stable")
+            cs = cand[order]
+            starts = np.searchsorted(cs, cs, "left")
+            within = np.arange(cs.size) - starts
+            ok = within < (free_count[cs] - fill[cs])
+            target[idxs[order[ok]]] = cs[ok]
+            np.add.at(fill, cs[ok], 1)
+        placed = np.flatnonzero(target >= 0)
+        if placed.size == 0:
+            self._absorb_stuck_at = len(self._tail)
+            return
+        self._absorb_stuck_at = None
+        # concrete free slot per placed row
+        slots = np.empty(placed.size, np.int64)
+        pos = 0
+        for c in np.unique(target[placed]):
+            rows_c = placed[target[placed] == c]
+            free_js = np.flatnonzero(~live[c * M_pad : (c + 1) * M_pad])
+            js = free_js[: rows_c.size]
+            slots[pos : pos + rows_c.size] = c * M_pad + js
+            pos += rows_c.size
+        # keep (row -> slot) pairing aligned with the per-cluster slot fill
+        order_rows = np.argsort(target[placed], kind="stable")
+        placed = placed[order_rows]
+        d = self.dimension
+        vecs = np.zeros((placed.size, self._d_pad), np.float32)
+        vecs[:, :d] = data[placed]
+        b = _bucket(placed.size)
+        if b > placed.size:
+            slots_p = np.concatenate(
+                [slots, np.repeat(slots[-1], b - placed.size)]
+            )
+            vecs_p = np.concatenate(
+                [vecs, np.repeat(vecs[-1:], b - placed.size, axis=0)]
+            )
+        else:
+            slots_p, vecs_p = slots, vecs
+        self._slabs, self._bias = _absorb_scatter(
+            self._slabs,
+            self._bias,
+            jnp.asarray(slots_p, jnp.int32),
+            jnp.asarray(vecs_p, self.dtype),
+        )
+        live[slots] = True
+        # copy-on-write: an in-flight serve dispatch snapshotted the OLD
+        # keys_by_slot reference; mutating it in place could attribute a
+        # reused slot's dispatch-time score to the newly absorbed key
+        keys_by_slot = self._keys_by_slot.copy()
+        for i, row_i in enumerate(placed):
+            key = tail_keys[int(row_i)]
+            slot = int(slots[i])
+            keys_by_slot[slot] = key
+            self._slot_of_key[key] = slot
+            del self._tail[key]
+        self._keys_by_slot = keys_by_slot
+        self.stats["absorbs"] += 1
+
+    def _tail_snapshot(self) -> Tuple[List[int], np.ndarray, np.ndarray, int]:
+        """Materialize the exact tail for scoring (caller holds the lock):
+        ``(tail_keys, tail_mat [t_pad, d], tail_valid [t_pad], t_pad)``.
+        ``t_pad`` is the bucketed row count (0 = empty tail); pad rows are
+        zero vectors masked invalid so they can never outrank real rows.
+        Shared by host ``search`` and the fused serving path."""
+        tail = [key for key in self._tail if key in self._rows]
+        t_pad = _bucket(len(tail)) if tail else 0
+        tail_mat = (
+            np.stack([self._rows[key] for key in tail])
+            if tail
+            else np.zeros((0, self.dimension), np.float32)
+        )
+        if t_pad > len(tail):
+            tail_mat = np.concatenate(
+                [
+                    tail_mat,
+                    np.zeros((t_pad - len(tail), self.dimension), np.float32),
+                ]
+            )
+        tail_valid = np.zeros(max(t_pad, 1), bool)
+        tail_valid[: len(tail)] = True
+        return tail, tail_mat, tail_valid, t_pad
 
     def _default_probe(self) -> int:
         """Probe count bounding the rescore shortlist: up to 20% of
@@ -324,8 +591,13 @@ class IvfKnnIndex:
             nq = queries.shape[0]
             if nq == 0 or not self._rows:
                 return [[] for _ in range(nq)]
-            if self._needs_rebuild():
+            if self._slabs is None:
+                # first build only: there is nothing to serve from yet.
+                # After that the serve path NEVER rebuilds — staleness is
+                # handled by absorb (in add) + background retrain.
                 self.build()
+            else:
+                self.maybe_retrain_async()
             if self.metric == "cos":
                 norms = np.linalg.norm(queries, axis=1, keepdims=True)
                 queries = queries / np.where(norms == 0, 1.0, norms)
@@ -338,19 +610,7 @@ class IvfKnnIndex:
                     [queries, np.zeros((b - nq, self.dimension), np.float32)]
                 )
             # exact tail of unbuilt recent rows, brute-force scored alongside
-            tail = [key for key in self._tail if key in self._rows]
-            tail_mat = (
-                np.stack([self._rows[key] for key in tail])
-                if tail
-                else np.zeros((0, self.dimension), np.float32)
-            )
-            t_pad = _bucket(len(tail)) if tail else 0
-            if t_pad > len(tail):
-                tail_mat = np.concatenate(
-                    [tail_mat, np.zeros((t_pad - len(tail), self.dimension), np.float32)]
-                )
-            tail_valid = np.zeros(max(t_pad, 1), bool)
-            tail_valid[: len(tail)] = True
+            tail, tail_mat, tail_valid, t_pad = self._tail_snapshot()
             fn = self._search_fn(b, k, p, t_pad)
             q_pad = queries
             if self._d_pad > self.dimension:
