@@ -24,6 +24,7 @@ __all__ = [
     "BaseQuestionAnswerer",
     "BaseRAGQuestionAnswerer",
     "AdaptiveRAGQuestionAnswerer",
+    "DeckRetriever",
     "RAGClient",
     "answer_with_geometric_rag_strategy",
     "answer_with_geometric_rag_strategy_from_index",
@@ -32,10 +33,16 @@ __all__ = [
 NO_ANSWER = "No information found."
 
 
-def _call_chat(llm, prompt: str) -> str:
-    """Call a chat UDF's underlying function synchronously with one prompt."""
+def _call_chat(llm, prompt) -> str:
+    """Call a chat UDF's underlying function synchronously — ``prompt`` is
+    either a plain string or a prepared messages list (vision parsers pass
+    multi-part content through here too)."""
     fn = llm.func
-    messages = [{"role": "user", "content": prompt}]
+    messages = (
+        prompt
+        if isinstance(prompt, list)
+        else [{"role": "user", "content": prompt}]
+    )
     if inspect.iscoroutinefunction(fn):
         return str(asyncio.run(fn(messages)))
     if getattr(llm, "batched", False):
@@ -258,6 +265,76 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
                 answer, dt.STR, args=(this._pw_prompt, this._pw_docs)
             )
         )
+
+
+class DeckRetriever(BaseQuestionAnswerer):
+    """Slide-deck search server (reference: question_answering.py:738) —
+    ``answer_query`` returns the top slides for a prompt instead of an LLM
+    answer; serves the same QA REST surface so clients and templates treat
+    it like any question answerer."""
+
+    excluded_response_metadata = ["b64_image", "image"]
+
+    class AnswerQuerySchema(Schema):
+        prompt: str
+        filters: Optional[str] = column_definition(default_value=None)
+
+    RetrieveQuerySchema = DocumentStore.RetrieveQuerySchema
+    StatisticsQuerySchema = DocumentStore.StatisticsQuerySchema
+    InputsQuerySchema = DocumentStore.InputsQuerySchema
+
+    def __init__(self, indexer: DocumentStore, *, search_topk: int = 6):
+        self.indexer = indexer
+        self.search_topk = search_topk
+        self.server = None
+
+    def answer_query(self, queries: Table) -> Table:
+        """Return slides matching the prompt (no LLM in the loop)."""
+        topk = self.search_topk
+        store = self.indexer
+        enriched = queries.select(
+            query=this.prompt,
+            k=ApplyExpression(lambda *_: topk, dt.INT, args=()),
+            metadata_filter=this.filters,
+            filepath_globpattern=ApplyExpression(lambda *_: None, dt.ANY, args=()),
+        )
+        retrieved = store.retrieve_query(enriched)
+        drop = set(self.excluded_response_metadata)
+
+        def strip(docs):
+            out = []
+            for d in docs or []:
+                d = dict(d)
+                meta = d.get("metadata")
+                if isinstance(meta, dict):
+                    d["metadata"] = {
+                        k: v for k, v in meta.items() if k not in drop
+                    }
+                out.append(d)
+            return out
+
+        return retrieved.select(
+            result=ApplyExpression(strip, dt.ANY, args=(this.result,))
+        )
+
+    def retrieve(self, queries: Table) -> Table:
+        return self.indexer.retrieve_query(queries)
+
+    def statistics(self, queries: Table) -> Table:
+        return self.indexer.statistics_query(queries)
+
+    def list_documents(self, queries: Table) -> Table:
+        return self.indexer.inputs_query(queries)
+
+    def build_server(self, host: str, port: int, **kwargs) -> None:
+        from .servers import QARestServer
+
+        self.server = QARestServer(host, port, self, **kwargs)
+
+    def run_server(self, threaded: bool = False, with_cache: bool = True, **kwargs):
+        if self.server is None:
+            raise RuntimeError("call build_server(host, port) first")
+        return self.server.run(threaded=threaded, with_cache=with_cache, **kwargs)
 
 
 class RAGClient:

@@ -119,3 +119,205 @@ def test_yaml_loader_circular_variables_raise():
 
     with _pytest.raises(ValueError, match="circular"):
         load_yaml(_io.StringIO("$a: $b\n$b: $a\nx: $a\n"))
+
+
+# ---------------------------------------------------------------------------
+# template FLEET (VERDICT r4 #1): each app launched by `pathway-tpu run`
+# and answering a real query end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _launch_template(yaml_path, port):
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", "pathway_tpu.cli", "run", yaml_path,
+         "--port", str(port)],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _post(port, route, payload, timeout):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{route}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_up(proc, port, probe_payload, deadline_s=120):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise AssertionError(f"template app died:\n{out[-3000:]}")
+        try:
+            _post(port, "/v1/retrieve", probe_payload, timeout=5)
+            return
+        except Exception:
+            time.sleep(1.0)
+    raise AssertionError("template server did not come up")
+
+
+@pytest.mark.slow
+def test_demo_question_answering_template_serves_end_to_end():
+    """Reference demo-question-answering app shape
+    (docs/2.developers/7.templates/1000.demo-question-answering.md):
+    retrieve + statistics + list_documents + answer over one YAML app."""
+    port = free_port()
+    proc = _launch_template("templates/demo_question_answering.yaml", port)
+    try:
+        _wait_up(proc, port, {"query": "cats", "k": 1})
+        docs = _post(port, "/v1/retrieve", {"query": "anything", "k": 3}, 60)
+        assert len(docs) == 3 and all("text" in d for d in docs)
+        stats = _post(port, "/v1/statistics", {}, 60)
+        assert stats["file_count"] >= 3, stats
+        listed = _post(port, "/v1/pw_list_documents", {}, 60)
+        assert {d["path"].rsplit("/", 1)[-1] for d in listed} >= {
+            "animals.txt", "dataflow.txt", "tpu.txt"
+        }
+        answer = _post(
+            port, "/v1/pw_ai_answer", {"prompt": "What do cats do?"}, 180
+        )
+        assert isinstance(answer, str) and answer.strip()
+        summary = _post(
+            port, "/v1/pw_ai_summary",
+            {"text_list": ["cats purr", "dogs bark"]}, 180,
+        )
+        assert isinstance(summary, str) and summary.strip()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+@pytest.mark.slow
+def test_multimodal_rag_template_serves_images():
+    """Reference multimodal-rag shape (1003.template-multimodal-rag.md):
+    images become searchable documents via local CLIP labels."""
+    port = free_port()
+    proc = _launch_template("templates/multimodal_rag.yaml", port)
+    try:
+        _wait_up(proc, port, {"query": "red", "k": 1})
+        docs = _post(port, "/v1/retrieve", {"query": "red square", "k": 3}, 60)
+        assert len(docs) == 3
+        # every indexed image chunk carries CLIP labels as searchable text
+        assert all(d["text"] for d in docs), docs
+        paths = {d["metadata"]["path"].rsplit("/", 1)[-1] for d in docs}
+        assert paths == {
+            "red_square.png", "blue_circle.png", "green_stripes.png"
+        }, paths
+        answer = _post(
+            port, "/v1/pw_ai_answer",
+            {"prompt": "Which image shows a red square?"}, 180,
+        )
+        assert isinstance(answer, str) and answer.strip()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+@pytest.mark.slow
+def test_slides_search_template_returns_slides():
+    """Reference slides-search shape (1010.template-slides-search.md):
+    the deck is parsed per slide and /v1/pw_ai_answer returns SLIDES."""
+    port = free_port()
+    proc = _launch_template("templates/slides_search.yaml", port)
+    try:
+        _wait_up(proc, port, {"query": "revenue", "k": 1})
+        slides = _post(
+            port, "/v1/pw_ai_answer", {"prompt": "revenue growth"}, 120
+        )
+        assert isinstance(slides, list) and slides, slides
+        assert all("text" in s and "metadata" in s for s in slides)
+        assert all("slide" in s["metadata"] for s in slides), slides
+        # three slides indexed from one deck
+        stats = _post(port, "/v1/statistics", {}, 60)
+        assert stats["file_count"] == 3, stats
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_kafka_etl_template_unifies_time_zones(monkeypatch):
+    """Reference kafka-etl shape (140.kafka-etl.md): two topics with
+    different time zones unify into one epoch-stamped stream, loaded back
+    to kafka — driven end-to-end over the fake client."""
+    import sys as _sys
+    import types as _types
+
+    sent = []
+
+    class Msg:
+        def __init__(self, partition, offset, value):
+            self.partition = partition
+            self.offset = offset
+            self.value = value
+
+    topics = {
+        "timezone1": [
+            Msg(0, 0, json.dumps({
+                "date": "2024-02-05 10:01:52.884548 -0500",
+                "message": "NYC event",
+            }).encode()),
+        ],
+        "timezone2": [
+            Msg(0, 0, json.dumps({
+                "date": "2024-02-05 16:01:52.884548 +0100",
+                "message": "Paris event",
+            }).encode()),
+        ],
+    }
+
+    class FakeConsumer:
+        def __init__(self, topic, **kw):
+            self._msgs = topics[topic]
+
+        def __iter__(self):
+            return iter(self._msgs)
+
+    class FakeProducer:
+        def __init__(self, **kw):
+            pass
+
+        def send(self, topic, payload):
+            sent.append((topic, json.loads(payload)))
+
+        def flush(self):
+            pass
+
+    mod = _types.ModuleType("kafka")
+    mod.KafkaConsumer = FakeConsumer
+    mod.KafkaProducer = FakeProducer
+    monkeypatch.setitem(_sys.modules, "kafka", mod)
+
+    import pathway_tpu as pw
+
+    pw.reset()
+    _sys.path.insert(0, str(__import__("os").path.join(REPO_ROOT, "templates")))
+    try:
+        import kafka_etl
+
+        kafka_etl.build(
+            {"bootstrap.servers": "broker:9092", "group.id": "g"},
+            "timezone1", "timezone2", "unified",
+        )
+        pw.run(monitoring_level=None, commit_duration_ms=50)
+    finally:
+        _sys.path.pop(0)
+        _sys.modules.pop("kafka_etl", None)
+
+    out = [p for topic, p in sent if topic == "unified"]
+    assert len(out) == 2, sent
+    # both zones collapse to the SAME epoch instant (15:01:52.884 UTC)
+    stamps = {p["timestamp"] for p in out}
+    assert len(stamps) == 1, stamps
+    assert next(iter(stamps)) == pytest.approx(1707145312884.548), stamps
+    assert {p["message"] for p in out} == {"NYC event", "Paris event"}
