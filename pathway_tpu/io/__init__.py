@@ -30,9 +30,11 @@ from . import (
     plaintext,
     postgres,
     pubsub,
+    pyfilesystem,
     python,
     redpanda,
     s3,
+    s3_csv,
     sharepoint,
     slack,
     sqlite,
@@ -62,9 +64,11 @@ __all__ = [
     "plaintext",
     "postgres",
     "pubsub",
+    "pyfilesystem",
     "python",
     "redpanda",
     "s3",
+    "s3_csv",
     "sharepoint",
     "slack",
     "sqlite",

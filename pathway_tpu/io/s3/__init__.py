@@ -64,6 +64,7 @@ def read(
     poll_interval_s: float = 5.0,
     name: str = "s3",
     persistent_id: Optional[str] = None,
+    csv_settings=None,
     **kwargs,
 ) -> Table:
     """Read objects under ``s3://bucket/prefix`` (or ``path`` as prefix with
@@ -101,7 +102,9 @@ def read(
                         tmpdir, f"{os.path.basename(key)}.{digest}"
                     )
                     client.download_file(bucket, key, local)
-                    _parse_into(local, writer, format, schema)
+                    _parse_into(
+                        local, writer, format, schema, csv_settings=csv_settings
+                    )
                     seen[key] = etag
                     if pers is not None:
                         pers.save_offsets(dict(seen))

@@ -671,3 +671,132 @@ def test_elasticsearch_write_bulk(monkeypatch):
     docs = [line for line in lines if "k" in line]
     assert len(ops) == 2 and all(op["index"]["_index"] == "idx" for op in ops)
     assert sorted((d["k"], d["v"]) for d in docs) == [("a", 1), ("b", 2)]
+
+
+# ---------------------------------------------------------------- s3_csv
+
+
+def test_s3_csv_wrapper_reads_csv(monkeypatch):
+    """pw.io.s3_csv delegates to the s3 reader with format=csv
+    (reference: python/pathway/io/s3_csv/__init__.py)."""
+
+    class FakePaginator:
+        def paginate(self, Bucket, Prefix):
+            return [{"Contents": [{"Key": "d/a.csv", "ETag": "x"}]}]
+
+    class FakeClient:
+        def get_paginator(self, op):
+            return FakePaginator()
+
+        def download_file(self, bucket, key, local):
+            with open(local, "w") as f:
+                f.write("k,v\nq,7\n")
+
+    monkeypatch.setitem(
+        sys.modules, "boto3", _module("boto3", client=lambda svc, **kw: FakeClient())
+    )
+    t = pw.io.s3_csv.read("s3://bkt/d/", schema=KV, mode="static")
+    _run()
+    assert_rows(t, [{"k": "q", "v": 7}])
+
+
+# ---------------------------------------------------------------- pyfilesystem
+
+
+class _FakeInfo:
+    def __init__(self, modified, size, name):
+        self.modified = modified
+        self.created = None
+        self.accessed = None
+        self.size = size
+        self.name = name
+
+
+class _FakeFS:
+    """The FS surface pw.io.pyfilesystem uses (walk.files/readbytes/getinfo).
+    Mirrors fs.memoryfs semantics closely enough for the connector logic."""
+
+    def __init__(self, files):
+        import types as _t
+
+        self.files = dict(files)  # path -> (mtime, bytes)
+        self.walk = _t.SimpleNamespace(
+            files=lambda path="": [
+                p for p in sorted(self.files) if p.startswith(path)
+            ]
+        )
+
+    def readbytes(self, p):
+        return self.files[p][1]
+
+    def getinfo(self, p, namespaces=()):
+        import datetime
+
+        mtime, data = self.files[p]
+        return _FakeInfo(
+            datetime.datetime.fromtimestamp(mtime, datetime.timezone.utc),
+            len(data),
+            p.rsplit("/", 1)[-1],
+        )
+
+
+def test_pyfilesystem_static_read_with_metadata():
+    src = _FakeFS({"/docs/a.txt": (100, b"alpha"), "/docs/b.txt": (200, b"beta")})
+    t = pw.io.pyfilesystem.read(
+        src, path="/docs", mode="static", with_metadata=True
+    )
+    rows = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: rows.append(
+            (row["path"], bytes(row["data"]), row["_metadata"], is_addition)
+        ),
+    )
+    _run()
+    assert sorted((p, d) for p, d, _m, add in rows if add) == [
+        ("/docs/a.txt", b"alpha"), ("/docs/b.txt", b"beta"),
+    ]
+    meta = {p: m for p, _d, m, add in rows if add}
+    assert meta["/docs/a.txt"]["size"] == 5
+    assert meta["/docs/a.txt"]["name"] == "a.txt"
+    assert meta["/docs/a.txt"]["modified_at"] == 100
+
+
+def test_pyfilesystem_streaming_upserts_and_deletes():
+    """Changed files upsert (retract old content), deleted files retract —
+    the reference's snapshot-diff contract."""
+    import threading as _t
+    import time as _time
+
+    src = _FakeFS({"/a.txt": (1, b"v1")})
+    t = pw.io.pyfilesystem.read(src, mode="streaming", refresh_interval=0.05)
+    events = []
+    done = _t.Event()
+
+    def on_change(key, row, time, is_addition):
+        events.append((row["path"], bytes(row["data"]), is_addition))
+        if (row["path"], is_addition) == ("/b.txt", False):
+            done.set()
+
+    pw.io.subscribe(t, on_change=on_change)
+
+    def mutate():
+        _time.sleep(0.4)
+        src.files["/a.txt"] = (2, b"v2")      # change
+        src.files["/b.txt"] = (3, b"fresh")   # create
+        _time.sleep(0.4)
+        del src.files["/b.txt"]               # delete
+        done.wait(timeout=20)
+        from pathway_tpu.internals.run import terminate
+
+        terminate()
+
+    mut = _t.Thread(target=mutate, daemon=True)
+    mut.start()
+    pw.run(monitoring_level=None, commit_duration_ms=50)
+    mut.join(timeout=5)
+    assert ("/a.txt", b"v1", True) in events
+    assert ("/a.txt", b"v1", False) in events, "old content not retracted on change"
+    assert ("/a.txt", b"v2", True) in events
+    assert ("/b.txt", b"fresh", True) in events
+    assert ("/b.txt", b"fresh", False) in events, "deleted file not retracted"
