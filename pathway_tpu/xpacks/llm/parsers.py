@@ -17,10 +17,99 @@ __all__ = [
     "PypdfParser",
     "ImageParser",
     "SlideParser",
+    "OpenParse",
     "ParseMarkdown",
 ]
 
 Chunk = Tuple[str, Dict]
+
+DEFAULT_VISION_PROMPT = (
+    "Describe the contents of this image in detail. If it contains a "
+    "table, transcribe the table as markdown."
+)
+
+
+_IMAGE_MAGIC = (
+    (b"\x89PNG", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF8", "image/gif"),
+    (b"RIFF", "image/webp"),
+    (b"BM", "image/bmp"),
+)
+
+
+def _image_mime(data: bytes) -> str:
+    for magic, mime in _IMAGE_MAGIC:
+        if data[: len(magic)] == magic:
+            return mime
+    return "application/octet-stream"
+
+
+def _call_vision_chat(llm, image_bytes: bytes, prompt: str) -> str:
+    """Ask a vision-capable chat model about one image (reference
+    parsers.py:235-396 routes tables/images through vision prompts).  The
+    message shape is the OpenAI multi-part content form every API chat
+    accepts: image_url (base64 data URI, media type sniffed from the
+    payload) + text; dispatch delegates to the shared chat invoker."""
+    import base64
+
+    from .question_answering import _call_chat
+
+    data = bytes(image_bytes)
+    b64 = base64.b64encode(data).decode()
+    mime = _image_mime(data)
+    messages = [
+        {
+            "role": "user",
+            "content": [
+                {
+                    "type": "image_url",
+                    "image_url": {"url": f"data:{mime};base64,{b64}"},
+                },
+                {"type": "text", "text": prompt},
+            ],
+        }
+    ]
+    return _call_chat(llm, messages)
+
+
+def _clip_labeler(labels: Optional[List[str]], clip_model, downsize_to: int):
+    """Shared zero-shot labeling closure for the offline image tiers:
+    returns ``label(img_source) -> (picked_labels, decoded_array | None)``
+    with the text-embedding cache inside.  ``img_source`` is raw bytes (or
+    an already-decoded float array); undecodable inputs yield ([], None)."""
+    clip = clip_model
+    if labels and clip is None:
+        from ...models.clip import ClipModel
+
+        clip = ClipModel(image_size=downsize_to)
+    state: Dict[str, Any] = {"vecs": None}
+
+    def label(img_source, top_k: int):
+        import io as _io
+
+        import numpy as np
+
+        if isinstance(img_source, (bytes, bytearray, memoryview)):
+            try:
+                from PIL import Image
+
+                img = Image.open(_io.BytesIO(img_source)).convert("RGB")
+            except Exception:  # noqa: BLE001 - undecodable image
+                return [], None
+            img = img.resize((downsize_to, downsize_to))
+            arr = np.asarray(img, dtype=np.float32) / 255.0
+        else:
+            arr = img_source
+        if not labels:
+            return [], arr
+        if state["vecs"] is None:
+            state["vecs"] = clip.encode_text(list(labels))
+        img_vec = clip.encode_image([arr])[0]
+        order = (state["vecs"] @ img_vec).argsort()[::-1][:top_k]
+        return [labels[i] for i in order], arr
+
+    return label
 
 
 def _to_text(contents: Any) -> str:
@@ -226,11 +315,12 @@ class PypdfParser(UDF):
 
 
 class ImageParser(UDF):
-    """(reference: parsers.py:396 — vision-LLM image description).  TPU-first
-    redesign: instead of a remote vision LLM, the optional ``labels`` list
-    zero-shot classifies the image with the local CLIP model and emits the
-    top labels as the chunk text (searchable); the decoded ndarray always
-    lands in metadata for the CLIP image-embedding index path."""
+    """(reference: parsers.py:396 — vision-LLM image description).  Two
+    tiers: when a vision-capable chat ``llm`` is configured, the image is
+    described via a vision prompt like the reference does; otherwise the
+    offline tier zero-shot classifies it with the LOCAL CLIP model using
+    the optional ``labels`` list.  The decoded ndarray always lands in
+    metadata for the CLIP image-embedding index path."""
 
     def __init__(
         self,
@@ -238,14 +328,11 @@ class ImageParser(UDF):
         labels: Optional[List[str]] = None,
         clip_model=None,
         top_k_labels: int = 3,
+        llm=None,
+        llm_prompt: str = DEFAULT_VISION_PROMPT,
         **kwargs,
     ):
-        clip = clip_model
-        if labels and clip is None:
-            from ...models.clip import ClipModel
-
-            clip = ClipModel(image_size=downsize_to)
-        label_vecs = None
+        labeler = _clip_labeler(labels if llm is None else None, clip_model, downsize_to)
 
         def parse(contents: bytes) -> List[Chunk]:
             import io
@@ -261,14 +348,12 @@ class ImageParser(UDF):
             arr = np.asarray(img, dtype=np.float32) / 255.0
             text = ""
             meta: Dict[str, Any] = {"image": arr}
-            if labels:
-                nonlocal label_vecs
-                if label_vecs is None:
-                    label_vecs = clip.encode_text(list(labels))
-                img_vec = clip.encode_image([arr])[0]
-                scores = label_vecs @ img_vec
-                order = scores.argsort()[::-1][:top_k_labels]
-                picked = [labels[i] for i in order]
+            if llm is not None:
+                # vision tier: the ORIGINAL bytes go to the model (the
+                # downsized array is only for the CLIP embedding path)
+                text = _call_vision_chat(llm, contents, llm_prompt)
+            elif labels:
+                picked, _ = labeler(arr, top_k_labels)
                 text = ", ".join(picked)
                 meta["labels"] = picked
             return [(text, meta)]
@@ -313,12 +398,12 @@ def _pdf_slide_scan(contents: bytes):
 
 
 class SlideParser(UDF):
-    """Slide decks (PDF exports) parsed fully offline — the TPU-first
-    redesign of the reference's vision-LLM SlideParser (parsers.py:569,
-    which rasterizes slides and asks a remote vision model to describe
-    them): per-slide text chunks come from the pure-python PDF extractor,
-    and embedded slide images are zero-shot labeled with the local CLIP
-    model (like ImageParser) so image-only slides stay searchable."""
+    """Slide decks (PDF exports).  Per-slide text chunks come from the
+    pure-python PDF extractor; embedded slide images go through the vision
+    chat ``llm`` when one is configured (the reference's tier,
+    parsers.py:569 — rasterize and ask a vision model), and are otherwise
+    zero-shot labeled with the LOCAL CLIP model so image-only slides stay
+    searchable fully offline."""
 
     def __init__(
         self,
@@ -326,18 +411,13 @@ class SlideParser(UDF):
         clip_model=None,
         top_k_labels: int = 3,
         downsize_to: int = 64,
+        llm=None,
+        llm_prompt: str = DEFAULT_VISION_PROMPT,
         **kwargs,
     ):
-        clip = clip_model
-        if labels and clip is None:
-            from ...models.clip import ClipModel
-
-            clip = ClipModel(image_size=downsize_to)
-        label_vecs = None
+        labeler = _clip_labeler(labels if llm is None else None, clip_model, downsize_to)
 
         def parse(contents: bytes) -> List[Chunk]:
-            import io as _io
-
             slide_text: Dict[int, List[str]] = {}
             slide_labels: Dict[int, List[str]] = {}
             for kind, slide, payload in _pdf_slide_scan(bytes(contents)):
@@ -345,26 +425,16 @@ class SlideParser(UDF):
                     if payload:
                         slide_text.setdefault(slide, []).append(payload)
                     continue
+                if llm is not None:
+                    desc = _call_vision_chat(llm, payload, llm_prompt)
+                    if desc:
+                        slide_labels.setdefault(slide, []).append(desc)
+                    continue
                 if not labels:
                     continue
-                try:
-                    from PIL import Image
-
-                    import numpy as np
-
-                    img = Image.open(_io.BytesIO(payload)).convert("RGB")
-                except Exception:  # noqa: BLE001 - undecodable image
-                    continue
-                img = img.resize((downsize_to, downsize_to))
-                arr = np.asarray(img, dtype=np.float32) / 255.0
-                nonlocal label_vecs
-                if label_vecs is None:
-                    label_vecs = clip.encode_text(list(labels))
-                img_vec = clip.encode_image([arr])[0]
-                order = (label_vecs @ img_vec).argsort()[::-1][:top_k_labels]
-                slide_labels.setdefault(slide, []).extend(
-                    labels[i] for i in order
-                )
+                picked, _arr = labeler(payload, top_k_labels)
+                if picked:
+                    slide_labels.setdefault(slide, []).extend(picked)
             out: List[Chunk] = []
             for slide in sorted(set(slide_text) | set(slide_labels)):
                 text = " ".join(slide_text.get(slide, []))
@@ -376,6 +446,62 @@ class SlideParser(UDF):
                     meta["labels"] = picked
                 if text:
                     out.append((text, meta))
+            return out
+
+        super().__init__(parse, **kwargs)
+
+
+class OpenParse(UDF):
+    """Structured PDF parsing with a vision-LLM tier (reference:
+    parsers.py:235 — OpenParse extracts text nodes plus tables/images via
+    vision prompts when ``parse_images``/table args are enabled).
+
+    Tiers here: text nodes always come from the pure-python PDF extractor;
+    embedded images become their own chunks — described by the vision chat
+    ``llm`` when configured (``parse_images=True``), zero-shot labeled by
+    the LOCAL CLIP model when only ``labels`` is given, and skipped
+    otherwise.  Each chunk carries its page/slide index and node kind in
+    metadata."""
+
+    def __init__(
+        self,
+        llm=None,
+        parse_images: bool = False,
+        image_prompt: str = DEFAULT_VISION_PROMPT,
+        labels: Optional[List[str]] = None,
+        clip_model=None,
+        top_k_labels: int = 3,
+        downsize_to: int = 64,
+        **kwargs,
+    ):
+        if parse_images and llm is None and not labels:
+            raise ValueError(
+                "OpenParse(parse_images=True) needs a vision `llm` or "
+                "CLIP `labels` to turn images into text"
+            )
+        labeler = _clip_labeler(labels if llm is None else None, clip_model, downsize_to)
+
+        def parse(contents: bytes) -> List[Chunk]:
+            out: List[Chunk] = []
+            for kind, page, payload in _pdf_slide_scan(bytes(contents)):
+                if kind == "text":
+                    if payload:
+                        out.append((payload, {"page": page, "kind": "text"}))
+                    continue
+                if not parse_images:
+                    continue
+                if llm is not None:
+                    desc = _call_vision_chat(llm, payload, image_prompt)
+                    if desc:
+                        out.append(
+                            (desc, {"page": page, "kind": "image"})
+                        )
+                    continue
+                picked, _arr = labeler(payload, top_k_labels)
+                if picked:
+                    out.append(
+                        (", ".join(picked), {"page": page, "kind": "image", "labels": picked})
+                    )
             return out
 
         super().__init__(parse, **kwargs)

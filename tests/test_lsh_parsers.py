@@ -159,3 +159,116 @@ def test_slide_parser_offline():
     chunks = SlideParser().__wrapped__(pdf)
     assert [meta["slide"] for _t, meta in chunks] == [0, 1]
     assert "Quarterly" in chunks[0][0] and "Roadmap" in chunks[1][0]
+
+
+class _FakeVisionChat:
+    """A vision-capable chat double: asserts the multi-part message shape
+    (base64 image_url + text prompt) and returns a canned description."""
+
+    batched = False
+
+    def __init__(self):
+        self.calls = []
+
+    def func(self, messages):
+        assert len(messages) == 1 and messages[0]["role"] == "user"
+        content = messages[0]["content"]
+        kinds = [part["type"] for part in content]
+        assert kinds == ["image_url", "text"], kinds
+        url = content[0]["image_url"]["url"]
+        assert url.startswith("data:image/"), url[:40]
+        import base64
+
+        mime = url.split(";", 1)[0][len("data:"):]
+        raw = base64.b64decode(url.split(",", 1)[1])
+        # the declared media type must match the payload's magic bytes
+        if raw[:3] == b"\xff\xd8\xff":
+            assert mime == "image/jpeg", mime
+        elif raw[:4] == b"\x89PNG":
+            assert mime == "image/png", mime
+        self.calls.append((raw[:3], content[1]["text"]))
+        return "a bar chart of quarterly revenue"
+
+
+def test_image_parser_vision_llm_tier():
+    """VERDICT r4 #10: when a vision chat is configured, images are parsed
+    via vision prompts (reference parsers.py:235-396); CLIP is the offline
+    fallback."""
+    pytest.importorskip("PIL")
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (40, 40), (10, 120, 200)).save(buf, format="PNG")
+    raw = buf.getvalue()
+
+    chat = _FakeVisionChat()
+    parser = ImageParser(downsize_to=32, llm=chat)
+    text, meta = parser.func(raw)[0]
+    assert text == "a bar chart of quarterly revenue"
+    assert chat.calls and "Describe" in chat.calls[0][1]
+    assert meta["image"].shape == (32, 32, 3)
+
+
+def test_openparse_text_and_vision_image_nodes():
+    """OpenParse emits per-page text nodes plus vision-described image
+    nodes when parse_images=True and a vision llm is configured."""
+    import io
+    import zlib
+
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    from pathway_tpu.xpacks.llm.parsers import OpenParse
+
+    parts = [b"%PDF-1.4\n"]
+    s = zlib.compress(b"BT (Revenue table below) Tj ET")
+    parts.append(
+        b"1 0 obj << /Filter /FlateDecode >>\nstream\n" + s + b"\nendstream\nendobj\n"
+    )
+    buf = io.BytesIO()
+    Image.new("RGB", (32, 32), (200, 50, 50)).save(buf, format="JPEG")
+    jpeg = buf.getvalue()
+    parts.append(b"2 0 obj << >>\nstream\n" + jpeg + b"\nendstream\nendobj\n")
+    parts.append(b"%%EOF\n")
+    pdf = b"".join(parts)
+
+    chat = _FakeVisionChat()
+    parser = OpenParse(llm=chat, parse_images=True)
+    chunks = parser.__wrapped__(pdf)
+    kinds = [(m["kind"], t) for t, m in chunks]
+    assert ("text", "Revenue table below") in kinds
+    assert ("image", "a bar chart of quarterly revenue") in kinds
+    assert chat.calls[0][0] == b"\xff\xd8\xff", "original jpeg bytes must reach the llm"
+
+    # gated: parse_images without any vision/label tier is a config error
+    with pytest.raises(ValueError, match="vision"):
+        OpenParse(parse_images=True)
+
+
+def test_slide_parser_vision_llm_tier():
+    import io
+    import zlib
+
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    parts = [b"%PDF-1.4\n"]
+    s = zlib.compress(b"BT (Q3 results) Tj ET")
+    parts.append(
+        b"1 0 obj << /Filter /FlateDecode >>\nstream\n" + s + b"\nendstream\nendobj\n"
+    )
+    buf = io.BytesIO()
+    Image.new("RGB", (32, 32), (50, 200, 50)).save(buf, format="JPEG")
+    parts.append(b"2 0 obj << >>\nstream\n" + buf.getvalue() + b"\nendstream\nendobj\n")
+    parts.append(b"%%EOF\n")
+
+    chat = _FakeVisionChat()
+    chunks = SlideParser(llm=chat).__wrapped__(b"".join(parts))
+    assert len(chunks) == 1
+    text, meta = chunks[0]
+    assert "Q3 results" in text
+    assert "a bar chart of quarterly revenue" in text
