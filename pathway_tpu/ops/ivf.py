@@ -81,6 +81,37 @@ def _kmeans(
 from functools import partial
 
 
+def _balanced_assign(order: np.ndarray, C: int, cap: int):
+    """Balanced nearest-centroid assignment under a per-cluster cap:
+    rows competing for one cluster are ranked by sort position and the
+    first (cap - fill) win; losers retry at their next preference.
+    ``order`` is [N, n_pref] centroid preferences.  Returns
+    (assignment [N], counts [C])."""
+    n, n_pref = order.shape
+    counts = np.zeros(C, np.int64)
+    assignment = np.full(n, -1, np.int64)
+    unassigned = np.arange(n)
+    for r in range(n_pref):
+        if unassigned.size == 0:
+            break
+        cand = order[unassigned, r]
+        sort_ix = np.argsort(cand, kind="stable")
+        cand_sorted = cand[sort_ix]
+        # within-cluster arrival rank of each competing row
+        starts = np.searchsorted(cand_sorted, cand_sorted, side="left")
+        within = np.arange(cand_sorted.size) - starts
+        accept = within < (cap - counts[cand_sorted])
+        winners = unassigned[sort_ix[accept]]
+        assignment[winners] = cand_sorted[accept]
+        np.add.at(counts, cand_sorted[accept], 1)
+        unassigned = unassigned[sort_ix[~accept]]
+    for i in unassigned:  # rare: all preferred clusters full
+        c = int(np.argmin(counts))
+        assignment[i] = c
+        counts[c] += 1
+    return assignment, counts
+
+
 @partial(jax.jit, static_argnums=(2,))
 def _tail_prefs(rows, centroids, n_pref):
     """Per-row top-``n_pref`` centroid preferences for absorb assignment."""
@@ -181,7 +212,12 @@ class IvfKnnIndex:
         self.stats = {"sync_builds": 0, "retrains": 0, "absorbs": 0}
 
     def __len__(self) -> int:
-        return len(self._rows)
+        # built live keys + unbuilt tail — counts correctly both for the
+        # host-of-record path (_rows holds everything) and for
+        # build_from_matrix (corpus stays on device; _rows holds only tail)
+        if self._slabs is None:
+            return len(self._rows)
+        return len(self._slot_of_key) + len(self._tail)
 
     # -- mutation (host-of-record; device rebuilt lazily) ------------------
     def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
@@ -192,7 +228,13 @@ class IvfKnnIndex:
             if self.metric == "cos":
                 norms = np.linalg.norm(vectors, axis=1, keepdims=True)
                 vectors = vectors / np.where(norms == 0, 1.0, norms)
-            existing = [int(k) for k in keys if int(k) in self._rows]
+            # membership check covers BOTH stores: host rows and (after
+            # build_from_matrix) device-only bulk keys known via their slot
+            existing = [
+                int(k)
+                for k in keys
+                if int(k) in self._rows or int(k) in self._slot_of_key
+            ]
             self._forget_built(existing)
             for key, vec in zip(keys, vectors):
                 key = int(key)
@@ -212,9 +254,12 @@ class IvfKnnIndex:
 
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:
-            dropped = [
-                int(k) for k in keys if self._rows.pop(int(k), None) is not None
-            ]
+            dropped = []
+            for k in keys:
+                k = int(k)
+                in_rows = self._rows.pop(k, None) is not None
+                if in_rows or k in self._slot_of_key:
+                    dropped.append(k)
             self._forget_built(dropped)
 
     def _forget_built(self, keys: Sequence[int]) -> None:
@@ -267,6 +312,11 @@ class IvfKnnIndex:
                 self._slabs is None
                 or self._retraining
                 or not self._needs_rebuild()
+                # build_from_matrix keeps the corpus on device; the host
+                # row store only holds the streamed tail, so a host-side
+                # retrain would DROP the bulk — skip until a full
+                # host-of-record exists (or build_from_matrix is re-run)
+                or len(self._rows) < len(self)
             ):
                 return
             self._retraining = True
@@ -345,27 +395,7 @@ class IvfKnnIndex:
             else:
                 parts.append(np.asarray(_prefs(jnp.asarray(chunk))))
         order = np.concatenate(parts) if len(parts) > 1 else parts[0]
-        counts = np.zeros(C, np.int64)
-        assignment = np.full(n, -1, np.int64)
-        unassigned = np.arange(n)
-        for r in range(n_pref):
-            if unassigned.size == 0:
-                break
-            cand = order[unassigned, r]
-            sort_ix = np.argsort(cand, kind="stable")
-            cand_sorted = cand[sort_ix]
-            # within-cluster arrival rank of each competing row
-            starts = np.searchsorted(cand_sorted, cand_sorted, side="left")
-            within = np.arange(cand_sorted.size) - starts
-            accept = within < (cap - counts[cand_sorted])
-            winners = unassigned[sort_ix[accept]]
-            assignment[winners] = cand_sorted[accept]
-            np.add.at(counts, cand_sorted[accept], 1)
-            unassigned = unassigned[sort_ix[~accept]]
-        for i in unassigned:  # rare: all 8 preferred clusters full
-            c = int(np.argmin(counts))
-            assignment[i] = c
-            counts[c] += 1
+        assignment, counts = _balanced_assign(order, C, cap)
         # CLUSTER-SORTED SLAB LAYOUT: rows of one cluster are contiguous
         # and padded to [C_pad, M_pad, d_pad], so the rescore reads each
         # probed cluster as ONE sequential DMA (ops/ivf_pallas.py) —
@@ -569,6 +599,118 @@ class IvfKnnIndex:
         tail_valid[: len(tail)] = True
         return tail, tail_mat, tail_valid, t_pad
 
+    def build_from_matrix(self, keys: Sequence[int], matrix_dev) -> None:
+        """Bulk build directly from a DEVICE-RESIDENT row matrix [n, d]
+        (e.g. the exact DeviceKnnIndex's HBM store) — the corpus never
+        crosses the host link (VERDICT r4 #7).  Host transfers are only:
+        the k-means training sample (one gather+fetch), the [n, n_pref]
+        assignment preferences, and the layout index uploads; the slab
+        scatter itself is a device gather.
+
+        The host row store afterwards holds only streamed tail rows, so
+        the background retrain is disabled until a full host-of-record
+        exists (absorb + exact-tail streaming maintenance still work)."""
+        n = int(matrix_dev.shape[0])
+        keys = [int(k) for k in keys]
+        assert len(keys) == n
+        d = self.dimension
+        C = self.n_clusters or int(np.clip(np.ceil(n / 120.0), 16, 65536))
+        rng = np.random.default_rng(self.seed)
+        sample_n = min(n, max(self.train_sample, 8 * C))
+        C = min(C, n, sample_n)
+        sample_idx = np.sort(rng.choice(n, size=sample_n, replace=False))
+        sample = np.asarray(
+            jnp.take(matrix_dev, jnp.asarray(sample_idx), axis=0),
+            np.float32,
+        )
+        if self.metric == "cos":
+            norms = np.linalg.norm(sample, axis=1, keepdims=True)
+            sample = sample / np.where(norms == 0, 1.0, norms)
+        centroids = _kmeans(sample, C, self.kmeans_iters, self.seed)
+
+        cap = max(1, int(np.ceil(2.0 * n / C)))
+        n_pref = min(8, C)
+        cents_dev = jnp.asarray(centroids.T)
+
+        @jax.jit
+        def _prefs(chunk_rows):
+            rows = chunk_rows.astype(jnp.float32)
+            if self.metric == "cos":
+                rows = rows / jnp.maximum(
+                    jnp.linalg.norm(rows, axis=-1, keepdims=True), 1e-9
+                )
+            s = jnp.dot(rows, cents_dev, preferred_element_type=jnp.float32)
+            _, idx = jax.lax.top_k(s, n_pref)
+            return idx
+
+        parts = []
+        step = 131072
+        for start in range(0, n, step):
+            m = min(step, n - start)
+            chunk = jax.lax.dynamic_slice_in_dim(matrix_dev, start, m, 0) \
+                if m == step else matrix_dev[start : start + m]
+            parts.append(np.asarray(_prefs(chunk)))
+        order = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        assignment, counts = _balanced_assign(order, C, cap)
+
+        M = int(counts.max())
+        M_pad = max(128, ((M + 127) // 128) * 128)
+        d_pad = ((d + 127) // 128) * 128
+        C_pad = ((C + 7) // 8) * 8
+        keys_arr = np.asarray(keys, dtype=np.uint64)
+        order_by_cluster = np.argsort(assignment, kind="stable")
+        sorted_cluster = assignment[order_by_cluster]
+        starts = np.searchsorted(sorted_cluster, sorted_cluster, "left")
+        j_within = np.arange(n) - starts
+        slots = sorted_cluster * M_pad + j_within
+
+        # slab layout as ONE device gather+scatter — no host copy of rows
+        @jax.jit
+        def _layout(matrix, order_ix, slot_ix):
+            rows = jnp.take(matrix, order_ix, axis=0).astype(jnp.float32)
+            if self.metric == "cos":
+                rows = rows / jnp.maximum(
+                    jnp.linalg.norm(rows, axis=-1, keepdims=True), 1e-9
+                )
+            if d_pad > d:
+                rows = jnp.concatenate(
+                    [rows, jnp.zeros((rows.shape[0], d_pad - d), rows.dtype)],
+                    axis=1,
+                )
+            flat = jnp.zeros((C_pad * M_pad, d_pad), self.dtype)
+            return flat.at[slot_ix].set(rows.astype(self.dtype)).reshape(
+                C_pad, M_pad, d_pad
+            )
+
+        slabs = _layout(
+            matrix_dev,
+            jnp.asarray(order_by_cluster, jnp.int32),
+            jnp.asarray(slots, jnp.int32),
+        )
+        bias = np.full(C_pad * M_pad, -np.inf, np.float32)
+        bias[slots] = 0.0
+        keys_by_slot = np.zeros(C_pad * M_pad, dtype=np.uint64)
+        sorted_keys = keys_arr[order_by_cluster]
+        keys_by_slot[slots] = sorted_keys
+        live_mask = np.zeros(C_pad * M_pad, dtype=bool)
+        live_mask[slots] = True
+        with self._lock:
+            self._keys_by_slot = keys_by_slot
+            self._slot_of_key = dict(
+                zip(sorted_keys.tolist(), slots.tolist())
+            )
+            self._live_mask = live_mask
+            self._slabs = slabs
+            self._bias = jnp.asarray(bias.reshape(C_pad, M_pad))
+            self._centroids = jnp.asarray(centroids)
+            self._M_pad = M_pad
+            self._d_pad = d_pad
+            self._tail = {k: None for k in self._rows if k not in self._slot_of_key}
+            self._built_n = n
+            self._absorb_stuck_at = None
+            self._search_fns.clear()
+            self.stats["sync_builds"] += 1
+
     def _default_probe(self) -> int:
         """Probe count bounding the rescore shortlist: up to 20% of
         clusters for small corpora (coarse clusters need generous probing
@@ -589,7 +731,7 @@ class IvfKnnIndex:
         with self._lock:
             queries = np.asarray(queries, np.float32).reshape(-1, self.dimension)
             nq = queries.shape[0]
-            if nq == 0 or not self._rows:
+            if nq == 0 or len(self) == 0:
                 return [[] for _ in range(nq)]
             if self._slabs is None:
                 # first build only: there is nothing to serve from yet.
@@ -646,7 +788,7 @@ class IvfKnnIndex:
                     if not np.isfinite(s) or slot < 0:
                         continue
                     key = int(self._keys_by_slot[slot])
-                    if key in self._rows and key in self._slot_of_key:
+                    if key in self._slot_of_key:
                         row.append((key, s))
                 if t_pad:
                     for j in range(t_idx.shape[1]):
@@ -736,7 +878,7 @@ class IvfKnnIndex:
     def score_flops_fraction(self) -> float:
         """Fraction of brute-force scoring FLOPs a probed search performs
         (centroid matmul + shortlist rescore vs full matrix)."""
-        if self._slabs is None or not len(self._rows):
+        if self._slabs is None or len(self) == 0:
             return 1.0
         C = self._centroids.shape[0]
         M = self._M_pad

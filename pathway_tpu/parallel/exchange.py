@@ -285,13 +285,17 @@ class ExchangePlane:
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
-                if self._dead is not None:
-                    raise self._dead
+                # drain BEFORE checking for death: a peer that sent its
+                # final frame and exited cleanly must not abort a
+                # collective whose data already arrived (TCP delivers the
+                # frame before the EOF, so the inbox is authoritative)
                 for p in peers:
                     if p not in out and (edge, seq, p) in self._inbox:
                         out[p] = self._inbox.pop((edge, seq, p))
                 if len(out) == len(peers):
                     return out
+                if self._dead is not None:
+                    raise self._dead
                 now = time.monotonic()
                 stalled = [
                     p

@@ -430,3 +430,74 @@ def test_upsert_and_remove_during_background_retrain_reconciled():
     assert all(key != 7 for key, _ in got[0]), "removed key resurrected"
     got9 = index.search(-data[9:10], k=1)
     assert got9[0][0][0] == 9, "upsert lost: old vector served after retrain"
+
+
+def test_build_from_device_matrix_matches_host_build():
+    """build_from_matrix (VERDICT r4 #7: corpus never crosses the host
+    link) must serve the same results as the host-of-record build, and
+    streaming tail maintenance must keep working on a device-built index."""
+    import jax.numpy as jnp
+
+    n, dim = 8192, 32
+    data = clustered_corpus(n, dim, n_centers=64, seed=6)
+    dn = data / np.linalg.norm(data, axis=1, keepdims=True)
+
+    host_ix = IvfKnnIndex(
+        dimension=dim, metric="cos", n_clusters=64, n_probe=16, seed=9
+    )
+    host_ix.add(range(n), data)
+    host_ix.build()
+
+    dev_ix = IvfKnnIndex(
+        dimension=dim, metric="cos", n_clusters=64, n_probe=16, seed=9
+    )
+    dev_ix.build_from_matrix(range(n), jnp.asarray(dn))
+    assert len(dev_ix) == n
+
+    rng = np.random.default_rng(4)
+    queries = data[rng.choice(n, 32, replace=False)]
+    got_host = host_ix.search(queries, k=10)
+    got_dev = dev_ix.search(queries, k=10)
+    # same seed + same rows => same centroids => identical result sets
+    overlap = sum(
+        len({k for k, _ in a} & {k for k, _ in b})
+        for a, b in zip(got_host, got_dev)
+    ) / (32 * 10)
+    assert overlap >= 0.95, overlap
+
+    # streaming adds are served as-of-now; the host-side retrain stays
+    # disabled (the bulk rows are not in the host row store)
+    fresh = clustered_corpus(256, dim, n_centers=64, seed=12)
+    dev_ix.add(range(n, n + 256), fresh)
+    hit = dev_ix.search(fresh[:1], k=3)
+    assert hit[0][0][0] == n
+    dev_ix.maybe_retrain_async()
+    assert not dev_ix._retraining
+
+
+def test_device_built_remove_and_upsert():
+    """remove() and add()-upsert must act on bulk keys known only via
+    their slot (build_from_matrix keeps the corpus on device), not just on
+    host-of-record rows."""
+    import jax.numpy as jnp
+
+    n, dim = 2048, 16
+    data = clustered_corpus(n, dim, n_centers=32, seed=2)
+    dn = data / np.linalg.norm(data, axis=1, keepdims=True)
+    ix = IvfKnnIndex(dimension=dim, metric="cos", n_clusters=16, n_probe=8)
+    ix.build_from_matrix(range(n), jnp.asarray(dn))
+
+    # remove a bulk-built key: it must stop being served and len shrinks
+    assert ix.search(data[5:6], k=1)[0][0][0] == 5
+    ix.remove([5])
+    assert len(ix) == n - 1
+    got = ix.search(data[5:6], k=3)
+    assert all(key != 5 for key, _ in got[0]), got[0]
+
+    # upsert a bulk-built key: the NEW vector must win, no double count
+    ix.add([7], -data[7:8])
+    assert len(ix) == n - 1  # 7 moved from slabs to tail, not duplicated
+    got7 = ix.search(-data[7:8], k=1)
+    assert got7[0][0][0] == 7
+    old7 = ix.search(data[7:8], k=3)
+    assert all(key != 7 for key, _ in old7[0]), "stale vector still served"
