@@ -72,7 +72,7 @@ class SelfAttention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, segments=None):
         cfg = self.config
         B, L, D = x.shape
         head_dim = cfg.d_model // cfg.n_heads
@@ -94,6 +94,13 @@ class SelfAttention(nn.Module):
         k = k.reshape(B, L, cfg.n_heads, head_dim)
         v = v.reshape(B, L, cfg.n_heads, head_dim)
         if cfg.sequence_axis is not None and cfg.mesh is not None:
+            # sequence packing and sequence sharding are mutually
+            # exclusive: the ring walks one logical sequence, and packed
+            # rows would attend across document boundaries undetected
+            assert segments is None, (
+                "packed (segments) forward is not supported with "
+                "ring/sequence-parallel attention"
+            )
             from ..ops.ring_attention import ring_attention_sharded
 
             positions = jnp.broadcast_to(jnp.arange(L)[None, :], (B, L))
@@ -110,7 +117,14 @@ class SelfAttention(nn.Module):
             return proj("out", ("heads", "embed"))(out)
         scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(head_dim)
         big_neg = jnp.finfo(jnp.float32).min
-        attn_mask = mask[:, None, None, :]  # [B,1,1,L] key mask
+        if segments is not None:
+            # PACKED rows: token l attends token m iff both belong to the
+            # SAME nonzero segment (block-diagonal attention) — several
+            # short documents share one row with exact per-doc semantics
+            same = segments[:, None, :, None] == segments[:, None, None, :]
+            attn_mask = same & (segments[:, None, None, :] > 0)
+        else:
+            attn_mask = mask[:, None, None, :]  # [B,1,1,L] key mask
         if cfg.causal:
             causal = jnp.tril(jnp.ones((L, L), dtype=bool))
             attn_mask = attn_mask * causal[None, None, :, :]
@@ -124,10 +138,10 @@ class EncoderBlock(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, segments=None):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype)(x)
-        x = x + SelfAttention(cfg)(h, mask)
+        x = x + SelfAttention(cfg)(h, mask, segments)
         h = nn.LayerNorm(dtype=cfg.dtype)(x)
         x = x + MlpBlock(cfg)(h)
         return x
@@ -139,7 +153,18 @@ class TransformerEncoder(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, ids, mask):
+    def __call__(self, ids, mask, segments=None, positions=None, n_segments=0):
+        """Unpacked: ``(ids, mask) -> [B, d]`` pooled embeddings.
+
+        PACKED (sequence packing — several short documents share one row,
+        the TPU-idiomatic answer to variable-length corpora): pass
+        ``segments`` [B, L] (0 = pad, 1..n_segments = document within the
+        row), ``positions`` [B, L] (restarting per document so positional
+        embeddings match the unpacked encoding), and static
+        ``n_segments``; returns ``[B, n_segments, d]`` per-document
+        embeddings (zero rows for absent segments).  Attention is
+        block-diagonal per segment, so results equal the unpacked
+        forward up to dtype accumulation order."""
         cfg = self.config
         B, L = ids.shape
         tok = nn.Embed(
@@ -151,6 +176,8 @@ class TransformerEncoder(nn.Module):
             ),
             name="tok_embed",
         )(ids)
+        if positions is None:
+            positions = jnp.arange(L)[None, :]
         pos = nn.Embed(
             cfg.max_len,
             cfg.d_model,
@@ -159,11 +186,26 @@ class TransformerEncoder(nn.Module):
                 nn.initializers.normal(0.02), ("pos", "embed")
             ),
             name="pos_embed",
-        )(jnp.arange(L)[None, :])
+        )(positions)
         x = tok + pos
         for i in range(cfg.n_layers):
-            x = EncoderBlock(cfg, name=f"block_{i}")(x, mask)
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, mask, segments)
         x = nn.LayerNorm(dtype=cfg.dtype, name="final_ln")(x)
+        if segments is not None:
+            # per-segment masked mean pool as ONE matmul per row:
+            # onehot [B, L, S] x hidden [B, L, d] -> [B, S, d]
+            assert n_segments > 0, "packed forward needs static n_segments"
+            assert cfg.pool == "mean", (
+                f"packed forward implements mean pooling only (pool="
+                f"{cfg.pool!r} would silently change semantics)"
+            )
+            seg_ids = jnp.arange(1, n_segments + 1)
+            onehot = (segments[:, :, None] == seg_ids[None, None, :]).astype(
+                x.dtype
+            )
+            summed = jnp.einsum("bls,bld->bsd", onehot, x)
+            counts = jnp.maximum(jnp.sum(onehot, axis=1), 1.0)[:, :, None]
+            return (summed / counts).astype(jnp.float32)
         if cfg.pool == "none":
             return x
         if cfg.pool == "cls":

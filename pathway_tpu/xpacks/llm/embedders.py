@@ -46,6 +46,7 @@ class TpuEmbedder(BaseEmbedder):
         checkpoint_path: Optional[str] = None,
         mesh=None,
         call_kwargs: dict | None = None,
+        packed: bool = True,
         **kwargs,
     ):
         from ...models.encoder import SentenceEncoder
@@ -60,8 +61,19 @@ class TpuEmbedder(BaseEmbedder):
         )
         encoder = self._encoder
 
-        def embed(texts) -> np.ndarray:
-            return encoder.encode(list(texts))
+        if packed and mesh is None:
+            # sequence packing: short docs share rows under block-diagonal
+            # attention (models/encoder.py) — same embeddings, much better
+            # MXU utilization on variable-length micro-batches.  Packing
+            # reshapes rows, so the mesh-sharded path keeps plain batches.
+            def embed(texts) -> np.ndarray:
+                out = encoder.encode_packed_to_device(list(texts))
+                return np.asarray(out, dtype=np.float32)
+
+        else:
+
+            def embed(texts) -> np.ndarray:
+                return encoder.encode(list(texts))
 
         super().__init__(embed, batched=True, **kwargs)
 
