@@ -580,9 +580,18 @@ class IvfKnnIndex:
         ``(tail_keys, tail_mat [t_pad, d], tail_valid [t_pad], t_pad)``.
         ``t_pad`` is the bucketed row count (0 = empty tail); pad rows are
         zero vectors masked invalid so they can never outrank real rows.
-        Shared by host ``search`` and the fused serving path."""
+        Shared by host ``search`` and the fused serving path.
+
+        A nonempty tail pads to at least ``absorb_threshold`` rows: the
+        steady-state tail oscillates below the threshold, so this keeps
+        the serving kernel at ONE compile shape instead of recompiling at
+        every /256 tail bucket a stream passes through."""
         tail = [key for key in self._tail if key in self._rows]
-        t_pad = _bucket(len(tail)) if tail else 0
+        t_pad = (
+            _bucket(max(len(tail), min(self.absorb_threshold, 8192)))
+            if tail
+            else 0
+        )
         tail_mat = (
             np.stack([self._rows[key] for key in tail])
             if tail
