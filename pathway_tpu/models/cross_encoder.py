@@ -27,11 +27,20 @@ class _CrossEncoderModule(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, ids, mask):
-        pooled = TransformerEncoder(self.config, name="trunk")(ids, mask)
+    def __call__(self, ids, mask, segments=None, positions=None, n_segments=0):
+        """Unpacked: ``(ids, mask) -> [B]`` pair scores.  PACKED (several
+        short (query, doc) pairs share one row under block-diagonal
+        segment attention — models/transformer.py): pass ``segments`` /
+        ``positions`` / static ``n_segments`` and the per-segment pooled
+        states come back as ``[B, n_segments, d]``, so the regression head
+        scores every packed pair in the same two matmuls."""
+        pooled = TransformerEncoder(self.config, name="trunk")(
+            ids, mask, segments=segments, positions=positions,
+            n_segments=n_segments,
+        )
         h = nn.Dense(self.config.d_model, name="head_dense")(pooled)
         h = nn.tanh(h)
-        return nn.Dense(1, name="head_out")(h)[:, 0]
+        return nn.Dense(1, name="head_out")(h)[..., 0]
 
 
 class CrossEncoderModel:
@@ -98,32 +107,138 @@ class CrossEncoderModel:
             self._fns[shape] = fn
         return fn
 
-    def predict(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
-        """[(query, doc)] -> scores [B] float32."""
+    def predict(
+        self, pairs: Sequence[Tuple[str, str]], packed: Optional[bool] = None
+    ) -> np.ndarray:
+        """[(query, doc)] -> scores [B] float32.
+
+        ``packed=None`` (default) picks sequence packing whenever the
+        module supports it (the in-framework trunk; HF-imported modules
+        take no segment inputs): short pairs share rows under
+        block-diagonal attention instead of each padding to
+        ``max_length``, identical scores up to dtype accumulation order.
+        ``packed=False`` forces the one-pair-per-row reference path (the
+        parity oracle for the packed one)."""
+        return self.submit(pairs, packed=packed)()
+
+    def submit(
+        self, pairs: Sequence[Tuple[str, str]], packed: Optional[bool] = None
+    ):
+        """Dispatch one scoring batch WITHOUT waiting; returns a zero-arg
+        callable completing it (same submit/complete pattern as
+        ``FusedEncodeSearch.submit``, so a serving pipeline can overlap
+        cross-encoder rescoring with the next call's retrieval)."""
         with self._lock:
             n = len(pairs)
             if n == 0:
-                return np.zeros((0,), np.float32)
-            from .encoder import _bucket
+                return lambda: np.zeros((0,), np.float32)
+            if packed is None:
+                packed = not self._hf
+            if packed and not self._hf:
+                return self._submit_packed(pairs)
+            return self._submit_unpacked(pairs)
 
-            b = _bucket(n)
-            qs = [str(p[0]) for p in pairs] + [""] * (b - n)
-            ds = [str(p[1]) for p in pairs] + [""] * (b - n)
-            ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
-            fn = self._forward_fn(ids.shape)
-            if self._hf:
-                # BERT pair segments: tokens after the first [SEP] are type 1
-                first_sep = np.argmax(ids == self.tokenizer.SEP, axis=1)
-                type_ids = (
-                    (np.arange(ids.shape[1])[None, :] > first_sep[:, None])
-                    & (mask > 0)
-                ).astype(np.int32)
-                out = fn(
-                    self.params,
-                    jnp.asarray(ids),
-                    jnp.asarray(mask),
-                    jnp.asarray(type_ids),
-                )
-            else:
-                out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+    def _submit_unpacked(self, pairs: Sequence[Tuple[str, str]]):
+        """One pair per padded row (caller holds the lock) — the HF path
+        and the parity reference for the packed path."""
+        from .encoder import _bucket
+
+        n = len(pairs)
+        b = _bucket(n)
+        qs = [str(p[0]) for p in pairs] + [""] * (b - n)
+        ds = [str(p[1]) for p in pairs] + [""] * (b - n)
+        ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
+        fn = self._forward_fn(ids.shape)
+        if self._hf:
+            # BERT pair segments: tokens after the first [SEP] are type 1
+            first_sep = np.argmax(ids == self.tokenizer.SEP, axis=1)
+            type_ids = (
+                (np.arange(ids.shape[1])[None, :] > first_sep[:, None])
+                & (mask > 0)
+            ).astype(np.int32)
+            out = fn(
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(mask),
+                jnp.asarray(type_ids),
+            )
+        else:
+            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+
+        def complete() -> np.ndarray:
             return np.asarray(out, dtype=np.float32)[:n]
+
+        return complete
+
+    # -- sequence packing ---------------------------------------------------
+    def _pack_pairs(self, pairs: Sequence[Tuple[str, str]]):
+        """Tokenize (query, doc) pairs and pack them into length-bucketed
+        rows (models/packing.py): the row width is the smallest bucket
+        holding the longest pair, so a 20-token pair never burns a full
+        ``max_length``-token row of MXU work.  Returns (ids, segments,
+        positions, doc_slots, n_seg) with doc_slots[i] = (row, seg-1) of
+        pair i."""
+        from .packing import pack_rows, row_length_bucket
+
+        qs = [str(p[0]) for p in pairs]
+        ds = [str(p[1]) for p in pairs]
+        ids_b, mask_b = self.tokenizer.encode_batch(qs, pairs=ds)
+        ids_b = np.asarray(ids_b)
+        lens = np.asarray(mask_b).sum(axis=1).astype(np.int64)
+        L = row_length_bucket(int(lens.max()), self.config.max_len)
+        lens = np.minimum(lens, L)
+        ids, _mask, segments, positions, doc_slots, n_seg = pack_rows(
+            ids_b, lens, L
+        )
+        return ids, segments, positions, doc_slots, n_seg
+
+    def _packed_fn(self, R: int, L: int, S: int):
+        key = ("packed", R, L, S)
+        fn = self._fns.get(key)
+        if fn is None:
+            module = self.module
+
+            @jax.jit
+            def fn(params, ids, segments, positions):
+                return module.apply(
+                    {"params": params},
+                    ids,
+                    segments > 0,  # the packed forward masks via segments
+                    segments=segments,
+                    positions=positions,
+                    n_segments=S,
+                )  # [R, S] per-segment pair scores
+
+            self._fns[key] = fn
+        return self._fns[key]
+
+    def _submit_packed(self, pairs: Sequence[Tuple[str, str]]):
+        """Packed async scoring (caller holds the lock): pack, dispatch ONE
+        forward over the packed rows, return a completion that gathers the
+        per-pair scores back into input order."""
+        from .encoder import _bucket
+        from .packing import pad_packed_rows, seg_bucket
+
+        n = len(pairs)
+        ids, segments, positions, doc_slots, n_seg = self._pack_pairs(pairs)
+        Rb = _bucket(ids.shape[0])
+        ids, segments, positions = pad_packed_rows(ids, segments, positions, Rb)
+        Sb = seg_bucket(n_seg)
+        fn = self._packed_fn(Rb, ids.shape[1], Sb)
+        out = fn(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(segments),
+            jnp.asarray(positions),
+        )
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        flat_ix = np.asarray([r * Sb + s for r, s in doc_slots], np.int64)
+
+        def complete() -> np.ndarray:
+            arr = np.asarray(out, dtype=np.float32).reshape(-1)
+            return arr[flat_ix][:n]
+
+        return complete

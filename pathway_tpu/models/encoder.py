@@ -170,80 +170,21 @@ class SentenceEncoder:
 
     # -- sequence packing ---------------------------------------------------
     def _pack(self, texts: Sequence[str], max_docs_per_row: int = 8):
-        """First-fit-decreasing packing of tokenized docs into rows of
-        ``max_len`` tokens.  Returns (ids [R, L], mask, segments,
-        positions, doc_slots) where doc_slots[i] = (row, segment-1) of
-        input doc i; segments are 1-based per row, positions restart per
+        """Best-fit-decreasing packing of tokenized docs into rows of
+        ``max_len`` tokens (layout shared with the cross-encoder:
+        models/packing.py).  Returns (ids [R, L], mask, segments,
+        positions, doc_slots, n_seg) where doc_slots[i] = (row, segment-1)
+        of input doc i; segments are 1-based per row, positions restart per
         document (so positional embeddings match unpacked encoding)."""
+        from .packing import pack_rows
+
         L = self.config.max_len
-        n = len(texts)
         # tokenize through the NATIVE batch path, then strip padding —
         # per-doc python tokenization was the original ingest bottleneck
         ids_b, mask_b = self.tokenizer.encode_batch(texts)
         ids_b = np.asarray(ids_b)
         lens = np.minimum(np.asarray(mask_b).sum(axis=1), L).astype(np.int64)
-        order = np.argsort(-lens, kind="stable")
-        # best-fit-decreasing via a capacity-sorted open-row list: O(log R)
-        # placement per doc (a naive scan-all-rows loop measured 68 ms per
-        # 2.5k-doc chunk — more than the device forward it feeds).  The
-        # per-row doc cap keeps the segment width (a compile dimension)
-        # small and stable across chunks.
-        import bisect
-
-        open_caps: list = []  # ascending (cap_left, row_id)
-        row_of = np.empty(n, np.int64)
-        seg_of = np.empty(n, np.int64)
-        off_of = np.empty(n, np.int64)
-        row_fill: list = []  # tokens used per row
-        row_count: list = []  # docs per row
-        for i in order.tolist():
-            need = int(lens[i])
-            j = bisect.bisect_left(open_caps, (need, -1))
-            if j < len(open_caps):
-                cap_left, rid = open_caps.pop(j)
-                row_of[i] = rid
-                seg_of[i] = row_count[rid]
-                off_of[i] = row_fill[rid]
-                row_count[rid] += 1
-                row_fill[rid] += need
-                new_cap = cap_left - need
-                if row_count[rid] < max_docs_per_row and new_cap >= 2:
-                    bisect.insort(open_caps, (new_cap, rid))
-            else:
-                rid = len(row_fill)
-                row_of[i] = rid
-                seg_of[i] = 0
-                off_of[i] = 0
-                row_fill.append(need)
-                row_count.append(1)
-                if max_docs_per_row > 1 and L - need >= 2:
-                    bisect.insort(open_caps, (L - need, rid))
-        R = len(row_fill)
-        n_seg = max(row_count) if row_count else 1
-        # vectorized assembly: one flat scatter for all token positions
-        total = int(lens.sum())
-        within = np.arange(total) - np.repeat(
-            np.concatenate([[0], np.cumsum(lens)[:-1]]), lens
-        )
-        src = np.repeat(np.arange(n) * ids_b.shape[1], lens) + within
-        dest = np.repeat(row_of * L + off_of, lens) + within
-        ids = np.zeros(R * L, np.int32)
-        mask = np.zeros(R * L, np.int32)
-        segments = np.zeros(R * L, np.int32)
-        positions = np.zeros(R * L, np.int32)
-        ids[dest] = ids_b.reshape(-1)[src]
-        mask[dest] = 1
-        segments[dest] = np.repeat(seg_of + 1, lens)
-        positions[dest] = within
-        doc_slots = list(zip(row_of.tolist(), seg_of.tolist()))
-        return (
-            ids.reshape(R, L),
-            mask.reshape(R, L),
-            segments.reshape(R, L),
-            positions.reshape(R, L),
-            doc_slots,
-            n_seg,
-        )
+        return pack_rows(ids_b, lens, L, max_docs_per_row)
 
     def encode_packed_to_device(self, texts: Sequence[str]):
         """Encode with SEQUENCE PACKING: short documents share rows with
@@ -261,16 +202,15 @@ class SentenceEncoder:
             n = len(texts)
             if n == 0:
                 return jnp.zeros((0, self.config.d_model), jnp.float32)
+            from .packing import pad_packed_rows, seg_bucket
+
             ids, mask, segments, positions, doc_slots, n_seg = self._pack(texts)
-            R = ids.shape[0]
             # bucket the row count and segment width: few compile shapes
-            Rb = _bucket(R)
-            if Rb > R:
-                pad = np.zeros((Rb - R, ids.shape[1]), np.int32)
-                ids = np.concatenate([ids, pad])
-                segments = np.concatenate([segments, pad])
-                positions = np.concatenate([positions, pad])
-            Sb = 8 if n_seg <= 8 else max(1, ((n_seg + 3) // 4) * 4)
+            Rb = _bucket(ids.shape[0])
+            ids, segments, positions = pad_packed_rows(
+                ids, segments, positions, Rb
+            )
+            Sb = seg_bucket(n_seg)
             fn = self._packed_fn(Rb, ids.shape[1], Sb)
             # no separate mask transfer: segments>0 IS the token mask in
             # the packed forward

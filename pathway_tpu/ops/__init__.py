@@ -3,7 +3,14 @@ reference's native hot paths: ndarray expressions in src/mat_mul.rs, external
 index scoring in src/external_integration/)."""
 
 from .knn import DeviceKnnIndex
+from .retrieve_rerank import RetrieveRerankPipeline
 from .serving import FusedEncodeSearch
 from .topk import merge_topk, sharded_topk
 
-__all__ = ["DeviceKnnIndex", "FusedEncodeSearch", "sharded_topk", "merge_topk"]
+__all__ = [
+    "DeviceKnnIndex",
+    "FusedEncodeSearch",
+    "RetrieveRerankPipeline",
+    "sharded_topk",
+    "merge_topk",
+]

@@ -1,0 +1,75 @@
+"""Dispatch/fetch accounting hook for the serving hot path.
+
+The latency claims on a tunneled TPU are round-trip counts, not FLOPs
+("a retrieve+rerank serve call issues exactly two device dispatches and two
+host fetches in steady state").  Timing can't prove that on CPU CI, so the
+serving paths report every compiled-function launch and every device→host
+result copy here; tests and bench install a counter around a steady-state
+call and assert on ground truth instead of wall clock.
+
+No-op (one dict lookup) unless a counter is installed — never on by
+default in production serving.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+__all__ = ["DispatchCounter", "install", "uninstall", "record_dispatch", "record_fetch"]
+
+_lock = threading.Lock()
+_active: Optional["DispatchCounter"] = None
+
+
+class DispatchCounter:
+    """Counts device dispatches and host fetches on the serving paths."""
+
+    def __init__(self) -> None:
+        self.dispatches = 0
+        self.fetches = 0
+        self.events: List[Tuple[str, str]] = []  # ("dispatch"|"fetch", tag)
+
+    def reset(self) -> None:
+        self.dispatches = 0
+        self.fetches = 0
+        self.events = []
+
+    def snapshot(self) -> Tuple[int, int]:
+        return self.dispatches, self.fetches
+
+    def __enter__(self) -> "DispatchCounter":
+        install(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        uninstall()
+
+
+def install(counter: Optional[DispatchCounter] = None) -> DispatchCounter:
+    global _active
+    with _lock:
+        _active = counter or DispatchCounter()
+        return _active
+
+
+def uninstall() -> None:
+    global _active
+    with _lock:
+        _active = None
+
+
+def record_dispatch(tag: str) -> None:
+    c = _active
+    if c is not None:
+        with _lock:
+            c.dispatches += 1
+            c.events.append(("dispatch", tag))
+
+
+def record_fetch(tag: str) -> None:
+    c = _active
+    if c is not None:
+        with _lock:
+            c.fetches += 1
+            c.events.append(("fetch", tag))

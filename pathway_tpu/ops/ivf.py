@@ -201,6 +201,15 @@ class IvfKnnIndex:
         # free-slot allocation without a device fetch
         self._live_mask: Optional[np.ndarray] = None
         self._retraining = False
+        self._absorbing = False
+        # bumped whenever a freshly trained layout is installed — an
+        # off-lock absorb whose snapshot predates the install must abort
+        # (its slot plan refers to the replaced slabs)
+        self._layout_gen = 0
+        # device-resident exact-tail upload, cached between serves and
+        # invalidated only when the tail mutates (ADVICE r5 #1): steady-
+        # state serving with an unchanged tail pays no per-call transfer
+        self._tail_cache: Optional[Tuple] = None
         # damping for absorb re-attempts: when an absorb could place
         # NOTHING (preferred clusters full), remember the tail size so
         # every subsequent add() doesn't pay a futile tail x C matmul;
@@ -240,8 +249,10 @@ class IvfKnnIndex:
                 key = int(key)
                 self._rows[key] = vec
                 self._tail[key] = None
+            self._tail_cache = None
             if (
                 self._slabs is not None
+                and not self._absorbing
                 and len(self._tail) >= self.absorb_threshold
                 and (
                     self._absorb_stuck_at is None
@@ -249,7 +260,21 @@ class IvfKnnIndex:
                     >= self._absorb_stuck_at + self.absorb_threshold
                 )
             ):
-                self._absorb_tail()
+                # absorb runs OFF the index lock on a maintenance thread
+                # (like retrain): the device prefs matmul + its host sync
+                # used to block concurrent search()/submit() for the whole
+                # absorb, a serve-latency spike at every absorb tick
+                # (ADVICE r5 #5).  Only the final donated scatter +
+                # bookkeeping re-acquire the lock.
+                self._absorbing = True
+                try:
+                    threading.Thread(
+                        target=self._absorb_bg, daemon=True, name="ivf-absorb"
+                    ).start()
+                except RuntimeError:
+                    # thread exhaustion: re-arm so a later add() retries
+                    # instead of disabling absorbs for the index lifetime
+                    self._absorbing = False
             self.maybe_retrain_async()
 
     def remove(self, keys: Sequence[int]) -> None:
@@ -270,7 +295,9 @@ class IvfKnnIndex:
             slot = self._slot_of_key.pop(key, None)
             if slot is not None:
                 slots.append(slot)
-            self._tail.pop(key, None)
+            if key in self._tail:
+                del self._tail[key]
+                self._tail_cache = None
         if slots and self._bias is not None:
             arr = np.asarray(slots, np.int64)
             self._bias = self._bias.at[
@@ -290,12 +317,14 @@ class IvfKnnIndex:
     def build(self) -> None:
         """Synchronous full (re)train + install — the explicit BULK path
         (initial load, tests, bench setup).  The serve path never calls
-        this; streaming maintenance goes through ``_absorb_tail`` and the
-        background retrain instead."""
+        this; streaming maintenance goes through the background
+        ``_absorb_bg`` and retrain threads instead."""
         with self._lock:
             if not self._rows:
                 self._slabs = None
                 self._tail = {}
+                self._tail_cache = None
+                self._layout_gen += 1
                 return
             snapshot = dict(self._rows)
             self.stats["sync_builds"] += 1
@@ -477,22 +506,68 @@ class IvfKnnIndex:
         }
         self._built_n = built["n"]
         self._absorb_stuck_at = None  # fresh layout: re-arm absorb
+        self._tail_cache = None
+        self._layout_gen += 1  # in-flight off-lock absorb plans must abort
         self._search_fns.clear()
 
-    def _absorb_tail(self) -> None:
-        """Fold tail rows into FREE slab slots at their nearest centroid
-        with spare capacity — one donated device scatter, no retrain
-        (caller holds the lock).  Rows whose preferred clusters are all
-        full stay in the exact tail until the next background retrain
-        rebalances the layout."""
+    def _absorb_bg(self) -> None:
+        """Background absorb (maintenance thread, like retrain): snapshot
+        under the lock, run the expensive plan (centroid-preference matmul
+        + host fetch + free-slot placement) WITHOUT the lock — serving
+        continues throughout — then re-acquire the lock only for the
+        donated scatter + bookkeeping."""
+        try:
+            with self._lock:
+                snap = self._absorb_snapshot()
+            if snap is None:
+                return
+            plan = self._plan_absorb(snap)
+            with self._lock:
+                self._commit_absorb(snap, plan)
+        except Exception:
+            # keep a visible trace of background failures (the threading
+            # excepthook prints the traceback; the old synchronous absorb
+            # raised into add()); the cleared flag below re-arms a retry
+            with self._lock:
+                self.stats["absorb_errors"] = (
+                    self.stats.get("absorb_errors", 0) + 1
+                )
+            raise
+        finally:
+            self._absorbing = False
+
+    def _absorb_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Consistent view of the tail + slab occupancy for absorb planning
+        (caller holds the lock)."""
         tail_keys = [k for k in self._tail if k in self._rows]
         if not tail_keys or self._slabs is None:
-            return
-        data = np.stack([self._rows[k] for k in tail_keys])
-        t = len(tail_keys)
-        M_pad = self._M_pad
-        C_pad = self._bias.shape[0]
-        C = self._centroids.shape[0]
+            return None
+        vec_refs = [self._rows[k] for k in tail_keys]
+        return {
+            "tail_keys": tail_keys,
+            # object identity of the stored vectors doubles as an exact
+            # staleness detector at commit (add() binds a fresh array per
+            # key, the same trick _install uses)
+            "vec_refs": vec_refs,
+            "data": np.stack(vec_refs),
+            "live": self._live_mask.copy(),
+            "centroids": self._centroids,
+            "M_pad": self._M_pad,
+            "C_pad": self._bias.shape[0],
+            "d_pad": self._d_pad,
+            "gen": self._layout_gen,
+        }
+
+    def _plan_absorb(self, snap: Dict[str, Any]) -> Dict[str, Any]:
+        """Assign tail rows to FREE slab slots at their nearest centroid
+        with spare capacity.  Lock-free: touches only the snapshot.  The
+        device preference matmul + its host sync live here — the whole
+        point of planning off the lock."""
+        data = snap["data"]
+        t = data.shape[0]
+        M_pad = snap["M_pad"]
+        C_pad = snap["C_pad"]
+        C = snap["centroids"].shape[0]
         n_pref = min(4, C)
         tb = _bucket(t)  # bucketed batch: a handful of compile shapes
         data_p = (
@@ -501,9 +576,9 @@ class IvfKnnIndex:
             else data
         )
         prefs = np.asarray(
-            _tail_prefs(jnp.asarray(data_p), self._centroids, n_pref)
+            _tail_prefs(jnp.asarray(data_p), snap["centroids"], n_pref)
         )[:t]
-        live = self._live_mask
+        live = snap["live"]
         free_count = M_pad - np.add.reduceat(
             live.astype(np.int64), np.arange(0, C_pad * M_pad, M_pad)
         )
@@ -527,9 +602,7 @@ class IvfKnnIndex:
             np.add.at(fill, cs[ok], 1)
         placed = np.flatnonzero(target >= 0)
         if placed.size == 0:
-            self._absorb_stuck_at = len(self._tail)
-            return
-        self._absorb_stuck_at = None
+            return {"placed": placed, "slots": np.empty(0, np.int64)}
         # concrete free slot per placed row
         slots = np.empty(placed.size, np.int64)
         pos = 0
@@ -542,9 +615,45 @@ class IvfKnnIndex:
         # keep (row -> slot) pairing aligned with the per-cluster slot fill
         order_rows = np.argsort(target[placed], kind="stable")
         placed = placed[order_rows]
+        return {"placed": placed, "slots": slots}
+
+    def _commit_absorb(self, snap: Dict[str, Any], plan: Dict[str, Any]) -> None:
+        """Install an absorb plan (caller holds the lock): donated device
+        scatter + bookkeeping only.  Rows that mutated while the plan ran
+        off-lock (removed/upserted) are dropped; a layout swap (background
+        retrain landed) aborts the whole plan — the retrain already
+        reconciled the tail against its fresh slabs."""
+        if snap["gen"] != self._layout_gen or self._slabs is None:
+            return
+        placed = plan["placed"]
+        if placed.size == 0:
+            # only suppress future absorbs if occupancy is unchanged since
+            # the snapshot: a concurrent remove() freed capacity and
+            # re-armed (_forget_built sets _absorb_stuck_at = None) while
+            # the plan ran off-lock — a stale zero-placement plan must not
+            # clobber that
+            if np.array_equal(self._live_mask, snap["live"]):
+                self._absorb_stuck_at = len(self._tail)
+            return
+        tail_keys = snap["tail_keys"]
+        vec_refs = snap["vec_refs"]
+        # staleness filter: key still in the tail with the SAME vector
+        keep = np.asarray(
+            [
+                tail_keys[int(i)] in self._tail
+                and self._rows.get(tail_keys[int(i)]) is vec_refs[int(i)]
+                for i in placed
+            ],
+            bool,
+        )
+        placed = placed[keep]
+        slots = plan["slots"][keep]
+        if placed.size == 0:
+            return
+        self._absorb_stuck_at = None
         d = self.dimension
-        vecs = np.zeros((placed.size, self._d_pad), np.float32)
-        vecs[:, :d] = data[placed]
+        vecs = np.zeros((placed.size, snap["d_pad"]), np.float32)
+        vecs[:, :d] = snap["data"][placed]
         b = _bucket(placed.size)
         if b > placed.size:
             slots_p = np.concatenate(
@@ -561,7 +670,7 @@ class IvfKnnIndex:
             jnp.asarray(slots_p, jnp.int32),
             jnp.asarray(vecs_p, self.dtype),
         )
-        live[slots] = True
+        self._live_mask[slots] = True
         # copy-on-write: an in-flight serve dispatch snapshotted the OLD
         # keys_by_slot reference; mutating it in place could attribute a
         # reused slot's dispatch-time score to the newly absorbed key
@@ -573,6 +682,7 @@ class IvfKnnIndex:
             self._slot_of_key[key] = slot
             del self._tail[key]
         self._keys_by_slot = keys_by_slot
+        self._tail_cache = None
         self.stats["absorbs"] += 1
 
     def _tail_snapshot(self) -> Tuple[List[int], np.ndarray, np.ndarray, int]:
@@ -607,6 +717,31 @@ class IvfKnnIndex:
         tail_valid = np.zeros(max(t_pad, 1), bool)
         tail_valid[: len(tail)] = True
         return tail, tail_mat, tail_valid, t_pad
+
+    def _tail_snapshot_device(self) -> Tuple[List[int], Any, Any, int]:
+        """Device-resident flavor of ``_tail_snapshot`` for the fused
+        serving path (caller holds the lock): ``(tail_keys, tail_mat_dev,
+        tail_valid_dev, t_pad)``.  The upload is CACHED on the index and
+        invalidated only when the tail mutates (add / absorb / remove /
+        layout install), so steady-state serving with an unchanged tail
+        pays no per-dispatch host->device transfer — the padded tail is
+        ~3 MB bf16 at d=384, previously re-sent on every serve call
+        (ADVICE r5 #1)."""
+        cache = self._tail_cache
+        if cache is None:
+            tail, tail_mat, tail_valid, t_pad = self._tail_snapshot()
+            if t_pad:
+                dev_mat = jnp.asarray(tail_mat[:t_pad], self.dtype)
+                dev_valid = jnp.asarray(tail_valid[:t_pad])
+            else:
+                # placeholder shapes for the tail-less kernel signature
+                dev_mat = jnp.asarray(
+                    np.zeros((1, self.dimension), np.float32), self.dtype
+                )
+                dev_valid = jnp.asarray(np.zeros(1, bool))
+            cache = (tail, dev_mat, dev_valid, t_pad)
+            self._tail_cache = cache
+        return cache
 
     def build_from_matrix(self, keys: Sequence[int], matrix_dev) -> None:
         """Bulk build directly from a DEVICE-RESIDENT row matrix [n, d]
@@ -717,6 +852,8 @@ class IvfKnnIndex:
             self._tail = {k: None for k in self._rows if k not in self._slot_of_key}
             self._built_n = n
             self._absorb_stuck_at = None
+            self._tail_cache = None
+            self._layout_gen += 1
             self._search_fns.clear()
             self.stats["sync_builds"] += 1
 
@@ -760,8 +897,9 @@ class IvfKnnIndex:
                 queries = np.concatenate(
                     [queries, np.zeros((b - nq, self.dimension), np.float32)]
                 )
-            # exact tail of unbuilt recent rows, brute-force scored alongside
-            tail, tail_mat, tail_valid, t_pad = self._tail_snapshot()
+            # exact tail of unbuilt recent rows, brute-force scored
+            # alongside (device upload cached until the tail mutates)
+            tail, tail_dev, tail_valid_dev, t_pad = self._tail_snapshot_device()
             fn = self._search_fn(b, k, p, t_pad)
             q_pad = queries
             if self._d_pad > self.dimension:
@@ -781,8 +919,8 @@ class IvfKnnIndex:
                 self._bias,
                 self._centroids if isinstance(self._centroids, jnp.ndarray)
                 else jnp.asarray(self._centroids),
-                jnp.asarray(tail_mat, self.dtype),
-                jnp.asarray(tail_valid[:t_pad] if t_pad else tail_valid[:0]),
+                tail_dev,
+                tail_valid_dev,
             )
             scores = np.asarray(scores)[:nq]
             slots = np.asarray(slots)[:nq]

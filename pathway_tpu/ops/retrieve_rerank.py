@@ -1,0 +1,288 @@
+"""Fused retrieve→rerank serving pipeline: TWO device round trips total.
+
+Stage 1 is the existing ``FusedEncodeSearch`` dispatch (encode + score +
+top-k in one launch); stage 2 re-scores the stage-1 candidates with the
+on-device cross-encoder.  Every multi-stage ranking architecture pays this
+chain per query (PAPERS.md: "An Exploration of Approaches to Integrating
+Neural Reranking Models in Multi-Stage Ranking Architectures"; "Accelerating
+Retrieval-Augmented Generation" names retrieve+rerank as the dominant
+serving cost), and on a tunneled TPU each extra dispatch or fetch is a full
+~70 ms RTT — so the stage-2 design goal is the same as stage 1's: ONE
+dispatch, ONE packed fetch.
+
+Stage 2 compiles (packed cross-encoder forward over length-bucketed,
+sequence-packed (query, doc) rows) → (scatter pair scores to a [Q, Kc]
+table) → (``lax.top_k`` per query) into a single jitted function whose
+output is one packed int32 array: ``k`` score bit-patterns plus the ``k``
+winning candidate indices (the per-query permutation of stage-1 ranks).
+Short pairs share rows under block-diagonal segment attention
+(models/transformer.py) instead of each padding to ``max_length`` — a
+20-token pair no longer burns a 256-token row of MXU work.
+
+``submit``/``complete`` follow the stage-1 async pattern, so consecutive
+serve calls pipeline: stage 2 of call N runs on device while stage 1 of
+call N+1 is already queued behind it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dispatch_counter import record_dispatch, record_fetch
+from .serving import FusedEncodeSearch
+
+__all__ = ["RetrieveRerankPipeline"]
+
+
+class _PendingServe:
+    """In-flight retrieve→rerank serve handle: ``advance()`` completes
+    stage 1 and dispatches stage 2 without blocking on the final fetch;
+    calling the handle finishes the serve.  A per-handle lock makes both
+    idempotent — a handle shared across threads (or completed twice)
+    dispatches stage 2 and fetches its result exactly once."""
+
+    __slots__ = (
+        "_pipeline", "_stage1", "_queries", "_k",
+        "_stage2", "_result", "_done", "_hlock",
+    )
+
+    def __init__(self, pipeline, stage1, queries, k) -> None:
+        self._pipeline = pipeline
+        self._stage1 = stage1
+        self._queries = queries
+        self._k = k
+        self._stage2: Any = None
+        self._result: Any = None
+        self._done = False
+        self._hlock = threading.Lock()
+
+    def advance(self) -> None:
+        with self._hlock:
+            self._advance_locked()
+
+    def _advance_locked(self) -> None:
+        if self._stage2 is None:
+            hits = self._stage1()  # host fetch #1 (stage-1 packed output)
+            cand_keys = [[key for key, _ in row] for row in hits]
+            with self._pipeline._lock:
+                self._stage2 = self._pipeline._submit_stage2(
+                    self._queries, cand_keys, self._k
+                )
+
+    def __call__(self) -> List[List[Tuple[int, float]]]:
+        with self._hlock:
+            if not self._done:
+                self._advance_locked()
+                self._result = self._stage2()
+                self._done = True
+            return self._result
+
+
+class RetrieveRerankPipeline:
+    """Chain ``FusedEncodeSearch`` (stage 1) with on-device cross-encoder
+    rescoring (stage 2) at two round trips per serve call.
+
+    ``doc_text`` maps a stage-1 winner key to its document text — a dict or
+    a ``key -> str`` callable (the document store's chunk text column).
+    ``candidates`` is the stage-1 shortlist width fed to the cross-encoder
+    (fixed, so stage-2 compiles once per batch bucket); the final result is
+    the rerank-ordered top ``k``.
+
+    Recompiles per (row bucket, row length bucket, segment bucket, query
+    bucket) — a handful of shapes in steady state.  HF-imported
+    cross-encoders (no segment inputs) fall back to an unpacked host-side
+    stage 2, same results, more transfers."""
+
+    def __init__(
+        self,
+        retriever: FusedEncodeSearch,
+        cross_encoder,
+        doc_text: Union[Mapping[int, str], Callable[[int], str]],
+        k: int = 10,
+        candidates: Optional[int] = None,
+    ):
+        self.retriever = retriever
+        self.cross_encoder = cross_encoder
+        self.doc_text = doc_text
+        self.k = k
+        self.candidates = candidates or max(4 * k, 16)
+        self._lock = threading.Lock()
+        self._fns: Dict[Tuple, Any] = {}
+        self.stats = {"serves": 0, "stage2_pairs": 0, "stage2_rows": 0}
+
+    # -- host helpers -------------------------------------------------------
+    def _text_of(self, key: int) -> str:
+        src = self.doc_text
+        try:
+            if callable(src):
+                return str(src(key) or "")
+            return str(src.get(key, "") or "")
+        except LookupError:  # a missing doc must not sink a serve; anything
+            return ""  # else is a real bug in doc_text and must surface
+
+    # -- stage 2 kernel -----------------------------------------------------
+    def _compiled_stage2(self, R: int, L: int, S: int, Q: int, k_out: int):
+        """One dispatch: packed cross-encoder forward -> scatter the pair
+        scores into the [Q, Kc] candidate table -> per-query top-k -> ONE
+        packed int32 output [Q, 2*k_out] (score bit-patterns, then the
+        winning stage-1 candidate indices).  Scores ride int lanes for the
+        same reason as serving.py: TPU float lanes canonicalize NaN
+        payloads; int lanes survive bit-exact."""
+        Kc = self.candidates
+        key = (R, L, S, Q, k_out)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        module = self.cross_encoder.module
+
+        @jax.jit
+        def fused(params, ids, segments, positions, pair_slot):
+            scores = module.apply(
+                {"params": params},
+                ids,
+                segments > 0,
+                segments=segments,
+                positions=positions,
+                n_segments=S,
+            )  # [R, S] per-segment pair scores
+            flat = scores.reshape(R * S).astype(jnp.float32)
+            # pair_slot[r*S+s] = q*Kc + j for real pairs, Q*Kc (out of
+            # range -> dropped) for pad segments; absent candidates keep
+            # -inf and can never outrank real ones
+            table = jnp.full((Q * Kc,), -jnp.inf, jnp.float32)
+            table = table.at[pair_slot].set(flat, mode="drop")
+            s, perm = jax.lax.top_k(table.reshape(Q, Kc), k_out)
+            s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
+            return jnp.concatenate([s_bits, perm.astype(jnp.int32)], axis=1)
+
+        self._fns[key] = fused
+        return fused
+
+    def _submit_stage2(
+        self,
+        queries: Sequence[str],
+        cand_keys: List[List[int]],
+        k: int,
+    ):
+        """Pack the (query, candidate) pairs and dispatch the stage-2
+        kernel; returns a completion -> [[(key, rerank_score)]]."""
+        from ..models.encoder import _bucket
+
+        ce = self.cross_encoder
+        Kc = self.candidates
+        k_out = min(k, Kc)
+        nq = len(queries)
+        pairs: List[Tuple[str, str]] = []
+        slot_ids: List[int] = []
+        for qi, row in enumerate(cand_keys):
+            for j, key in enumerate(row[:Kc]):
+                pairs.append((queries[qi], self._text_of(key)))
+                slot_ids.append(qi * Kc + j)
+        if not pairs:
+            return lambda: [[] for _ in range(nq)]
+        if getattr(ce, "_hf", False):
+            return self._submit_stage2_host(queries, cand_keys, pairs, k_out)
+        from ..models.packing import pad_packed_rows, seg_bucket
+
+        Qb = _bucket(nq)
+        with ce._lock:
+            ids, segments, positions, doc_slots, n_seg = ce._pack_pairs(pairs)
+        Rb = _bucket(ids.shape[0])
+        L = ids.shape[1]
+        ids, segments, positions = pad_packed_rows(ids, segments, positions, Rb)
+        Sb = seg_bucket(n_seg)
+        pair_slot = np.full(Rb * Sb, Qb * Kc, np.int32)  # default: dropped
+        for i, (r, s) in enumerate(doc_slots):
+            pair_slot[r * Sb + s] = slot_ids[i]
+        fn = self._compiled_stage2(Rb, L, Sb, Qb, k_out)
+        out = fn(
+            ce.params,
+            jnp.asarray(ids),
+            jnp.asarray(segments),
+            jnp.asarray(positions),
+            jnp.asarray(pair_slot),
+        )
+        record_dispatch("rerank_stage2")
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        self.stats["stage2_pairs"] += len(pairs)
+        self.stats["stage2_rows"] += Rb
+
+        def complete() -> List[List[Tuple[int, float]]]:
+            arr = np.asarray(out)[:nq]
+            record_fetch("rerank_stage2")
+            scores = np.ascontiguousarray(arr[:, :k_out]).view(np.float32)
+            perm = arr[:, k_out:]
+            results: List[List[Tuple[int, float]]] = []
+            for qi in range(nq):
+                row: List[Tuple[int, float]] = []
+                cands = cand_keys[qi]
+                for j in range(k_out):
+                    s = float(scores[qi, j])
+                    ci = int(perm[qi, j])
+                    if not np.isfinite(s) or ci >= len(cands):
+                        continue
+                    row.append((cands[ci], s))
+                results.append(row[:k])
+            return results
+
+        return complete
+
+    def _submit_stage2_host(self, queries, cand_keys, pairs, k_out):
+        """HF fallback: unpacked async scoring + host-side per-query sort
+        (HF modules take no segment inputs; still one dispatch + one fetch,
+        just a max-length-padded batch)."""
+        from ..models.encoder import _bucket
+
+        score_done = self.cross_encoder.submit(pairs, packed=False)
+        record_dispatch("rerank_stage2_host")
+        self.stats["stage2_pairs"] += len(pairs)
+        self.stats["stage2_rows"] += _bucket(len(pairs))  # one row per pair
+
+        def complete() -> List[List[Tuple[int, float]]]:
+            flat = score_done()
+            record_fetch("rerank_stage2_host")
+            results: List[List[Tuple[int, float]]] = []
+            pos = 0
+            for qi in range(len(queries)):
+                n_c = min(len(cand_keys[qi]), self.candidates)
+                scored = list(
+                    zip(cand_keys[qi][:n_c], flat[pos : pos + n_c].tolist())
+                )
+                pos += n_c
+                scored.sort(key=lambda kv: -kv[1])
+                results.append(scored[:k_out])
+            return results
+
+        return complete
+
+    # -- serve --------------------------------------------------------------
+    def submit(self, queries: Sequence[str], k: Optional[int] = None):
+        """Dispatch stage 1 WITHOUT waiting; returns a handle that is also
+        the completion callable.  ``handle.advance()`` completes stage 1
+        and dispatches stage 2 without blocking on the final fetch, so a
+        caller driving several in-flight serves keeps the device queue
+        full (stage 2 of call N overlaps stage 1 of call N+1);
+        ``handle()`` finishes the serve.  ``k`` is capped at the
+        ``candidates`` pool width (standard top-k semantics: a serve cannot
+        return more documents than stage 1 retrieved)."""
+        k = k or self.k
+        queries = list(queries)
+        if not queries:
+            done = _PendingServe(self, lambda: [], [], k)
+            done._stage2 = lambda: []
+            return done
+        stage1 = self.retriever.submit(queries, self.candidates)
+        with self._lock:
+            self.stats["serves"] += 1
+        return _PendingServe(self, stage1, queries, k)
+
+    def __call__(
+        self, queries: Sequence[str], k: Optional[int] = None
+    ) -> List[List[Tuple[int, float]]]:
+        return self.submit(queries, k)()

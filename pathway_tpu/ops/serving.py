@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .dispatch_counter import record_dispatch, record_fetch
 from .knn import _bucket
 
 __all__ = ["FusedEncodeSearch"]
@@ -197,10 +198,12 @@ class FusedEncodeSearch:
             mask = np.concatenate(
                 [mask, np.zeros((b - n_real, mask.shape[1]), mask.dtype)]
             )
-        # exact tail: rows not yet absorbed into the slabs
-        tail, tail_mat, tail_valid, t_pad = index._tail_snapshot()
-        if t_pad == 0:
-            tail_mat = np.zeros((1, index.dimension), np.float32)
+        # exact tail: rows not yet absorbed into the slabs.  The device
+        # upload is CACHED on the index and invalidated only when the tail
+        # mutates (add/absorb/remove/install) — re-uploading the padded
+        # ~3 MB tail matrix on every dispatch was a per-call host->device
+        # transfer on the one-RTT latency path (ADVICE r5 #1)
+        tail, tail_dev, tail_valid_dev, t_pad = index._tail_snapshot_device()
         fn, k_main, k_tail = self._compiled_ivf(
             ids.shape[0], ids.shape[1], k_eff, t_pad
         )
@@ -213,16 +216,18 @@ class FusedEncodeSearch:
             index._centroids
             if isinstance(index._centroids, jax.Array)
             else jnp.asarray(index._centroids),
-            jnp.asarray(tail_mat[:t_pad] if t_pad else tail_mat[:1], index.dtype),
-            jnp.asarray(tail_valid[:t_pad] if t_pad else tail_valid[:1]),
+            tail_dev,
+            tail_valid_dev,
         ]
         out = fn(*args)
+        record_dispatch("serve_ivf")
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         keys_by_slot = index._keys_by_slot  # rebuilds REPLACE the array
 
         def complete() -> List[List[Tuple[int, float]]]:
             arr = np.asarray(out)[:n_real]
+            record_fetch("serve_ivf")
             scores = np.ascontiguousarray(arr[:, :k_main]).view(np.float32)
             slots = arr[:, k_main : 2 * k_main]
             if k_tail:
@@ -307,6 +312,7 @@ class FusedEncodeSearch:
                 index._keys_hi,
                 index._keys_lo,
             )
+            record_dispatch("serve_exact")
             if hasattr(out, "copy_to_host_async"):
                 out.copy_to_host_async()
             # nothing host-side to snapshot: the dispatch captured a
@@ -317,6 +323,7 @@ class FusedEncodeSearch:
 
         def complete() -> List[List[Tuple[int, float]]]:
             arr = np.asarray(out)[:n_real]
+            record_fetch("serve_exact")
             scores = np.ascontiguousarray(arr[:, :k_eff]).view(np.float32)
             ints = np.ascontiguousarray(arr[:, k_eff:]).view(np.uint32)
             hi = ints[:, :k_eff].astype(np.uint64)

@@ -142,17 +142,83 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         default_llm_name: Optional[str] = None,
         search_topk: int = 6,
         prompt_template: Callable[[str, Sequence[str]], str] = prompt_qa,
+        reranker=None,
+        rerank_candidates: Optional[int] = None,
     ):
+        """``reranker`` plugs a second ranking stage between retrieval and
+        the LLM prompt (the multi-stage ranking architecture from
+        PAPERS.md): a ``CrossEncoderModel`` (or anything with
+        ``predict(pairs) -> scores``, e.g. a sentence_transformers
+        CrossEncoder) or a ``CrossEncoderReranker`` UDF.  Retrieval then
+        over-fetches ``rerank_candidates`` docs (default 4x ``search_topk``)
+        and the reranker's packed pair scoring keeps the best
+        ``search_topk`` — the same retrieve→rerank shape the fused
+        ``ops.RetrieveRerankPipeline`` serves at two device round trips."""
         self.llm = llm
         self.indexer = indexer
         self.search_topk = search_topk
         self.prompt_template = prompt_template
+        self.reranker = reranker
+        # resolve the predict-capable object ONCE: a constructor-time error
+        # beats an AttributeError per row deep inside the dataflow UDF
+        if reranker is None:
+            self._rerank_model = None
+        else:
+            model = (
+                reranker
+                if callable(getattr(reranker, "predict", None))
+                else getattr(reranker, "_model", None)
+            )
+            if not callable(getattr(model, "predict", None)):
+                raise ValueError(
+                    "reranker must expose predict(pairs) -> scores (a "
+                    "CrossEncoderModel, a sentence_transformers "
+                    "CrossEncoder, or a CrossEncoderReranker wrapping one)"
+                    f"; got {type(reranker).__name__}"
+                )
+            self._rerank_model = model
+        # a CrossEncoderReranker carries an explicit packed= choice; honor
+        # it here too, not just on its own dataflow scoring path (non-None
+        # only when the wrapped model's predict takes packed)
+        self._rerank_packed = getattr(reranker, "_predict_packed", None)
+        # without a reranker there is no second stage to over-fetch for:
+        # retrieval stays at search_topk even if rerank_candidates is set
+        self.rerank_candidates = (
+            (rerank_candidates or 4 * search_topk)
+            if reranker is not None
+            else search_topk
+        )
         self.server = None
+
+    def _rerank_docs(
+        self, question: str, docs: list, keep: Optional[int] = None
+    ) -> list:
+        """Reorder retrieved doc dicts by cross-encoder pair score and keep
+        the best ``keep`` (default ``search_topk``); no-op without a
+        reranker."""
+        if self._rerank_model is None or not docs:
+            return docs
+        model = self._rerank_model
+        pairs = [(question, str(d.get("text", ""))) for d in docs]
+        if self._rerank_packed is None:
+            scores = np.asarray(model.predict(pairs), dtype=np.float64)
+        else:
+            scores = np.asarray(
+                model.predict(pairs, packed=self._rerank_packed),
+                dtype=np.float64,
+            )
+        order = np.argsort(-scores, kind="stable")[: keep or self.search_topk]
+        out = []
+        for j in order:
+            d = dict(docs[int(j)])
+            d["rerank_score"] = float(scores[int(j)])
+            out.append(d)
+        return out
 
     # -- dataflow endpoints -------------------------------------------------
     def answer_query(self, queries: Table) -> Table:
-        """prompt -> retrieve -> build prompt -> chat -> answer."""
-        topk = self.search_topk
+        """prompt -> retrieve -> (rerank) -> build prompt -> chat -> answer."""
+        topk = self.rerank_candidates
         store = self.indexer
         enriched = queries.select(
             query=this.prompt,
@@ -163,9 +229,11 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         retrieved = store.retrieve_query(enriched)
         llm = self.llm
         template = self.prompt_template
+        rerank = self._rerank_docs
 
         def answer(prompt, docs, return_docs):
-            doc_texts = [d["text"] for d in (docs or [])]
+            docs = rerank(prompt, list(docs or []))
+            doc_texts = [d["text"] for d in docs]
             response = _call_chat(llm, template(prompt, doc_texts))
             if return_docs:
                 return {"response": response, "context_docs": docs}
@@ -250,9 +318,15 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         retrieved = store.retrieve_query(enriched)
         llm = self.llm
         n0, factor, iters = self.n_starting_documents, self.factor, self.max_iterations
+        rerank = self._rerank_docs
 
         def answer(prompt, docs):
-            doc_texts = [d["text"] for d in (docs or [])]
+            # rerank BEFORE the geometric loop: adaptive RAG answers from
+            # the first n docs, so cross-encoder ordering directly buys
+            # one-round answers (reorder only — the loop needs the full
+            # candidate list to grow into)
+            docs = rerank(prompt, list(docs or []), keep=len(docs or []))
+            doc_texts = [d["text"] for d in docs]
             return answer_with_geometric_rag_strategy(
                 prompt, doc_texts, llm, n0, factor, iters
             )

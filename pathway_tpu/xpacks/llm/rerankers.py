@@ -39,13 +39,22 @@ def rerank_topk_filter(docs, scores, k: int = 5):
 class CrossEncoderReranker(UDF):
     """Pair scoring with the on-device cross-encoder (reference:
     rerankers.py:186 uses sentence_transformers CrossEncoder per row; here the
-    whole micro-batch of (query, doc) pairs is one jitted forward)."""
+    whole micro-batch of (query, doc) pairs is one jitted forward).
+
+    The in-framework model scores with SEQUENCE PACKING by default
+    (models/cross_encoder.py): short (query, doc) pairs share rows under
+    block-diagonal segment attention instead of each padding to
+    ``max_length``, so a dataflow micro-batch of short pairs costs a
+    fraction of the MXU work.  For the fused two-dispatch serving path see
+    ``ops.RetrieveRerankPipeline``, which chains retrieval and this model's
+    packed rescoring with one round trip per stage."""
 
     def __init__(
         self,
         model_name: str = "pathway-mini-cross",
         checkpoint_path: Optional[str] = None,
         cross_encoder=None,
+        packed: Optional[bool] = None,
         **kwargs,
     ):
         import os
@@ -65,10 +74,27 @@ class CrossEncoderReranker(UDF):
             )
 
         model = self._model
+        # capability check ONCE at construction (a per-batch
+        # except-TypeError probe would mask genuine TypeErrors from inside
+        # the packed scoring path and silently rescore the batch)
+        import inspect
+
+        try:
+            takes_packed = "packed" in inspect.signature(model.predict).parameters
+        except (TypeError, ValueError):  # builtins / C-impl predict
+            takes_packed = False
+        # consumers that unwrap ._model and call predict themselves (e.g.
+        # BaseRAGQuestionAnswerer(reranker=...)) must honor an explicit
+        # packed= choice; None when the model's predict doesn't take it
+        self._predict_packed = packed if takes_packed else None
 
         def score(docs, queries) -> np.ndarray:
             pairs = [(str(q), str(d)) for q, d in zip(queries, docs)]
-            return np.asarray(model.predict(pairs), dtype=np.float64)
+            if takes_packed:
+                scores = model.predict(pairs, packed=packed)
+            else:  # sentence_transformers CrossEncoder
+                scores = model.predict(pairs)
+            return np.asarray(scores, dtype=np.float64)
 
         super().__init__(score, batched=True, **kwargs)
 

@@ -1,0 +1,186 @@
+"""Heartbeat regression tests for the host exchange plane
+(parallel/exchange.py).
+
+The false positive being pinned down (ADVICE r5 #2): ``pickle.dumps`` of a
+very large shard is a single GIL-holding C call, so a healthy rank
+serializing for longer than PATHWAY_EXCHANGE_HEARTBEAT_TIMEOUT could not
+service its heartbeat thread and was declared PeerLost by its peers.  The
+fix streams the pickle in bounded chunks and pings peers INLINE from the
+serializing thread — so these tests run a sender whose background heartbeat
+thread is DISABLED (the deterministic stand-in for GIL starvation) and a
+serializer that takes several timeouts' worth of wall clock.  The same
+failure mode exists on the receive side (one GIL-holding ``pickle.loads``),
+mirrored by the slow-DESERIALIZATION test."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.parallel.exchange import ExchangePlane, PeerLost
+
+
+class _FakeKV:
+    """In-process stand-in for the jax coordination KV store."""
+
+    def __init__(self):
+        self._kv = {}
+        self._cv = threading.Condition()
+
+    def set(self, key, value):
+        with self._cv:
+            self._kv[key] = value
+            self._cv.notify_all()
+
+    def get(self, key, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - time.monotonic()
+                assert left > 0, f"KV rendezvous timed out waiting for {key}"
+                self._cv.wait(timeout=left)
+            return self._kv[key]
+
+
+class _StarvedHeartbeatPlane(ExchangePlane):
+    """A plane whose background heartbeat thread never runs — the
+    deterministic equivalent of that thread being starved by a GIL-holding
+    serialization.  Only the inline ticks issued from the serializing
+    thread itself can prove this rank's liveness."""
+
+    def _heartbeat_loop(self):  # pragma: no cover - intentionally inert
+        return
+
+
+class _SlowChunk:
+    """Pickles to 64 KiB after a deliberate stall — a list of these makes
+    serialization take several heartbeat timeouts with chunked writes, like
+    a huge real shard does."""
+
+    def __init__(self, delay: float):
+        self.delay = delay
+
+    def __reduce__(self):
+        time.sleep(self.delay)
+        return (bytes, (b"\0" * 65536,))
+
+
+def _mesh(monkeypatch, hb: float, hb_timeout: float, cls0=ExchangePlane, cls1=ExchangePlane):
+    monkeypatch.setenv("PATHWAY_EXCHANGE_HEARTBEAT", str(hb))
+    monkeypatch.setenv("PATHWAY_EXCHANGE_HEARTBEAT_TIMEOUT", str(hb_timeout))
+    kv = _FakeKV()
+    planes = {}
+    errs = []
+
+    def build(rank, cls):
+        try:
+            planes[rank] = cls(rank, 2, kv.set, kv.get, namespace="hb-test")
+        except BaseException as exc:  # pragma: no cover - surface in main
+            errs.append(exc)
+
+    t0 = threading.Thread(target=build, args=(0, cls0))
+    t1 = threading.Thread(target=build, args=(1, cls1))
+    t0.start(); t1.start(); t0.join(30); t1.join(30)
+    assert not errs and 0 in planes and 1 in planes
+    return planes
+
+
+def test_slow_serialization_is_not_declared_peer_lost(monkeypatch):
+    """A rank blocked in serialization for several heartbeat timeouts (with
+    its heartbeat THREAD starved) must not be declared lost: inline ticks
+    from the serializing thread keep the receiver's liveness clock fresh,
+    and the payload arrives intact."""
+    planes = _mesh(
+        monkeypatch, hb=0.2, hb_timeout=1.0, cls0=_StarvedHeartbeatPlane
+    )
+    try:
+        # ~2.5 s of serialization stalls against a 1.0 s heartbeat timeout
+        payload = [_SlowChunk(0.05) for _ in range(50)]
+        send_err = []
+
+        def send():
+            try:
+                planes[0].gather("slow", 0, payload, root=1, timeout=60)
+            except BaseException as exc:
+                send_err.append(exc)
+
+        sender = threading.Thread(target=send)
+        sender.start()
+        got = planes[1].gather("slow", 0, None, root=1, timeout=60)
+        sender.join(60)
+        assert not send_err, send_err
+        assert len(got) == 2 and len(got[0]) == 50
+        assert planes[1]._dead is None, planes[1]._dead
+    finally:
+        for p in planes.values():
+            p.close()
+
+
+def _slow_load(delay: float, data: bytes) -> bytes:
+    time.sleep(delay)
+    return data
+
+
+class _SlowLoadChunk:
+    """Pickles instantly (carrying 64 KiB of payload, so the stream has one
+    large read per chunk) but stalls on UNpickling — a list of these makes
+    deserialization take several heartbeat timeouts on the receiving rank."""
+
+    def __init__(self, delay: float):
+        self.delay = delay
+        self.data = b"\0" * 65536
+
+    def __reduce__(self):
+        return (_slow_load, (self.delay, self.data))
+
+
+def test_slow_deserialization_is_not_declared_peer_lost(monkeypatch):
+    """The recv-side mirror: a rank blocked in deserialization for several
+    heartbeat timeouts (heartbeat thread starved) must not be declared lost
+    by a peer waiting on it — inline ticks from the receiving thread keep
+    pinging — and must not itself declare the SENDER lost just because the
+    sender's pings are queued behind the frame being loaded."""
+    planes = _mesh(monkeypatch, hb=0.2, hb_timeout=1.0, cls1=_StarvedHeartbeatPlane)
+    try:
+        # ~2.5 s of load stalls on rank 1 against a 1.0 s heartbeat timeout
+        payload = [_SlowLoadChunk(0.05) for _ in range(50)]
+        side0_err, side0_res = [], []
+
+        def side0():
+            try:
+                planes[0].gather("slowload", 0, payload, root=1, timeout=60)
+                # rank 0 now WAITS on starved rank 1 while it deserializes
+                side0_res.append(
+                    planes[0].gather("after", 1, "r0", root=0, timeout=60)
+                )
+            except BaseException as exc:
+                side0_err.append(exc)
+
+        t = threading.Thread(target=side0)
+        t.start()
+        got = planes[1].gather("slowload", 0, None, root=1, timeout=60)
+        planes[1].gather("after", 1, "r1", root=0, timeout=60)
+        t.join(60)
+        assert not side0_err, side0_err
+        assert side0_res and side0_res[0] == ["r0", "r1"]
+        assert len(got) == 2 and len(got[0]) == 50
+        assert planes[0]._dead is None, planes[0]._dead
+        assert planes[1]._dead is None, planes[1]._dead
+    finally:
+        for p in planes.values():
+            p.close()
+
+
+def test_hung_peer_is_still_detected(monkeypatch):
+    """The fix must not blunt real detection: a peer that goes silent
+    (closed without traffic) still raises PeerLost within the timeout."""
+    planes = _mesh(monkeypatch, hb=0.2, hb_timeout=1.0)
+    try:
+        planes[0].close()  # rank 0 vanishes without sending
+        with pytest.raises(PeerLost):
+            planes[1].gather("never", 0, None, root=1, timeout=30)
+    finally:
+        for p in planes.values():
+            p.close()

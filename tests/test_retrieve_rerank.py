@@ -1,0 +1,210 @@
+"""Fused retrieve→rerank pipeline tests (ops/retrieve_rerank.py).
+
+Correctness bar (CPU fallback backend): the pipeline's final ranking equals
+the unfused composition encode → index.search → CrossEncoderModel.predict →
+sort; packed cross-encoder scores equal unpacked ones up to dtype
+accumulation.  Budget bar: one steady-state retrieve+rerank serve call
+issues ≤ 2 device dispatches and ≤ 2 host fetches (asserted via the
+dispatch-counter hook, not timing)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.ops import dispatch_counter
+from pathway_tpu.ops.ivf import IvfKnnIndex
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+from pathway_tpu.ops.serving import FusedEncodeSearch
+
+
+DOCS = {
+    i: f"document number {i} about {topic} case {i % 7} with live updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+            "mesh collectives", "tokenizer ingest", "serving latency",
+            "cross encoders", "top k selection", "packing rows",
+            "segment attention", "heartbeat timeouts", "absorb ticks",
+            "retrain swaps", "bias planes", "slab layout",
+        ]
+        * 2
+    )
+}
+QUERIES = ["rag retrieval serving", "exactly once stream", "packing segment rows"]
+
+
+@pytest.fixture(scope="module")
+def stack():
+    enc = SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    ce = CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=64,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    index.add(sorted(DOCS), enc.encode([DOCS[i] for i in sorted(DOCS)]))
+    return enc, ce, index
+
+
+def reference_rerank(enc, ce, index, queries, k, candidates):
+    """The unfused composition the pipeline must match: encode → search →
+    unpacked cross-encoder predict → stable sort by score."""
+    hits = index.search(enc.encode(queries), k=candidates)
+    out = []
+    for q, row in zip(queries, hits):
+        keys = [key for key, _ in row]
+        scores = ce.predict([(q, DOCS[key]) for key in keys], packed=False)
+        order = np.argsort(-scores, kind="stable")[:k]
+        out.append([(keys[j], float(scores[j])) for j in order])
+    return out
+
+
+def assert_rankings_match(got, want, tol=1e-4):
+    """Rank-for-rank equality, tolerating swaps of near-tied scores (packed
+    vs unpacked accumulation order differs)."""
+    assert len(got) == len(want)
+    for grow, wrow in zip(got, want):
+        assert len(grow) == len(wrow)
+        np.testing.assert_allclose(
+            [s for _, s in grow], [s for _, s in wrow], rtol=tol, atol=tol
+        )
+        for j, ((gk, gs), (wk, ws)) in enumerate(zip(grow, wrow)):
+            if gk != wk:
+                assert abs(gs - ws) < tol, (
+                    f"rank {j}: got {gk}@{gs}, want {wk}@{ws}"
+                )
+
+
+def test_pipeline_matches_unfused_reference(stack):
+    enc, ce, index = stack
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=5, candidates=16
+    )
+    got = pipe(QUERIES)
+    want = reference_rerank(enc, ce, index, QUERIES, k=5, candidates=16)
+    assert_rankings_match(got, want)
+    # rerank scores descend
+    for row in got:
+        scores = [s for _, s in row]
+        assert scores == sorted(scores, reverse=True)
+
+
+def test_pipeline_over_ivf_index(stack):
+    enc, ce, _ = stack
+    ivf = IvfKnnIndex(dimension=32, metric="cos", n_clusters=8, n_probe=8)
+    ivf.add(sorted(DOCS), enc.encode([DOCS[i] for i in sorted(DOCS)]))
+    ivf.build()
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, ivf, k=8), ce, DOCS, k=5, candidates=16
+    )
+    got = pipe(QUERIES)
+    want = reference_rerank(enc, ce, ivf, QUERIES, k=5, candidates=16)
+    assert_rankings_match(got, want)
+
+
+def test_packed_scores_match_unpacked_bf16():
+    """Packed cross-encoder scores match the unpacked forward within
+    bfloat16 accumulation tolerance (the dtype the serving stack runs)."""
+    ce = CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=64,
+        vocab_size=512, dtype=jnp.bfloat16,
+    )
+    pairs = [
+        (q, DOCS[i])
+        for q in QUERIES
+        for i in list(DOCS)[:10]
+    ]
+    up = ce.predict(pairs, packed=False)
+    pk = ce.predict(pairs, packed=True)
+    np.testing.assert_allclose(pk, up, rtol=3e-2, atol=3e-2)
+
+
+def test_steady_state_two_dispatches_two_fetches(stack):
+    enc, ce, index = stack
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=5, candidates=16
+    )
+    pipe(QUERIES)  # warmup: compiles both stages
+    with dispatch_counter.DispatchCounter() as counter:
+        got = pipe(QUERIES)
+    assert got and all(got)
+    assert counter.dispatches <= 2, counter.events
+    assert counter.fetches <= 2, counter.events
+
+
+def test_submit_pipelines_consecutive_calls(stack):
+    enc, ce, index = stack
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=4, candidates=16
+    )
+    sync = [pipe([q]) for q in QUERIES]
+    # overlapped: all stage-1 dispatches in flight before any completion
+    handles = [pipe.submit([q]) for q in QUERIES]
+    for h in handles:
+        h.advance()  # completes stage 1, dispatches stage 2, non-blocking
+    overlapped = [h() for h in handles]
+    assert [r[0] for r in overlapped] == [r[0] for r in sync]
+
+
+def test_pipeline_edge_cases(stack):
+    enc, ce, index = stack
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=5, candidates=16
+    )
+    assert pipe([]) == []
+    # k larger than the candidate pool: returns all candidates, reranked
+    got = pipe(QUERIES[:1], k=64)
+    assert len(got[0]) == 16
+    # empty index: empty rows, no crash
+    empty = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=8)
+    pipe_empty = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, empty, k=8), ce, DOCS, k=5
+    )
+    assert pipe_empty(QUERIES) == [[], [], []]
+    # missing doc text must not sink the serve
+    pipe_missing = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, {}, k=3, candidates=8
+    )
+    got = pipe_missing(QUERIES[:1])
+    assert len(got[0]) == 3
+
+
+def test_cross_encoder_submit_matches_predict(stack):
+    _, ce, _ = stack
+    pairs = [(q, DOCS[i]) for q in QUERIES for i in (0, 3, 9, 17)]
+    done = ce.submit(pairs)
+    np.testing.assert_allclose(done(), ce.predict(pairs), rtol=1e-6)
+
+
+def test_ivf_tail_device_upload_is_cached(stack):
+    """Steady-state serving with an unchanged tail must reuse the SAME
+    device-resident tail arrays; a tail mutation invalidates the cache."""
+    enc, _, _ = stack
+    ivf = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=8, n_probe=8,
+        absorb_threshold=4096,
+    )
+    keys = sorted(DOCS)
+    vecs = enc.encode([DOCS[i] for i in keys])
+    ivf.add(keys[:40], vecs[:40])
+    ivf.build()
+    ivf.add(keys[40:], vecs[40:])  # rides the exact tail (below threshold)
+    with ivf._lock:
+        _, mat1, valid1, t_pad = ivf._tail_snapshot_device()
+        _, mat2, valid2, _ = ivf._tail_snapshot_device()
+    assert t_pad > 0
+    assert mat1 is mat2 and valid1 is valid2, "tail re-uploaded per call"
+    ivf.remove(keys[41:42])  # tail mutation invalidates the cache
+    with ivf._lock:
+        _, mat3, _, _ = ivf._tail_snapshot_device()
+    assert mat3 is not mat1

@@ -180,6 +180,56 @@ def test_adaptive_rag_question_answerer():
     assert cols["result"][0] == "answer about cat"
 
 
+def test_rag_question_answerer_with_reranker():
+    """reranker= plugs a cross-encoder second stage between retrieval and
+    the prompt: retrieval over-fetches rerank_candidates, _rerank_docs
+    keeps the cross-encoder's best search_topk, and an explicit packed=
+    choice on a CrossEncoderReranker is honored (integration cover for the
+    QA wiring, not just the UDF shape)."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+    from pathway_tpu.xpacks.llm.rerankers import CrossEncoderReranker
+
+    ce = CrossEncoderModel(
+        dimension=16, n_layers=1, n_heads=2, max_length=32,
+        vocab_size=256, dtype=jnp.float32,
+    )
+    store = make_store()
+    chat = FakeChat(keyword="cat")
+    rag = BaseRAGQuestionAnswerer(chat, store, search_topk=2, reranker=ce)
+    assert rag.rerank_candidates == 8  # over-fetch: 4x topk by default
+
+    # _rerank_docs must match the unwrapped predict + stable-sort reference
+    docs = [
+        {"text": "the cat sat on the mat."},
+        {"text": "a dog chased the ball."},
+        {"text": "fish swim in the sea."},
+    ]
+    got = rag._rerank_docs("where is the cat", docs)
+    scores = ce.predict([("where is the cat", d["text"]) for d in docs])
+    order = np.argsort(-np.asarray(scores, np.float64), kind="stable")[:2]
+    assert [d["text"] for d in got] == [docs[int(j)]["text"] for j in order]
+    assert all("rerank_score" in d for d in got)
+
+    # an explicit packed= choice on a CrossEncoderReranker is honored
+    rr = CrossEncoderReranker(cross_encoder=ce, packed=False)
+    rag_unpacked = BaseRAGQuestionAnswerer(chat, store, search_topk=2, reranker=rr)
+    assert rag_unpacked._rerank_packed is False
+    assert len(rag_unpacked._rerank_docs("where is the cat", docs)) == 2
+
+    # the dataflow endpoint runs end-to-end with the reranker wired in
+    queries = pw.debug.table_from_rows(
+        BaseRAGQuestionAnswerer.AnswerQuerySchema,
+        [("cat question", None, None, False)],
+    )
+    out = rag.answer_query(queries)
+    pw.run(monitoring_level=None)
+    _, cols = out._materialize()
+    assert cols["result"][0] in ("answer about cat", "No information found.")
+    assert chat.calls  # the prompt actually reached the LLM stage
+
+
 def test_cross_encoder_reranker_shape():
     from pathway_tpu.xpacks.llm.rerankers import CrossEncoderReranker
 
