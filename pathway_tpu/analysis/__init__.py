@@ -1,0 +1,54 @@
+"""Hot-path static analysis for pathway_tpu.
+
+An AST lint framework plus three rule families that make the round-5 bug
+classes impossible to reintroduce silently:
+
+- ``lock-discipline`` — device dispatch / host sync / GIL-holding C calls
+  lexically inside ``with <lock>:`` bodies (the ``ops/ivf.py``
+  absorb-under-lock and ``parallel/exchange.py`` pickle-starved-heartbeat
+  class);
+- ``hidden-sync`` — implicit host round trips on serve-path modules,
+  cross-checked against the ``ops/dispatch_counter.py`` budget;
+- ``recompile-hazard`` — jitted calls fed unbucketed Python-varying
+  shapes (paired with the runtime tripwire in ``ops/recompile_guard.py``).
+
+Run ``python -m pathway_tpu.analysis pathway_tpu/`` for file:line
+diagnostics; suppress a reviewed finding in place with
+``# pathway: allow(<rule>): <reason>``.  The tier-1 gate
+(``tests/test_analysis.py``) asserts the whole tree stays clean.
+"""
+
+from .core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    default_rules,
+    iter_py_files,
+)
+from .hidden_sync import HiddenSyncRule
+from .lock_discipline import LockDisciplineRule
+from .recompile_hazard import RecompileHazardRule
+
+__all__ = [
+    "Finding",
+    "HiddenSyncRule",
+    "LockDisciplineRule",
+    "ModuleContext",
+    "RecompileHazardRule",
+    "Rule",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "default_rules",
+    "iter_py_files",
+    "main",
+]
+
+
+def main(argv=None) -> int:
+    from .__main__ import main as _main
+
+    return _main(argv)

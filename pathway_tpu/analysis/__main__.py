@@ -1,0 +1,71 @@
+"""CLI: ``python -m pathway_tpu.analysis [paths...]``.
+
+Prints one ``path:line:col: rule: message`` diagnostic per unsuppressed
+finding and exits 1 if any exist (0 on a clean tree) — the same contract
+the tier-1 gate test asserts through the API.  ``--show-suppressed``
+audits every pragma allowance alongside the live findings; ``--json``
+emits machine-readable records.
+
+The analysis modules themselves are pure stdlib + AST (no jax import),
+so the lint runs anywhere — pre-commit, CI boxes with no accelerator, a
+wedged-tunnel host — in well under a second once Python is up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from .core import analyze_paths, default_rules
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pathway_tpu.analysis",
+        description="Hot-path lint: lock-discipline, hidden-sync, "
+        "recompile-hazard.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["pathway_tpu"],
+        help="files or directories to analyze (default: pathway_tpu)",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings with their pragma reasons",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON lines",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule names + descriptions and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.name}: {rule.description}")
+        return 0
+
+    findings = analyze_paths(args.paths)
+    live = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else live
+    for f in shown:
+        if args.as_json:
+            print(json.dumps(f.__dict__))
+        else:
+            print(f.format())
+    n_sup = len(findings) - len(live)
+    print(
+        f"{len(live)} finding{'s' if len(live) != 1 else ''} "
+        f"({n_sup} suppressed)",
+        file=sys.stderr,
+    )
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,272 @@
+"""AST lint framework for the serving hot path.
+
+Round 5 shipped fixes for two instances of the same latent bug class —
+device work and GIL-holding C calls executed under a lock (`ops/ivf.py`
+absorb-under-lock, `parallel/exchange.py` pickle-starved heartbeat) — and
+the serve path's "2 dispatches + 2 fetches" budget is guarded only at
+runtime by `ops/dispatch_counter.py`.  This package detects those bug
+classes statically, repo-wide, on every tier-1 run, so they cannot be
+reintroduced silently.
+
+Framework pieces (rules live in sibling modules):
+
+- ``Finding`` — one diagnostic with ``path:line:col`` and a rule name;
+- pragma suppression — ``# pathway: allow(<rule>[, <rule>]): <reason>``
+  on (or covering) the offending line silences a finding WITH a recorded
+  reason.  A pragma on the first line of a compound statement (``with``,
+  ``for``, ``def``…) covers the whole statement body, so one reviewed
+  reason can bless an entire lock section.  ``# pathway: allow-file(...)``
+  covers the module.  Reasons are mandatory: a pragma without one is
+  itself reported;
+- ``# pathway: serve-path`` — marks a module as serve-path so the
+  hidden-sync rule applies to it (a default list covers the known serving
+  modules even without the marker);
+- ``analyze_paths`` / ``analyze_file`` — the repo walker used by both the
+  CLI (``python -m pathway_tpu.analysis``) and the tier-1 gate test.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "default_rules",
+    "iter_py_files",
+]
+
+_PRAGMA_RE = re.compile(
+    r"#\s*pathway:\s*allow(?P<scope>-file)?\(\s*(?P<rules>[\w\-, ]+)\s*\)"
+    r"\s*(?::\s*(?P<reason>\S.*?))?\s*$"
+)
+_SERVE_PATH_RE = re.compile(r"#\s*pathway:\s*serve-path\b")
+
+# modules the hidden-sync rule covers even without an in-file marker
+DEFAULT_SERVE_PATH_MODULES = (
+    "ops/serving.py",
+    "ops/retrieve_rerank.py",
+    "models/encoder.py",
+    "models/cross_encoder.py",
+)
+
+
+@dataclass
+class Finding:
+    """One diagnostic.  ``suppressed`` findings carry the pragma reason so
+    the CLI can audit every allowance alongside the live violations."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None
+
+    def format(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{tag}"
+
+
+@dataclass
+class _Pragma:
+    line: int
+    rules: Set[str]
+    reason: Optional[str]
+    whole_file: bool
+    span: Tuple[int, int] = (0, 0)  # statement body the pragma covers
+
+
+class ModuleContext:
+    """Everything a rule needs about one parsed module."""
+
+    def __init__(self, path: str, display_path: str, source: str):
+        self.path = path
+        self.display_path = display_path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.pragmas = _collect_pragmas(source)
+        _attach_spans(self.pragmas, self.tree)
+        self.serve_path = bool(_SERVE_PATH_RE.search(source)) or any(
+            display_path.replace(os.sep, "/").endswith(m)
+            for m in DEFAULT_SERVE_PATH_MODULES
+        )
+        from .registry import collect_jit_names
+
+        self.jit_names = collect_jit_names(self.tree)
+        self.findings: List[Finding] = []
+
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        suppressed, reason = self._suppression_for(rule, line)
+        self.findings.append(
+            Finding(
+                self.display_path, line, col, rule, message,
+                suppressed=suppressed, reason=reason,
+            )
+        )
+
+    def _suppression_for(self, rule: str, line: int) -> Tuple[bool, Optional[str]]:
+        for p in self.pragmas:
+            if rule not in p.rules and "*" not in p.rules:
+                continue
+            if p.whole_file or p.line == line or p.span[0] <= line <= p.span[1]:
+                return True, p.reason
+        return False, None
+
+
+class Rule:
+    """Base rule: subclasses set ``name`` and implement ``run(ctx)``,
+    reporting through ``ctx.report`` (suppression is applied centrally)."""
+
+    name = "rule"
+    description = ""
+
+    def run(self, ctx: ModuleContext) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _collect_pragmas(source: str) -> List[_Pragma]:
+    pragmas: List[_Pragma] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            pragmas.append(
+                _Pragma(
+                    line=tok.start[0],
+                    rules=rules,
+                    reason=m.group("reason"),
+                    whole_file=bool(m.group("scope")),
+                )
+            )
+    except tokenize.TokenError:  # unterminated strings etc: no pragmas then
+        pass
+    return pragmas
+
+
+def _attach_spans(pragmas: List[_Pragma], tree: ast.Module) -> None:
+    """A pragma on a statement's FIRST line covers the whole statement
+    (multi-line calls, a ``with`` body, a whole ``def``); a pragma on a
+    comment line of its own covers the statement starting on the NEXT
+    line (the conventional lint-pragma placement)."""
+    if not pragmas:
+        return
+    stmt_lines = {
+        node.lineno for node in ast.walk(tree) if isinstance(node, ast.stmt)
+    }
+    by_line: Dict[int, List[_Pragma]] = {}
+    for p in pragmas:
+        by_line.setdefault(p.line, []).append(p)
+        if p.line not in stmt_lines:
+            # standalone-comment placement only: claim the next line.  A
+            # TRAILING pragma must never leak onto the following statement
+            # — an unreviewed violation added right below an allowance has
+            # to stay visible to the gate.
+            by_line.setdefault(p.line + 1, []).append(p)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        for p in by_line.get(node.lineno, ()):
+            end = getattr(node, "end_lineno", node.lineno)
+            start = min(p.line, node.lineno)
+            p.span = (start, max(p.span[1], end))
+
+
+def default_rules() -> List[Rule]:
+    from .hidden_sync import HiddenSyncRule
+    from .lock_discipline import LockDisciplineRule
+    from .recompile_hazard import RecompileHazardRule
+
+    return [LockDisciplineRule(), HiddenSyncRule(), RecompileHazardRule()]
+
+
+def analyze_file(
+    path: str,
+    rules: Optional[Sequence[Rule]] = None,
+    display_path: Optional[str] = None,
+) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return analyze_source(
+        source, display_path or path, rules=rules, real_path=path
+    )
+
+
+def analyze_source(
+    source: str,
+    display_path: str,
+    rules: Optional[Sequence[Rule]] = None,
+    real_path: Optional[str] = None,
+) -> List[Finding]:
+    try:
+        ctx = ModuleContext(real_path or display_path, display_path, source)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                display_path, exc.lineno or 0, exc.offset or 0,
+                "parse-error", f"could not parse: {exc.msg}",
+            )
+        ]
+    for rule in rules if rules is not None else default_rules():
+        rule.run(ctx)
+    # a pragma with no reason is itself a violation: allowances must be
+    # reviewable, and "because it complained" is not a review
+    for p in ctx.pragmas:
+        if p.reason is None:
+            ctx.findings.append(
+                Finding(
+                    display_path, p.line, 0, "pragma-missing-reason",
+                    "suppression pragma without a ': <reason>' — every "
+                    "allowance must record why it is safe",
+                )
+            )
+    ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return ctx.findings
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def analyze_paths(
+    paths: Sequence[str], rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    rules = list(rules) if rules is not None else default_rules()
+    findings: List[Finding] = []
+    base = os.getcwd()
+    for file_path in iter_py_files(paths):
+        display = os.path.relpath(file_path, base)
+        if display.startswith(".."):
+            display = file_path
+        findings.extend(analyze_file(file_path, rules=rules, display_path=display))
+    return findings

@@ -1,0 +1,153 @@
+"""lock-discipline: no device work or GIL-holding C calls under a lock.
+
+The round-5 bug class: ``ops/ivf.py`` ran a device matmul + host fetch
+inside ``add()``'s lock section (every concurrent ``search``/``submit``
+stalled for the whole absorb), and ``parallel/exchange.py`` held the GIL
+in one multi-hundred-MB ``pickle.dumps`` so the heartbeat thread starved
+and healthy peers were declared dead.  Both are invisible to tests that
+don't race the exact schedule — but both are *lexically visible*: a call
+with device-dispatch / host-sync / GIL-holding semantics sitting inside a
+``with <lock>:`` body.
+
+Flagged inside lock bodies (nested ``def``/``lambda`` bodies excluded —
+they execute later, not under the lock):
+
+- calls to jitted functions (module ``jax.jit``/``pjit`` registry +
+  cache-getter convention — see ``registry.py``): a dispatch enqueues
+  device work and can block in C on a full device queue;
+- ``.block_until_ready()`` — an unbounded host sync;
+- ``jax.device_put`` / ``jax.device_get`` — blocking transfers;
+- ``np.asarray``/``np.array``/``float``/``int``/``.item()`` on a value
+  produced by a jitted call — an implicit device→host sync;
+- ``pickle.dumps`` / ``pickle.loads`` / ``Pickler.dump`` /
+  ``Unpickler.load`` — one GIL-holding C call for the whole payload.
+
+Deliberate cases (e.g. a dispatch-only launch under the lock that
+snapshots device state consistently and never blocks on the result) are
+suppressed at the ``with`` statement with a reviewed reason:
+``with self._lock:  # pathway: allow(lock-discipline): <why it is safe>``
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from .core import ModuleContext, Rule
+from .registry import (
+    dotted_name,
+    is_device_value_arg,
+    is_device_value_base,
+    is_jit_call,
+    is_lock_context,
+    scope_jit_and_device_vars,
+    walk_scope,
+)
+
+__all__ = ["LockDisciplineRule"]
+
+_TRANSFER_CALLS = {
+    "jax.device_put": "host→device transfer",
+    "jax.device_get": "device→host sync",
+}
+_PICKLE_CALLS = {
+    "pickle.dumps", "pickle.loads", "pickle.dump", "pickle.load",
+    "marshal.dumps", "marshal.loads",
+}
+_COERCIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+              "float", "int"}
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "device dispatch / host sync / GIL-holding C call inside a "
+        "`with <lock>:` body"
+    )
+
+    def run(self, ctx: ModuleContext) -> None:
+        # map each function scope to its (jit callables, device vars),
+        # inheriting through closures so `with` bodies resolve names bound
+        # by the enclosing function
+        scope_envs = {}
+
+        def visit_scope(scope, inherited_fns, inherited_vars):
+            fns, dvars = scope_jit_and_device_vars(
+                scope, ctx.jit_names, inherited_fns, inherited_vars
+            )
+            scope_envs[scope] = (fns, dvars)
+            # walk_scope stops at nested defs; recurse into them explicitly
+            # so closures inherit the enclosing scope's environment
+            for child in ast.iter_child_nodes(scope):
+                self._recurse_defs(child, fns, dvars, visit_scope)
+
+        visit_scope(ctx.tree, None, None)
+
+        for scope, (jit_fns, device_vars) in scope_envs.items():
+            for node in walk_scope(scope):
+                if isinstance(node, ast.With) and is_lock_context(node):
+                    self._check_lock_body(ctx, node, jit_fns, device_vars)
+
+    def _recurse_defs(self, node, fns, dvars, visit_scope) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            visit_scope(node, fns, dvars)
+            return
+        if isinstance(node, (ast.Lambda,)):
+            return
+        for child in ast.iter_child_nodes(node):
+            self._recurse_defs(child, fns, dvars, visit_scope)
+
+    def _check_lock_body(
+        self,
+        ctx: ModuleContext,
+        with_node: ast.With,
+        jit_fns: Set[str],
+        device_vars: Set[str],
+    ) -> None:
+        for node in walk_scope(with_node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            leaf = callee.rsplit(".", 1)[-1] if callee else ""
+            if is_jit_call(node, jit_fns):
+                ctx.report(
+                    self.name, node,
+                    f"jitted dispatch `{callee}(...)` under lock — device "
+                    "work (and a possible C-level block on a full queue) "
+                    "while every other thread waits on this lock",
+                )
+            elif leaf == "block_until_ready":
+                ctx.report(
+                    self.name, node,
+                    f"`{callee}()` under lock — unbounded host sync while "
+                    "holding the lock",
+                )
+            elif callee in _TRANSFER_CALLS:
+                ctx.report(
+                    self.name, node,
+                    f"`{callee}` under lock — {_TRANSFER_CALLS[callee]} "
+                    "blocks the lock for a full link round trip",
+                )
+            elif callee in _PICKLE_CALLS or leaf in ("dump", "load") and (
+                callee or ""
+            ).split(".", 1)[0].lower().find("pickl") >= 0:
+                ctx.report(
+                    self.name, node,
+                    f"`{callee}` under lock — one GIL-holding C call for "
+                    "the whole payload starves every other thread "
+                    "(heartbeats included) for its duration",
+                )
+            elif callee in _COERCIONS and is_device_value_arg(
+                node, jit_fns, device_vars
+            ):
+                ctx.report(
+                    self.name, node,
+                    f"`{callee}` of a jitted-call result under lock — "
+                    "implicit device→host sync while holding the lock",
+                )
+            elif leaf == "item" and is_device_value_base(node, device_vars):
+                ctx.report(
+                    self.name, node,
+                    "`.item()` on a jitted-call result under lock — "
+                    "implicit device→host sync while holding the lock",
+                )

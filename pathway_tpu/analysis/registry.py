@@ -1,0 +1,198 @@
+"""Resolution helpers shared by the lint rules.
+
+The rules need to answer, lexically, three questions the AST does not
+answer directly:
+
+1. **Is this call a device dispatch?**  A first pass over the module
+   collects every function defined via ``jax.jit`` / ``pjit`` (decorator,
+   ``partial(jax.jit, ...)`` decorator, or ``name = jax.jit(fn)``
+   assignment).  The serving code additionally reaches jitted callables
+   through per-shape cache getters (``self._forward_fn(...)``,
+   ``self._compiled_ivf(...)``, ``self._search_fn(...)`` — the repo-wide
+   convention), so a local variable assigned from such a getter is also a
+   jitted callee.
+2. **Is this variable a device array?**  Variables assigned (incl. tuple
+   unpacking) from a jitted call hold unfetched device values; coercing
+   one on the host (``np.asarray`` / ``float`` / ``int`` / ``.item()``)
+   is a blocking transfer.
+3. **Is this ``with`` statement a lock?**  Matched by name: any context
+   expression whose terminal identifier contains ``lock``/``mutex``/
+   ``cv``/``cond`` (``self._lock``, ``index._lock``,
+   ``self._send_locks[peer]``, condition variables).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+__all__ = [
+    "collect_jit_names",
+    "dotted_name",
+    "is_lock_context",
+    "scope_jit_and_device_vars",
+    "walk_scope",
+]
+
+# cache getters that hand back per-shape jitted callables (the repo-wide
+# naming convention for compiled-fn caches)
+_CACHE_GETTER_RE = re.compile(r"^_(compiled\w*|forward_fn|packed_fn|search_fn)$")
+_LOCK_NAME_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
+_JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains (subscripts transparent:
+    ``self._send_locks[peer]`` -> ``self._send_locks``); None otherwise."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``pjit(...)`` / ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return dotted_name(node) in _JIT_CTORS
+    name = dotted_name(node.func)
+    if name in _JIT_CTORS:
+        return True
+    if name in ("partial", "functools.partial") and node.args:
+        return dotted_name(node.args[0]) in _JIT_CTORS
+    return False
+
+
+def collect_jit_names(tree: ast.AST) -> Set[str]:
+    """Names bound to jitted callables anywhere in the module (module
+    level and nested: call sites resolve by bare name, which matches how
+    the code actually reaches them)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(dec) for dec in node.decorator_list):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            if _is_jit_expr(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+    return names
+
+
+def is_lock_context(with_node: ast.With) -> bool:
+    for item in with_node.items:
+        name = dotted_name(item.context_expr)
+        if name and _LOCK_NAME_RE.search(name.rsplit(".", 1)[-1]):
+            return True
+    return False
+
+
+def walk_scope(node: ast.AST, *, into_functions: bool = False) -> Iterable[ast.AST]:
+    """Walk ``node`` without descending into nested function/lambda/class
+    bodies (unless ``into_functions``): statements inside a nested ``def``
+    do not execute where they appear, so e.g. a completion closure defined
+    under a lock does not RUN under that lock."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if not into_functions and isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    return []
+
+
+def scope_jit_and_device_vars(
+    scope: ast.AST,
+    module_jit_names: Set[str],
+    inherited_fns: Optional[Set[str]] = None,
+    inherited_vars: Optional[Set[str]] = None,
+) -> (Set[str], Set[str]):
+    """For one function scope (or the module body): the set of local names
+    holding JITTED CALLABLES (from the module registry, ``jax.jit``
+    assignments, or cache-getter calls) and the set holding DEVICE VALUES
+    (assigned from a call to one of those callables).  ``inherited_*``
+    seed closures with the enclosing scope's sets."""
+    jit_fns: Set[str] = set(module_jit_names) | set(inherited_fns or ())
+    device_vars: Set[str] = set(inherited_vars or ())
+    # two passes so a getter assignment above or below a use both resolve
+    # (lexical order is irrelevant for name→kind classification here)
+    for _ in range(2):
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            names: List[str] = []
+            for tgt in node.targets:
+                names.extend(_target_names(tgt))
+            if not names:
+                continue
+            if _is_jit_expr(value):
+                jit_fns.update(names)
+            elif isinstance(value, ast.Call):
+                callee = dotted_name(value.func)
+                if callee is None:
+                    continue
+                leaf = callee.rsplit(".", 1)[-1]
+                if _CACHE_GETTER_RE.match(leaf):
+                    # tuple getters return (fn, extras...): only the first
+                    # element is the callable
+                    jit_fns.add(names[0])
+                elif leaf in jit_fns or callee in jit_fns:
+                    device_vars.update(names)
+    return jit_fns, device_vars
+
+
+def is_jit_call(call: ast.Call, jit_fns: Set[str]) -> bool:
+    callee = dotted_name(call.func)
+    if callee is None:
+        return False
+    return callee in jit_fns or callee.rsplit(".", 1)[-1] in jit_fns
+
+
+def is_device_value_arg(
+    call: ast.Call, jit_fns: Set[str], device_vars: Set[str]
+) -> bool:
+    """First positional argument of ``call`` is a device value: either a
+    direct jitted call, or a (possibly subscripted) name holding one —
+    shared by the lock-discipline and hidden-sync rules so the resolution
+    cannot drift between them."""
+    if not call.args:
+        return False
+    arg = call.args[0]
+    if isinstance(arg, ast.Call):
+        return is_jit_call(arg, jit_fns)
+    name = dotted_name(arg)  # Subscript-transparent: out[:n] -> "out"
+    return name is not None and name in device_vars
+
+
+def is_device_value_base(call: ast.Call, device_vars: Set[str]) -> bool:
+    """``call`` is a method on a device value (``out.item()``,
+    ``out[0].item()``)."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    base = dotted_name(call.func.value)
+    return base is not None and base in device_vars

@@ -101,6 +101,12 @@ class ClipModel:
         self._lock = threading.Lock()
         self._text_fns: Dict[tuple, Any] = {}
         self._image_fns: Dict[tuple, Any] = {}
+        # recompile tripwire (ops/recompile_guard.py): text batches bucket
+        # via _bucket and image batches have one shape, so the compile
+        # census is small; a leak warns (fails under tests)
+        from ..ops.recompile_guard import RecompileTripwire
+
+        self._tripwire = RecompileTripwire(f"ClipModel[{model}]")
         ids = jnp.zeros((1, 16), jnp.int32)
         mask = jnp.ones((1, 16), jnp.int32)
         imgs = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
@@ -125,6 +131,7 @@ class ClipModel:
             key = ids.shape
             fn = self._text_fns.get(key)
             if fn is None:
+                self._tripwire.observe(("text",) + tuple(key))
                 module = self.module
                 image_size = self.image_size
 
@@ -135,8 +142,12 @@ class ClipModel:
                     return t / jnp.maximum(jnp.linalg.norm(t, axis=-1, keepdims=True), 1e-9)
 
                 self._text_fns[key] = fn
-            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
-            return np.asarray(out)[:n]
+        # dispatch + fetch OFF the lock (the round-5 lock-discipline class:
+        # holding it across the device round trip serialized every
+        # concurrent encode for the full latency); the lock only guards
+        # tokenization and the compiled-fn cache
+        out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        return np.asarray(out)[:n]
 
     def encode_image(self, images: Sequence[np.ndarray]) -> np.ndarray:
         with self._lock:
@@ -158,6 +169,7 @@ class ClipModel:
             key = (b,)
             fn = self._image_fns.get(key)
             if fn is None:
+                self._tripwire.observe(("image",) + key)
                 module = self.module
 
                 @jax.jit
@@ -170,5 +182,6 @@ class ClipModel:
                     )
 
                 self._image_fns[key] = fn
-            out = fn(self.params, jnp.asarray(batch))
-            return np.asarray(out)[:n]
+        # dispatch + fetch off-lock, same as encode_text
+        out = fn(self.params, jnp.asarray(batch))
+        return np.asarray(out)[:n]

@@ -7,6 +7,8 @@ forward per padded batch."""
 
 from __future__ import annotations
 
+# pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
+
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -15,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.recompile_guard import RecompileTripwire
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
@@ -60,6 +63,9 @@ class CrossEncoderModel:
 
         self._lock = threading.Lock()
         self._fns: Dict[tuple, Any] = {}
+        # recompile tripwire (ops/recompile_guard.py): counts compile
+        # shapes, warns past budget, fails under tests
+        self._tripwire = RecompileTripwire(f"CrossEncoderModel[{model}]")
         self._hf = is_hf_checkpoint(checkpoint_path)
         if self._hf:
             # real-weights path: HF BertForSequenceClassification (the
@@ -92,6 +98,7 @@ class CrossEncoderModel:
     def _forward_fn(self, shape):
         fn = self._fns.get(shape)
         if fn is None:
+            self._tripwire.observe(shape)
             if self._hf:
                 fn = jax.jit(
                     lambda params, ids, mask, type_ids: self.module.apply(
@@ -128,27 +135,30 @@ class CrossEncoderModel:
         callable completing it (same submit/complete pattern as
         ``FusedEncodeSearch.submit``, so a serving pipeline can overlap
         cross-encoder rescoring with the next call's retrieval)."""
-        with self._lock:
-            n = len(pairs)
-            if n == 0:
-                return lambda: np.zeros((0,), np.float32)
-            if packed is None:
-                packed = not self._hf
-            if packed and not self._hf:
-                return self._submit_packed(pairs)
-            return self._submit_unpacked(pairs)
+        n = len(pairs)
+        if n == 0:
+            return lambda: np.zeros((0,), np.float32)
+        if packed is None:
+            packed = not self._hf
+        if packed and not self._hf:
+            return self._submit_packed(pairs)
+        return self._submit_unpacked(pairs)
 
     def _submit_unpacked(self, pairs: Sequence[Tuple[str, str]]):
-        """One pair per padded row (caller holds the lock) — the HF path
-        and the parity reference for the packed path."""
+        """One pair per padded row — the HF path and the parity reference
+        for the packed path.  The lock covers tokenization + the
+        compiled-fn cache only; the dispatch launches OFF it
+        (lock-discipline: concurrent rerank callers must not serialize
+        behind one thread's enqueue)."""
         from .encoder import _bucket
 
         n = len(pairs)
-        b = _bucket(n)
-        qs = [str(p[0]) for p in pairs] + [""] * (b - n)
-        ds = [str(p[1]) for p in pairs] + [""] * (b - n)
-        ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
-        fn = self._forward_fn(ids.shape)
+        with self._lock:
+            b = _bucket(n)
+            qs = [str(p[0]) for p in pairs] + [""] * (b - n)
+            ds = [str(p[1]) for p in pairs] + [""] * (b - n)
+            ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
+            fn = self._forward_fn(ids.shape)
         if self._hf:
             # BERT pair segments: tokens after the first [SEP] are type 1
             first_sep = np.argmax(ids == self.tokenizer.SEP, axis=1)
@@ -198,6 +208,7 @@ class CrossEncoderModel:
         key = ("packed", R, L, S)
         fn = self._fns.get(key)
         if fn is None:
+            self._tripwire.observe(key)
             module = self.module
 
             @jax.jit
@@ -215,18 +226,22 @@ class CrossEncoderModel:
         return self._fns[key]
 
     def _submit_packed(self, pairs: Sequence[Tuple[str, str]]):
-        """Packed async scoring (caller holds the lock): pack, dispatch ONE
-        forward over the packed rows, return a completion that gathers the
-        per-pair scores back into input order."""
+        """Packed async scoring: pack, dispatch ONE forward over the packed
+        rows, return a completion that gathers the per-pair scores back
+        into input order.  Pack + compiled-fn lookup run under the lock;
+        the dispatch launches OFF it (lock-discipline)."""
         from .encoder import _bucket
         from .packing import pad_packed_rows, seg_bucket
 
         n = len(pairs)
-        ids, segments, positions, doc_slots, n_seg = self._pack_pairs(pairs)
-        Rb = _bucket(ids.shape[0])
-        ids, segments, positions = pad_packed_rows(ids, segments, positions, Rb)
-        Sb = seg_bucket(n_seg)
-        fn = self._packed_fn(Rb, ids.shape[1], Sb)
+        with self._lock:
+            ids, segments, positions, doc_slots, n_seg = self._pack_pairs(pairs)
+            Rb = _bucket(ids.shape[0])
+            ids, segments, positions = pad_packed_rows(
+                ids, segments, positions, Rb
+            )
+            Sb = seg_bucket(n_seg)
+            fn = self._packed_fn(Rb, ids.shape[1], Sb)
         out = fn(
             self.params,
             jnp.asarray(ids),

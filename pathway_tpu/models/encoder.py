@@ -9,6 +9,8 @@ over the mesh "model" axis; batches shard over "data".
 
 from __future__ import annotations
 
+# pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
+
 import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence
@@ -17,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.recompile_guard import RecompileTripwire
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
@@ -54,6 +57,9 @@ class SentenceEncoder:
         self.mesh = mesh
         self._lock = threading.Lock()
         self._fns: Dict[tuple, Any] = {}
+        # recompile tripwire: every new compile shape is counted; past the
+        # budget it warns (fails under tests) — see ops/recompile_guard.py
+        self._tripwire = RecompileTripwire(f"SentenceEncoder[{model}]")
 
         from .hf_import import is_hf_checkpoint
 
@@ -116,6 +122,7 @@ class SentenceEncoder:
         key = (batch, length)
         fn = self._fns.get(key)
         if fn is None:
+            self._tripwire.observe(key)
             module = self.module
             normalize = self.normalize
             if self.mesh is not None:
@@ -160,8 +167,11 @@ class SentenceEncoder:
             padded = list(texts) + [""] * (b - n)
             ids, mask = self.tokenizer.encode_batch(padded)
             fn = self._forward_fn(ids.shape[0], ids.shape[1])
-            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
-            return out[:n]
+        # dispatch OFF the lock (lock-discipline): params/fn are stable
+        # refs, so the launch needs no lock — holding it would serialize
+        # concurrent encoders behind one device queue push
+        out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        return out[:n]
 
     def encode(self, texts: Sequence[str]) -> np.ndarray:
         """Batch encode: [B] strings -> [B, d] float32."""
@@ -212,31 +222,33 @@ class SentenceEncoder:
             )
             Sb = seg_bucket(n_seg)
             fn = self._packed_fn(Rb, ids.shape[1], Sb)
-            # no separate mask transfer: segments>0 IS the token mask in
-            # the packed forward
-            pooled = fn(
-                self.params,
-                jnp.asarray(ids),
-                jnp.asarray(segments),
-                jnp.asarray(positions),
-            )  # [Rb, Sb, d]
-            flat_ix = np.asarray(
-                [r * Sb + s for r, s in doc_slots], np.int32
+        # dispatch OFF the lock, same as encode_to_device
+        # no separate mask transfer: segments>0 IS the token mask in
+        # the packed forward
+        pooled = fn(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(segments),
+            jnp.asarray(positions),
+        )  # [Rb, Sb, d]
+        flat_ix = np.asarray(
+            [r * Sb + s for r, s in doc_slots], np.int32
+        )
+        nb = _bucket(n)
+        if nb > n:
+            flat_ix = np.concatenate(
+                [flat_ix, np.repeat(flat_ix[-1:], nb - n)]
             )
-            nb = _bucket(n)
-            if nb > n:
-                flat_ix = np.concatenate(
-                    [flat_ix, np.repeat(flat_ix[-1:], nb - n)]
-                )
-            out = jnp.take(
-                pooled.reshape(Rb * Sb, -1), jnp.asarray(flat_ix), axis=0
-            )
-            return out[:n]
+        out = jnp.take(
+            pooled.reshape(Rb * Sb, -1), jnp.asarray(flat_ix), axis=0
+        )
+        return out[:n]
 
     def _packed_fn(self, R: int, L: int, S: int):
         key = ("packed", R, L, S)
         fn = self._fns.get(key)
         if fn is None:
+            self._tripwire.observe(key)
             module = self.module
             normalize = self.normalize
 

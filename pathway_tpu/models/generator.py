@@ -52,6 +52,11 @@ class TextGenerator:
         self.module = TransformerEncoder(self.config)
         self._lock = threading.Lock()
         self._fns: Dict[tuple, Any] = {}
+        # recompile tripwire (ops/recompile_guard.py): decode shapes are
+        # (batch bucket, padded length, steps); a leak fails under tests
+        from ..ops.recompile_guard import RecompileTripwire
+
+        self._tripwire = RecompileTripwire(f"TextGenerator[{model}]")
         ids = jnp.zeros((1, 16), jnp.int32)
         mask = jnp.ones((1, 16), jnp.int32)
         self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask)["params"]
@@ -63,6 +68,7 @@ class TextGenerator:
         key = (B, L, steps)
         fn = self._fns.get(key)
         if fn is None:
+            self._tripwire.observe(key)
             module = self.module
 
             def decode(params, ids, mask, temperature, rng):
@@ -121,19 +127,23 @@ class TextGenerator:
             ids = np.concatenate([ids, pad], axis=1)
             mask_full = np.concatenate([mask, pad], axis=1)
             fn = self._decode_fn(ids.shape[0], ids.shape[1], max_new_tokens)
-            toks = fn(
-                self.params,
-                jnp.asarray(ids),
-                jnp.asarray(mask_full),
-                jnp.float32(temperature),
-                jax.random.PRNGKey(seed),
-            )
-            toks = np.asarray(toks)[:n]
-            # hashing tokenizer is not invertible; render token ids
-            return [
-                " ".join(f"<{int(t)}>" for t in row if t != self.tokenizer.PAD)
-                for row in toks
-            ]
+        # dispatch + fetch OFF the lock (lock-discipline: holding it across
+        # the decode round trip serialized concurrent generates for the
+        # full device latency); the lock only guards tokenization and the
+        # compiled-fn cache
+        toks = fn(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(mask_full),
+            jnp.float32(temperature),
+            jax.random.PRNGKey(seed),
+        )
+        toks = np.asarray(toks)[:n]
+        # hashing tokenizer is not invertible; render token ids
+        return [
+            " ".join(f"<{int(t)}>" for t in row if t != self.tokenizer.PAD)
+            for row in toks
+        ]
 
     def __call__(self, prompts: Sequence[str], **kwargs) -> List[str]:
         return self.generate(prompts, **kwargs)

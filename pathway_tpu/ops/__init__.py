@@ -3,6 +3,12 @@ reference's native hot paths: ndarray expressions in src/mat_mul.rs, external
 index scoring in src/external_integration/)."""
 
 from .knn import DeviceKnnIndex
+from .recompile_guard import (
+    RecompileBudgetExceeded,
+    RecompileTripwire,
+    RecompileWarning,
+    guarded_jit,
+)
 from .retrieve_rerank import RetrieveRerankPipeline
 from .serving import FusedEncodeSearch
 from .topk import merge_topk, sharded_topk
@@ -10,7 +16,11 @@ from .topk import merge_topk, sharded_topk
 __all__ = [
     "DeviceKnnIndex",
     "FusedEncodeSearch",
+    "RecompileBudgetExceeded",
+    "RecompileTripwire",
+    "RecompileWarning",
     "RetrieveRerankPipeline",
+    "guarded_jit",
     "sharded_topk",
     "merge_topk",
 ]

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .knn import _bucket, normalize_metric
+from .recompile_guard import RecompileTripwire
 
 __all__ = ["IvfKnnIndex"]
 
@@ -61,6 +62,7 @@ def _kmeans(
         return jnp.argmax(scores, axis=1)
 
     for _ in range(iters):
+        # pathway: allow(recompile-hazard): train-time — centroids keep one [C, d] shape for all iterations of a build; one compile per (C, d), off the serve path
         owner = np.asarray(assign(jnp.asarray(centroids)))
         sums = np.zeros_like(centroids)
         np.add.at(sums, owner, sample)
@@ -197,6 +199,9 @@ class IvfKnnIndex:
         self._tail: Dict[int, None] = {}  # keys added since last build
         self._built_n = 0
         self._search_fns: Dict[tuple, Any] = {}
+        # recompile tripwire (ops/recompile_guard.py): search shapes are
+        # bucketed, so the signature census stays small; a leak trips
+        self._tripwire = RecompileTripwire("IvfKnnIndex.search")
         # host mirror of slot occupancy (True = live row), for absorb's
         # free-slot allocation without a device fetch
         self._live_mask: Optional[np.ndarray] = None
@@ -419,9 +424,11 @@ class IvfKnnIndex:
             chunk = data[start : start + step]
             if chunk.shape[0] < step and n > step:
                 pad = np.zeros((step - chunk.shape[0], data.shape[1]), data.dtype)
+                # pathway: allow(recompile-hazard): build-time — chunks are padded to the fixed 131072-row step, so large builds compile once; the n<=step case compiles once per corpus size per build
                 got = np.asarray(_prefs(jnp.asarray(np.concatenate([chunk, pad]))))
                 parts.append(got[: chunk.shape[0]])
             else:
+                # pathway: allow(recompile-hazard): build-time — one compile per (n, d) layout build, off the serve path (serving shapes go through _bucket)
                 parts.append(np.asarray(_prefs(jnp.asarray(chunk))))
         order = np.concatenate(parts) if len(parts) > 1 else parts[0]
         assignment, counts = _balanced_assign(order, C, cap)
@@ -826,6 +833,7 @@ class IvfKnnIndex:
                 C_pad, M_pad, d_pad
             )
 
+        # pathway: allow(recompile-hazard): bulk build — one compile per (n, layout) build_from_matrix call; never on the serve path
         slabs = _layout(
             matrix_dev,
             jnp.asarray(order_by_cluster, jnp.int32),
@@ -913,7 +921,12 @@ class IvfKnnIndex:
                     ],
                     axis=1,
                 )
-            scores, slots, t_scores, t_idx = fn(
+            # dispatch must stay under the lock: a concurrent absorb commit
+            # DONATES the slab/bias buffers (_absorb_scatter), so a launch
+            # against refs snapshotted before the lock dropped could name
+            # freed device memory.  The enqueue itself is async (no host
+            # block); only the launch ordering needs the lock.
+            scores, slots, t_scores, t_idx = fn(  # pathway: allow(lock-discipline): dispatch-only — donated absorb buffers force launch-before-unlock; fetch happens off-lock below
                 jnp.asarray(q_pad, jnp.float32),
                 self._slabs,
                 self._bias,
@@ -922,37 +935,47 @@ class IvfKnnIndex:
                 tail_dev,
                 tail_valid_dev,
             )
-            scores = np.asarray(scores)[:nq]
-            slots = np.asarray(slots)[:nq]
-            t_scores = np.asarray(t_scores)[:nq] if t_pad else None
-            t_idx = np.asarray(t_idx)[:nq] if t_pad else None
-            out: List[List[Tuple[int, float]]] = []
-            for qi in range(nq):
-                row: List[Tuple[int, float]] = []
-                for j in range(slots.shape[1]):
-                    s = float(scores[qi, j])
-                    slot = int(slots[qi, j])
-                    if not np.isfinite(s) or slot < 0:
-                        continue
-                    key = int(self._keys_by_slot[slot])
-                    if key in self._slot_of_key:
-                        row.append((key, s))
-                if t_pad:
-                    for j in range(t_idx.shape[1]):
-                        s = float(t_scores[qi, j])
-                        ti = int(t_idx[qi, j])
-                        if np.isfinite(s) and ti < len(tail):
-                            row.append((tail[ti], s))
-                row.sort(key=lambda kv: -kv[1])
-                # drop duplicate keys (upsert landed in both built+tail)
-                seen = set()
-                dedup = []
-                for key, s in row:
-                    if key not in seen:
-                        seen.add(key)
-                        dedup.append((key, s))
-                out.append(dedup[:k])
-            return out
+            # dispatch-time snapshot for off-lock completion: rebuilds and
+            # absorbs REPLACE keys_by_slot (copy-on-write), so this ref is
+            # the dispatch-time slot->key view.  No live-dict filter below:
+            # rows removed BEFORE dispatch are already -inf-biased in the
+            # dispatched arrays (bias is replaced functionally), and a
+            # removal landing after dispatch must not shrink this result —
+            # dispatch-time semantics, same as the fused serving path
+            keys_by_slot = self._keys_by_slot
+        # device round trip + python post-processing OFF the lock — holding
+        # it across the fetch blocked every concurrent add()/absorb commit
+        # and search for the full device latency (the round-5 bug class;
+        # found by `python -m pathway_tpu.analysis`)
+        scores = np.asarray(scores)[:nq]
+        slots = np.asarray(slots)[:nq]
+        t_scores = np.asarray(t_scores)[:nq] if t_pad else None
+        t_idx = np.asarray(t_idx)[:nq] if t_pad else None
+        out: List[List[Tuple[int, float]]] = []
+        for qi in range(nq):
+            row: List[Tuple[int, float]] = []
+            for j in range(slots.shape[1]):
+                s = float(scores[qi, j])
+                slot = int(slots[qi, j])
+                if not np.isfinite(s) or slot < 0:
+                    continue
+                row.append((int(keys_by_slot[slot]), s))
+            if t_pad:
+                for j in range(t_idx.shape[1]):
+                    s = float(t_scores[qi, j])
+                    ti = int(t_idx[qi, j])
+                    if np.isfinite(s) and ti < len(tail):
+                        row.append((tail[ti], s))
+            row.sort(key=lambda kv: -kv[1])
+            # drop duplicate keys (upsert landed in both built+tail)
+            seen = set()
+            dedup = []
+            for key, s in row:
+                if key not in seen:
+                    seen.add(key)
+                    dedup.append((key, s))
+            out.append(dedup[:k])
+        return out
 
     def _search_fn(self, B: int, k: int, p: int, t_pad: int):
         key = (
@@ -963,6 +986,7 @@ class IvfKnnIndex:
         )
         fn = self._search_fns.get(key)
         if fn is None:
+            self._tripwire.observe(key)
             M = self._M_pad
             d = self.dimension
             k_main = min(k, p * M)

@@ -1,0 +1,164 @@
+"""Runtime recompile tripwire — the dynamic half of the recompile-hazard
+lint (pathway_tpu/analysis/recompile_hazard.py).
+
+The static rule catches jitted calls fed unbucketed shapes lexically; a
+hazard that slips past it (shapes threaded through data, a bucketing
+helper that stops covering a new code path) still shows up at runtime as
+one jitted callable accumulating compiled signatures without bound.  Every
+compiled-fn cache in the serving stack registers its signatures here; a
+callable crossing its budget warns once in production and FAILS under
+tests (pytest or ``PATHWAY_RECOMPILE_STRICT=1``), so a recompile leak is
+a red test instead of a silent latency cliff.
+
+``RecompileTripwire`` is the counting primitive (used directly by the
+per-shape ``_fns`` caches); ``guarded_jit`` wraps a bare function for
+code without a cache dict.  The default budget is generous — the bucketed
+paths compile a few dozen shapes at most (batch buckets × /16 length
+buckets) — and tunable via ``PATHWAY_RECOMPILE_LIMIT``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+__all__ = [
+    "RecompileBudgetExceeded",
+    "RecompileTripwire",
+    "RecompileWarning",
+    "guarded_jit",
+    "signature_of",
+    "strict_mode",
+]
+
+
+class RecompileWarning(UserWarning):
+    """A jitted callable crossed its compiled-signature budget."""
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """Strict-mode flavor of :class:`RecompileWarning`."""
+
+
+def _default_limit() -> int:
+    return int(os.environ.get("PATHWAY_RECOMPILE_LIMIT", "128"))
+
+
+def strict_mode() -> bool:
+    """Fail (raise) instead of warn: explicitly via
+    ``PATHWAY_RECOMPILE_STRICT=1`` / off via ``=0``; defaults to on under
+    pytest so a recompile leak is a red test, never a silent slowdown."""
+    flag = os.environ.get("PATHWAY_RECOMPILE_STRICT")
+    if flag is not None:
+        return flag not in ("", "0", "false", "no")
+    return "PYTEST_CURRENT_TEST" in os.environ
+
+
+class RecompileTripwire:
+    """Counts distinct compile signatures for ONE logical jitted callable
+    (an instance's compiled-fn cache, or one ``guarded_jit`` wrapper).
+
+    ``observe(key)`` is called with the compile key each time a new
+    compiled variant is (about to be) created; past ``limit`` distinct
+    keys it warns — or raises in strict mode — with the full signature
+    census so the unbucketed dimension is visible in the message."""
+
+    def __init__(self, name: str, limit: Optional[int] = None):
+        self.name = name
+        self.limit = limit if limit is not None else _default_limit()
+        self._sigs: Set[Any] = set()
+        self._lock = threading.Lock()
+        self.tripped = False
+
+    @property
+    def signatures(self) -> int:
+        return len(self._sigs)
+
+    def observe(self, signature: Any) -> bool:
+        """Record one compile signature; returns True if it was new.
+        Warns/raises when the count first exceeds ``limit`` (and again at
+        every further doubling, so a still-leaking path stays loud without
+        spamming every call)."""
+        with self._lock:
+            if signature in self._sigs:
+                return False
+            self._sigs.add(signature)
+            n = len(self._sigs)
+        if n > self.limit and (
+            n == self.limit + 1 or (n & (n - 1)) == 0
+        ):
+            self.tripped = True
+            msg = (
+                f"jitted callable {self.name!r} accumulated {n} compiled "
+                f"signatures (budget {self.limit}) — an input dimension "
+                "is not bucketed, so every new size pays an XLA compile "
+                f"on the hot path; last signature: {signature!r}. Bucket "
+                "the varying dimension (_bucket/seg_bucket/"
+                "row_length_bucket) or raise PATHWAY_RECOMPILE_LIMIT if "
+                "the shape set is genuinely this large."
+            )
+            if strict_mode():
+                raise RecompileBudgetExceeded(msg)
+            warnings.warn(msg, RecompileWarning, stacklevel=3)
+        return True
+
+
+def signature_of(*args: Any, **kwargs: Any) -> Tuple:
+    """Abstract compile signature of a call: (shape, dtype) for
+    array-likes, pytrees walked structurally, everything else by type —
+    mirroring what jax keys its compile cache on (weak types aside)."""
+
+    def leaf(x: Any) -> Any:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return (tuple(shape), str(dtype))
+        if isinstance(x, (list, tuple)):
+            return tuple(leaf(v) for v in x)
+        if isinstance(x, dict):
+            return tuple(sorted((k, leaf(v)) for k, v in x.items()))
+        if isinstance(x, (bool, int, float, str, bytes, type(None))):
+            # static-ish scalars: value participates (python scalars
+            # re-trace under jit only via weak-type promotion, but a
+            # varying static arg IS a recompile)
+            return (type(x).__name__, x)
+        return type(x).__name__
+    sig = tuple(leaf(a) for a in args)
+    if kwargs:
+        sig += (tuple(sorted((k, leaf(v)) for k, v in kwargs.items())),)
+    return sig
+
+
+def guarded_jit(
+    fn: Optional[Callable] = None,
+    *,
+    name: Optional[str] = None,
+    limit: Optional[int] = None,
+    **jit_kwargs: Any,
+) -> Callable:
+    """``jax.jit`` with the tripwire attached: each call's abstract
+    signature is observed before dispatch, so shape churn trips even when
+    jax silently absorbs it into its own cache.  Usable bare
+    (``@guarded_jit``) or configured (``@guarded_jit(limit=8)``); the
+    wrapper exposes ``.tripwire`` for tests."""
+    if fn is None:
+        return lambda f: guarded_jit(f, name=name, limit=limit, **jit_kwargs)
+    import functools
+
+    import jax
+
+    jitted = jax.jit(fn, **jit_kwargs)
+    tripwire = RecompileTripwire(
+        name or getattr(fn, "__qualname__", repr(fn)), limit=limit
+    )
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any):
+        tripwire.observe(signature_of(*args, **kwargs))
+        return jitted(*args, **kwargs)
+
+    wrapper.tripwire = tripwire
+    wrapper.jitted = jitted
+    return wrapper

@@ -26,6 +26,8 @@ call N+1 is already queued behind it.
 
 from __future__ import annotations
 
+# pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
+
 import threading
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
@@ -34,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .dispatch_counter import record_dispatch, record_fetch
+from .recompile_guard import RecompileTripwire
 from .serving import FusedEncodeSearch
 
 __all__ = ["RetrieveRerankPipeline"]
@@ -113,6 +116,9 @@ class RetrieveRerankPipeline:
         self.candidates = candidates or max(4 * k, 16)
         self._lock = threading.Lock()
         self._fns: Dict[Tuple, Any] = {}
+        # recompile tripwire (ops/recompile_guard.py): stage-2 shapes are
+        # bucketed (row/length/segment/query); a leak trips under tests
+        self._tripwire = RecompileTripwire("RetrieveRerankPipeline.stage2")
         self.stats = {"serves": 0, "stage2_pairs": 0, "stage2_rows": 0}
 
     # -- host helpers -------------------------------------------------------
@@ -138,6 +144,7 @@ class RetrieveRerankPipeline:
         fn = self._fns.get(key)
         if fn is not None:
             return fn
+        self._tripwire.observe(key)
         module = self.cross_encoder.module
 
         @jax.jit

@@ -12,6 +12,8 @@ async host copy — exactly one round trip per serve call.
 
 from __future__ import annotations
 
+# pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
+
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -21,6 +23,7 @@ import numpy as np
 
 from .dispatch_counter import record_dispatch, record_fetch
 from .knn import _bucket
+from .recompile_guard import RecompileTripwire
 
 __all__ = ["FusedEncodeSearch"]
 
@@ -41,6 +44,9 @@ class FusedEncodeSearch:
         self.k = k
         self._lock = threading.Lock()
         self._fns: Dict[Tuple, Any] = {}
+        # recompile tripwire (ops/recompile_guard.py): the fused kernel
+        # must stay at a handful of compile shapes in steady state
+        self._tripwire = RecompileTripwire("FusedEncodeSearch")
         # IVF indexes lack device key planes; winners map slot->key on host
         self._ivf = hasattr(index, "_centroids")
 
@@ -49,6 +55,7 @@ class FusedEncodeSearch:
         fn = self._fns.get(key)
         if fn is not None:
             return fn
+        self._tripwire.observe(key)
         module = self.encoder.module
         metric = self.index.metric
         normalize = metric == "cos"
@@ -116,6 +123,7 @@ class FusedEncodeSearch:
         fn = self._fns.get(shape_key)
         if fn is not None:
             return fn, k_main, k_tail
+        self._tripwire.observe(shape_key)
         use_pallas = jax.default_backend() == "tpu"
 
         @jax.jit
@@ -303,7 +311,15 @@ class FusedEncodeSearch:
                 )
             B, L = ids.shape
             fn = self._compiled(B, L, k_eff, index.capacity)
-            out = fn(
+            # capture the device view under the lock; LAUNCH off it.  The
+            # exact index replaces matrix/valid/keys functionally (never
+            # in place, never donated), so refs snapshotted here stay
+            # valid and consistent after the lock drops — unlike the IVF
+            # path, whose absorb DONATES slab buffers and must launch
+            # before unlocking.  Nothing else host-side to snapshot: the
+            # winners' keys come back IN the packed output, and a slot
+            # removed at snapshot time scores -inf and is dropped below.
+            args = (
                 self.encoder.params,
                 ids,
                 mask,
@@ -312,14 +328,10 @@ class FusedEncodeSearch:
                 index._keys_hi,
                 index._keys_lo,
             )
-            record_dispatch("serve_exact")
-            if hasattr(out, "copy_to_host_async"):
-                out.copy_to_host_async()
-            # nothing host-side to snapshot: the dispatch captured a
-            # consistent device view under the index lock (matrix/valid/keys
-            # are replaced functionally, never mutated in place), and the
-            # winners' keys come back IN the packed output.  A slot whose row
-            # was removed at dispatch time scores -inf and is dropped below.
+        out = fn(*args)
+        record_dispatch("serve_exact")
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
 
         def complete() -> List[List[Tuple[int, float]]]:
             arr = np.asarray(out)[:n_real]

@@ -31,6 +31,10 @@ class CachedObjectStorage:
         self.backend = backend
         self.namespace = namespace
         self._lock = threading.Lock()
+        # in-flight computes keyed by blob key: dedups same-key work
+        # without holding any lock across compute()/pickle (see
+        # get_or_compute)
+        self._inflight: dict = {}
 
     def _blob_key(self, key: Any, version: Any) -> str:
         return f"{self.namespace}/{_digest(key, version)}"
@@ -56,15 +60,50 @@ class CachedObjectStorage:
         self, key: Any, compute: Callable[[], Any], version: Any = None
     ) -> Any:
         """Cached call: returns the stored value for (key, version), or runs
-        ``compute`` once and stores its result.  The lock only guards the
-        in-process race; backends are last-writer-wins like the reference."""
-        blob = self.backend.get(self._blob_key(key, version))
+        ``compute`` once and stores its result.  Backends are
+        last-writer-wins like the reference.
+
+        Same-key in-process races dedup through a per-key in-flight event
+        instead of one global critical section: the old structure held the
+        cache-wide lock across ``compute()`` (arbitrary user code — a PDF
+        parse, a model call) AND the pickle of its result (one GIL-holding
+        C call for the whole payload), so every other thread's cache access
+        stalled behind it — the round-5 ``parallel/exchange.py`` bug class,
+        flagged by the lock-discipline lint.  The global lock now only
+        guards the in-flight dict (a couple of dict ops)."""
+        bkey = self._blob_key(key, version)
+        blob = self.backend.get(bkey)
         if blob is not None:
             return pickle.loads(blob)
         with self._lock:
-            blob = self.backend.get(self._blob_key(key, version))
+            waiter = self._inflight.get(bkey)
+            event = None
+            if waiter is None:
+                event = self._inflight[bkey] = threading.Event()
+        if waiter is not None:
+            # another thread owns this key's compute: wait, then re-read
+            waiter.wait()
+            blob = self.backend.get(bkey)
+            if blob is not None:
+                return pickle.loads(blob)
+            # the owner failed; claim ownership for our own attempt (if a
+            # third thread already re-claimed it, compute un-deduped —
+            # correctness over dedup, and never wait twice)
+            with self._lock:
+                if self._inflight.get(bkey) is None:
+                    event = self._inflight[bkey] = threading.Event()
+        try:
+            blob = self.backend.get(bkey)
             if blob is not None:
                 return pickle.loads(blob)
             value = compute()
-            self.backend.put(self._blob_key(key, version), pickle.dumps(value))
+            self.backend.put(bkey, pickle.dumps(value))
             return value
+        finally:
+            # only the OWNER retires its own event: popping someone
+            # else's entry would wake their waiters before the value lands
+            if event is not None:
+                with self._lock:
+                    if self._inflight.get(bkey) is event:
+                        del self._inflight[bkey]
+                event.set()

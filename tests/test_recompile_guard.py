@@ -1,0 +1,117 @@
+"""Runtime recompile tripwire: unbucketed shape churn must trip; the
+bucketed production paths must stay inside a small signature census.
+
+This is the dynamic half of the recompile-hazard lint
+(tests/test_analysis.py covers the static half): a jitted callable fed
+Python-varying shapes accumulates one compiled signature per distinct
+size, and the tripwire turns that into a failure under tests instead of
+a silent XLA-compile-per-call latency cliff in production.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.ops.recompile_guard import (
+    RecompileBudgetExceeded,
+    RecompileTripwire,
+    RecompileWarning,
+    guarded_jit,
+    signature_of,
+    strict_mode,
+)
+
+
+def test_strict_mode_defaults_on_under_pytest():
+    assert strict_mode()
+
+
+def test_tripwire_fires_on_varying_shapes():
+    """Deliberately unbucketed jitted op: every batch size is a new
+    compile signature; the tripwire must fail the test past its budget."""
+
+    @guarded_jit(limit=4)
+    def score(x):
+        return (x * 2.0).sum()
+
+    with pytest.raises(RecompileBudgetExceeded, match="compiled signatures"):
+        for n in range(1, 32):
+            score(jnp.zeros((n, 8), jnp.float32))
+    assert score.tripwire.tripped
+    assert score.tripwire.signatures > 4
+
+
+def test_tripwire_warns_when_not_strict(monkeypatch):
+    monkeypatch.setenv("PATHWAY_RECOMPILE_STRICT", "0")
+
+    @guarded_jit(limit=2)
+    def score(x):
+        return x + 1
+
+    with pytest.warns(RecompileWarning):
+        for n in range(1, 8):
+            score(jnp.zeros((n,), jnp.float32))
+    # warning mode keeps serving alive: calls still succeed past the trip
+    assert score.tripwire.signatures == 7
+
+
+def test_stable_shapes_never_trip():
+    @guarded_jit(limit=2)
+    def score(x):
+        return x * 3
+
+    for _ in range(50):
+        score(jnp.zeros((16, 4), jnp.float32))
+    assert score.tripwire.signatures == 1
+    assert not score.tripwire.tripped
+
+
+def test_signature_of_distinguishes_shape_dtype_and_statics():
+    a = np.zeros((4, 8), np.float32)
+    assert signature_of(a) == signature_of(np.ones((4, 8), np.float32))
+    assert signature_of(a) != signature_of(np.zeros((4, 9), np.float32))
+    assert signature_of(a) != signature_of(np.zeros((4, 8), np.int32))
+    assert signature_of(a, k=5) != signature_of(a, k=6)
+
+
+def test_observe_dedups_and_counts():
+    tw = RecompileTripwire("t", limit=100)
+    assert tw.observe((1, 2)) is True
+    assert tw.observe((1, 2)) is False
+    assert tw.signatures == 1
+
+
+def test_bucketed_encoder_paths_stay_bounded():
+    """The production discipline under test: `_bucket` (batch) and the
+    /16 length padding keep the encoder's compiled-signature census small
+    no matter how ragged the input stream is.  15+ distinct workloads
+    through both the plain and the PACKED path (models/packing.py row/
+    segment bucketing) must stay far inside the tripwire budget — and a
+    strict-mode pytest run doubles as the assertion that nothing trips."""
+    from pathway_tpu.models.encoder import SentenceEncoder
+
+    enc = SentenceEncoder(dimension=64, n_layers=1, n_heads=2, max_length=32)
+    texts = ["stream " * (1 + i % 7) for i in range(40)]
+    for n in (1, 2, 3, 4, 5, 7, 9, 12, 15, 16, 17):
+        enc.encode(texts[:n])
+    for n in (1, 3, 6, 10, 14, 18, 25, 33, 40):
+        np.asarray(enc.encode_packed_to_device(texts[:n]))
+    assert not enc._tripwire.tripped
+    # plain path: a handful of (batch bucket, length) shapes; packed path:
+    # (row bucket, row length bucket, segment bucket).  20 workloads must
+    # collapse to ~a dozen signatures, not one per input size.
+    assert enc._tripwire.signatures <= 12, enc._tripwire.signatures
+
+
+def test_bucketed_cross_encoder_packed_path_stays_bounded():
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+
+    ce = CrossEncoderModel(dimension=64, n_layers=1, n_heads=2, max_length=64)
+    qs = ["what is a stream join"] * 24
+    ds = ["docs " * (1 + i % 9) for i in range(24)]
+    for n in (1, 2, 4, 6, 9, 13, 18, 24):
+        ce.predict(list(zip(qs[:n], ds[:n])))
+    assert not ce._tripwire.tripped
+    assert ce._tripwire.signatures <= 10, ce._tripwire.signatures
