@@ -294,35 +294,27 @@ def phase_retrieval(backend: str, extras: dict) -> float:
     return p50_device
 
 
-def phase_retrieve_rerank(backend: str, extras: dict) -> float:
-    """Fused two-stage serving (ops/retrieve_rerank.py): encode+search is
-    dispatch #1, packed cross-encoder rescoring is dispatch #2 — a full
-    retrieve→rerank serve is two device round trips, and consecutive calls
-    pipeline (stage 2 of call N overlaps stage 1 of call N+1).  Reports
-    cross-encoder pairs/s (the phase value), per-call latency sync and
-    pipelined, the packing row compression, and the measured dispatch/fetch
-    budget."""
-    jax = _init_jax(backend)
-
+def _build_rr_pipeline(n_docs: int, n_queries: int, k: int, candidates: int,
+                       small: bool = False):
+    """Shared serve-stack setup for the retrieve_rerank and
+    observe_overhead phases: models, chunked device ingest into an exact
+    index, fused retriever + rerank pipeline, query set.  ``small`` picks
+    scaled-down models (the observe phase's CPU arm measures host-side
+    recorder overhead, which is model-size blind)."""
     from pathway_tpu.models.cross_encoder import CrossEncoderModel
     from pathway_tpu.models.encoder import SentenceEncoder
-    from pathway_tpu.ops import dispatch_counter
     from pathway_tpu.ops.knn import DeviceKnnIndex
     from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
     from pathway_tpu.ops.serving import FusedEncodeSearch
 
-    backend = jax.default_backend()
-    extras["backend"] = backend
-    # CPU fallback runs the full-size models at a fraction of the corpus
-    # and iteration count (one serve call is ~8 s of CPU cross-encoder
-    # work; the phase must fit its 900 s subprocess budget)
-    n_docs = int(
-        os.environ.get("BENCH_RR_DOCS", "100000" if backend == "tpu" else "2000")
-    )
-    dim, n_queries, k, candidates = 384, 16, 10, 32
-
-    encoder = SentenceEncoder(dimension=dim, n_layers=6, max_length=128)
-    cross = CrossEncoderModel(dimension=256, n_layers=4, max_length=256)
+    if small:
+        encoder = SentenceEncoder(dimension=64, n_layers=2, max_length=64)
+        cross = CrossEncoderModel(dimension=64, n_layers=2, max_length=128)
+        dim = 64
+    else:
+        encoder = SentenceEncoder(dimension=384, n_layers=6, max_length=128)
+        cross = CrossEncoderModel(dimension=256, n_layers=4, max_length=256)
+        dim = 384
     index = DeviceKnnIndex(dimension=dim, metric="cos", initial_capacity=n_docs)
     # variable-length prose, log-normal lengths — the packing win is real
     # row sharing, not an artifact of uniform short docs
@@ -334,12 +326,37 @@ def phase_retrieve_rerank(backend: str, extras: dict) -> float:
             range(start, start + len(part)), encoder.encode_to_device(part)
         )
     index._matrix.block_until_ready()
-
     queries = [docs[(i * 9973) % n_docs] for i in range(n_queries)]
-    retriever = FusedEncodeSearch(encoder, index, k=candidates)
     pipe = RetrieveRerankPipeline(
-        retriever, cross, doc_text=dict(enumerate(docs)), k=k,
-        candidates=candidates,
+        FusedEncodeSearch(encoder, index, k=candidates), cross,
+        doc_text=dict(enumerate(docs)), k=k, candidates=candidates,
+    )
+    return pipe, cross, docs, queries
+
+
+def phase_retrieve_rerank(backend: str, extras: dict) -> float:
+    """Fused two-stage serving (ops/retrieve_rerank.py): encode+search is
+    dispatch #1, packed cross-encoder rescoring is dispatch #2 — a full
+    retrieve→rerank serve is two device round trips, and consecutive calls
+    pipeline (stage 2 of call N overlaps stage 1 of call N+1).  Reports
+    cross-encoder pairs/s (the phase value), per-call latency sync and
+    pipelined, the packing row compression, and the measured dispatch/fetch
+    budget."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu.ops import dispatch_counter
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    # CPU fallback runs the full-size models at a fraction of the corpus
+    # and iteration count (one serve call is ~8 s of CPU cross-encoder
+    # work; the phase must fit its 900 s subprocess budget)
+    n_docs = int(
+        os.environ.get("BENCH_RR_DOCS", "100000" if backend == "tpu" else "2000")
+    )
+    n_queries, k, candidates = 16, 10, 32
+    pipe, cross, docs, queries = _build_rr_pipeline(
+        n_docs, n_queries, k, candidates
     )
     hits = pipe(queries)  # warmup: compiles both stages
     assert len(hits) == n_queries and all(len(row) == k for row in hits)
@@ -419,6 +436,69 @@ def phase_retrieve_rerank(backend: str, extras: dict) -> float:
     extras["packed_speedup_vs_unpacked"] = round(t_unpacked / max(t_packed, 1e-9), 2)
 
     return round(max(pairs_per_s, pairs_per_s_piped), 1)
+
+
+def phase_observe_overhead(backend: str, extras: dict) -> float:
+    """Price of the always-on flight recorder (pathway_tpu/observe): the
+    SAME steady-state fused retrieve→rerank serve measured with the
+    recorder enabled vs forcibly disabled, interleaved A/B/A/B so clock
+    drift and cache effects hit both arms equally.  The phase value is the
+    added p50 latency in percent — the acceptance budget is < 3%.  Also
+    re-asserts the 2-dispatch + 2-fetch budget WITH the recorder on."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu import observe
+    from pathway_tpu.ops import dispatch_counter
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_OBS_DOCS", "20000" if on_tpu else "1000"))
+    n_queries, k, candidates = 16, 10, 32
+    pipe, _cross, _docs, queries = _build_rr_pipeline(
+        n_docs, n_queries, k, candidates, small=not on_tpu
+    )
+    pipe(queries)  # warmup: compiles both stages
+
+    # budget with the recorder ON: observability must not add round trips.
+    # Force it on (a PATHWAY_OBSERVE=0 environment must not kill the
+    # phase — the A/B loop flips the switch both ways regardless) and
+    # restore the environment-derived state afterwards.
+    env_enabled = observe.enabled()
+    observe.set_enabled(True)
+    with dispatch_counter.DispatchCounter() as counter:
+        pipe(queries)
+    extras["dispatches_with_recorder"] = counter.dispatches
+    extras["fetches_with_recorder"] = counter.fetches
+    assert counter.dispatches == 2 and counter.fetches == 2, counter.events
+
+    rounds = int(os.environ.get("BENCH_OBS_ROUNDS", "6"))
+    per_round = int(
+        os.environ.get("BENCH_OBS_ITERS", "10" if on_tpu else "4")
+    )
+    lat = {True: [], False: []}
+    try:
+        for _ in range(rounds):
+            for mode in (True, False):
+                observe.set_enabled(mode)
+                pipe(queries)  # settle: the first call after a flip is warm-up
+                for _ in range(per_round):
+                    t0 = time.perf_counter()
+                    pipe(queries)
+                    lat[mode].append((time.perf_counter() - t0) * 1e3)
+    finally:
+        observe.set_enabled(env_enabled)
+    p50_on = float(np.percentile(lat[True], 50))
+    p50_off = float(np.percentile(lat[False], 50))
+    overhead_pct = (p50_on - p50_off) / max(p50_off, 1e-9) * 100.0
+    extras["observe_p50_on_ms"] = round(p50_on, 3)
+    extras["observe_p50_off_ms"] = round(p50_off, 3)
+    extras["observe_overhead_pct"] = round(overhead_pct, 3)
+    # series actually populated by the workload (sanity: the recorder the
+    # overhead was measured against is the one /metrics would scrape)
+    stats = observe.snapshot()
+    extras["observe_series"] = len(stats["histograms"])
+    return round(overhead_pct, 3)
 
 
 _PEAK_BF16_FLOPS = {
@@ -1088,6 +1168,7 @@ def phase_rag_eval(backend: str, extras: dict) -> float:
 _PHASES = {
     "retrieval": (phase_retrieval, 1800),
     "retrieve_rerank": (phase_retrieve_rerank, 900),
+    "observe_overhead": (phase_observe_overhead, 450),
     "ingest": (phase_ingest, 900),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
@@ -1239,6 +1320,7 @@ def main() -> None:
     plan = [
         ("retrieval", lambda: device_phase("retrieval")),
         ("retrieve_rerank", lambda: device_phase("retrieve_rerank")),
+        ("observe_overhead", lambda: device_phase("observe_overhead")),
         ("ingest", lambda: device_phase("ingest")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)
@@ -1256,6 +1338,8 @@ def main() -> None:
         state[name] = value
         if name == "retrieve_rerank" and value is not None:
             extras["rerank_pairs_per_sec"] = round(value, 1)
+        elif name == "observe_overhead" and value is not None:
+            extras["observe_overhead_pct"] = round(value, 3)
         elif name == "ingest" and value is not None:
             extras["ingest_docs_per_sec"] = round(value, 1)
         elif name == "wordcount" and value is not None:

@@ -3,8 +3,12 @@
 Prints one ``path:line:col: rule: message`` diagnostic per unsuppressed
 finding and exits 1 if any exist (0 on a clean tree) — the same contract
 the tier-1 gate test asserts through the API.  ``--show-suppressed``
-audits every pragma allowance alongside the live findings; ``--json``
-emits machine-readable records.
+audits every pragma allowance alongside the live findings.
+
+Machine-readable output: ``--format json`` emits ONE JSON document
+(``{"findings": [...], "live": N, "suppressed": M}`` — the CI-friendly
+shape); ``--format jsonl`` (alias: the legacy ``--json`` flag) emits one
+JSON record per finding.  Exit codes are identical across formats.
 
 The analysis modules themselves are pure stdlib + AST (no jax import),
 so the lint runs anywhere — pre-commit, CI boxes with no accelerator, a
@@ -36,8 +40,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="also print suppressed findings with their pragma reasons",
     )
     parser.add_argument(
-        "--json", action="store_true", dest="as_json",
-        help="emit findings as JSON lines",
+        "--format", choices=("text", "json", "jsonl"), default="text",
+        dest="fmt",
+        help="output format: human text (default), one JSON document "
+        "(json), or one JSON record per finding (jsonl)",
+    )
+    parser.add_argument(
+        "--json", action="store_const", const="jsonl", dest="fmt",
+        help="legacy alias for --format jsonl",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
@@ -52,13 +62,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     findings = analyze_paths(args.paths)
     live = [f for f in findings if not f.suppressed]
+    n_sup = len(findings) - len(live)
+    if args.fmt == "json":
+        # one complete document: what a CI step or the tier-1 gate wants
+        # to parse — every finding (suppressed ones carry their reason),
+        # plus the counts the exit code is derived from
+        print(
+            json.dumps(
+                {
+                    "findings": [f.__dict__ for f in findings],
+                    "live": len(live),
+                    "suppressed": n_sup,
+                }
+            )
+        )
+        return 1 if live else 0
     shown = findings if args.show_suppressed else live
     for f in shown:
-        if args.as_json:
+        if args.fmt == "jsonl":
             print(json.dumps(f.__dict__))
         else:
             print(f.format())
-    n_sup = len(findings) - len(live)
     print(
         f"{len(live)} finding{'s' if len(live) != 1 else ''} "
         f"({n_sup} suppressed)",

@@ -2,10 +2,24 @@
 (reference: src/engine/http_server.rs:21-130 — per-process metrics server on
 port 20000+process_id exposing connector latencies and input/output stats).
 
-Serves ``GET /metrics`` (and ``/status`` JSON) from a daemon thread; gauges
-and counters are computed at scrape time from the live engine graph, so
-there is no per-tick bookkeeping beyond the rows_in/rows_out/process_ns
-counters the scheduler already maintains.
+Serves ``GET /metrics`` (plus ``/status`` and ``/serve_stats`` JSON) from a
+daemon thread; gauges and counters are computed at scrape time from the
+live engine graph, so there is no per-tick bookkeeping beyond the
+rows_in/rows_out/process_ns counters the scheduler already maintains.
+
+This is the ONE metrics surface: alongside the engine/connector series,
+``/metrics`` renders the serve-path flight recorder
+(``pathway_tpu/observe`` — ``pathway_serve_*`` stage histograms,
+``pathway_ivf_*`` index gauges, ``pathway_recompile_*`` compile census,
+``pathway_exchange_*`` plane counters), and ``/serve_stats`` serves the
+same recorder as a JSON summary (histogram quantile estimates + the
+recent-event ring).
+
+Scrape consistency: the engine graph's operator/table collections are
+snapshotted (and each operator's counters read once) BEFORE any line is
+formatted, so a scrape racing a commit tick sees one coherent view
+instead of a list mutating mid-iteration.  Uptime is stamped at
+``MetricsServer.start()`` — module import time is not a server lifetime.
 """
 
 from __future__ import annotations
@@ -27,34 +41,53 @@ def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
-def render_metrics(graph) -> str:
-    """Render the engine graph's state in Prometheus text exposition format."""
+def render_metrics(graph, started_at: Optional[float] = None) -> str:
+    """Render the engine graph's state in Prometheus text exposition
+    format.  ``started_at`` is the serving process's start stamp (the
+    MetricsServer passes its own); defaults to module import time for
+    direct callers."""
+    # SNAPSHOT before rendering: fix the operator/table lists and read
+    # each operator's counters exactly once, so a scrape racing a commit
+    # tick cannot see a list mutating under iteration or one operator's
+    # counters torn across two lines
+    operators = list(graph.operators)
+    tables = list(graph.tables)
+    op_stats = [
+        (
+            _sanitize(op.name),
+            op.id,
+            op.rows_in,
+            op.rows_out,
+            op.process_ns,
+            op.last_tick_ns,
+        )
+        for op in operators
+    ]
+    total_rows = sum(len(table.store) for table in tables)
+    started = started_at if started_at is not None else _started_at
     lines = [
         "# TYPE pathway_uptime_seconds gauge",
-        f"pathway_uptime_seconds {time.time() - _started_at:.3f}",
+        f"pathway_uptime_seconds {time.time() - started:.3f}",
         "# TYPE pathway_operators gauge",
-        f"pathway_operators {len(graph.operators)}",
+        f"pathway_operators {len(operators)}",
         "# TYPE pathway_resident_rows gauge",
+        f"pathway_resident_rows {total_rows}",
         "# TYPE pathway_operator_rows_in_total counter",
         "# TYPE pathway_operator_rows_out_total counter",
         "# TYPE pathway_operator_process_seconds_total counter",
         "# TYPE pathway_operator_last_tick_seconds gauge",
     ]
-    total_rows = 0
-    for table in graph.tables:
-        total_rows += len(table.store)
-    lines.insert(5, f"pathway_resident_rows {total_rows}")
-    for op in graph.operators:
-        label = f'operator="{_sanitize(op.name)}",id="{op.id}"'
-        lines.append(f"pathway_operator_rows_in_total{{{label}}} {op.rows_in}")
-        lines.append(f"pathway_operator_rows_out_total{{{label}}} {op.rows_out}")
+    for name, op_id, rows_in, rows_out, process_ns, last_tick_ns in op_stats:
+        label = f'operator="{name}",id="{op_id}"'
+        lines.append(f"pathway_operator_rows_in_total{{{label}}} {rows_in}")
+        lines.append(f"pathway_operator_rows_out_total{{{label}}} {rows_out}")
         lines.append(
             f"pathway_operator_process_seconds_total{{{label}}} "
-            f"{op.process_ns / 1e9:.6f}"
+            f"{process_ns / 1e9:.6f}"
         )
         lines.append(
             f"pathway_operator_last_tick_seconds{{{label}}} "
-            f"{op.last_tick_ns / 1e9:.6f}"
+            f"{last_tick_ns / 1e9:.6f}"
         )
     # per-connector ingestion/lag stats (reference: ConnectorMonitor,
     # src/connectors/monitoring.rs:237 scraped by http_server.rs)
@@ -84,6 +117,12 @@ def render_metrics(graph) -> str:
         lines.append(
             f"pathway_connector_partitions{{{label}}} {stats['partitions']}"
         )
+    # serve-path flight recorder (pathway_tpu/observe): stage histograms,
+    # IVF/recompile/exchange series — the same scrape covers engine,
+    # connectors, and the ML hot path
+    from .. import observe
+
+    lines.extend(observe.render_prometheus())
     lines.append("")
     return "\n".join(lines)
 
@@ -105,23 +144,31 @@ class MetricsServer:
         )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._started_at = time.time()  # re-stamped at start()
 
     def start(self) -> "MetricsServer":
         graph = self.graph
+        # uptime means THIS server's lifetime, not module import time
+        self._started_at = started_at = time.time()
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
                 if self.path.startswith("/metrics"):
-                    body = render_metrics(graph).encode()
+                    body = render_metrics(graph, started_at=started_at).encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/serve_stats"):
+                    from .. import observe
+
+                    body = json.dumps(observe.snapshot()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/status"):
                     body = json.dumps(
                         {
-                            "operators": len(graph.operators),
+                            "operators": len(list(graph.operators)),
                             "resident_rows": sum(
-                                len(t.store) for t in graph.tables
+                                len(t.store) for t in list(graph.tables)
                             ),
-                            "uptime_s": time.time() - _started_at,
+                            "uptime_s": time.time() - started_at,
                         }
                     ).encode()
                     ctype = "application/json"

@@ -6,6 +6,7 @@ transformer projected into one space; both batched jit forwards."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import flax.linen as nn
@@ -13,12 +14,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observe
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
 from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
 
 __all__ = ["ClipModel"]
+
+# flight recorder: submit→ready latency (dispatch through host fetch)
+# per modality + batch occupancy per dispatch
+_H_TEXT = observe.histogram("pathway_serve_model_seconds", model="clip_text")
+_H_IMAGE = observe.histogram("pathway_serve_model_seconds", model="clip_image")
 
 
 class _ImageEncoder(nn.Module):
@@ -146,8 +153,12 @@ class ClipModel:
         # holding it across the device round trip serialized every
         # concurrent encode for the full latency); the lock only guards
         # tokenization and the compiled-fn cache
+        t0 = time.perf_counter_ns()
+        observe.record_occupancy("clip_text", n, b)
         out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
-        return np.asarray(out)[:n]
+        host = np.asarray(out)[:n]
+        _H_TEXT.observe_ns(time.perf_counter_ns() - t0)
+        return host
 
     def encode_image(self, images: Sequence[np.ndarray]) -> np.ndarray:
         with self._lock:
@@ -183,5 +194,9 @@ class ClipModel:
 
                 self._image_fns[key] = fn
         # dispatch + fetch off-lock, same as encode_text
+        t0 = time.perf_counter_ns()
+        observe.record_occupancy("clip_image", n, b)
         out = fn(self.params, jnp.asarray(batch))
-        return np.asarray(out)[:n]
+        host = np.asarray(out)[:n]
+        _H_IMAGE.observe_ns(time.perf_counter_ns() - t0)
+        return host

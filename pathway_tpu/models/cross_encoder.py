@@ -10,6 +10,7 @@ from __future__ import annotations
 # pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -17,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observe
 from ..ops.recompile_guard import RecompileTripwire
 from ._params import unbox as _unbox
 
@@ -24,6 +26,10 @@ from .tokenizer import HashTokenizer
 from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
 
 __all__ = ["CrossEncoderModel"]
+
+# flight recorder: submit→ready latency (dispatch through the completion
+# fetch) + per-dispatch batch occupancy
+_H_READY = observe.histogram("pathway_serve_model_seconds", model="cross_encoder")
 
 
 class _CrossEncoderModule(nn.Module):
@@ -176,9 +182,13 @@ class CrossEncoderModel:
             out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
+        t_dispatch = time.perf_counter_ns()
+        observe.record_occupancy("cross_encoder", n, b)
 
         def complete() -> np.ndarray:
-            return np.asarray(out, dtype=np.float32)[:n]
+            scores = np.asarray(out, dtype=np.float32)[:n]
+            _H_READY.observe_ns(time.perf_counter_ns() - t_dispatch)
+            return scores
 
         return complete
 
@@ -236,7 +246,8 @@ class CrossEncoderModel:
         n = len(pairs)
         with self._lock:
             ids, segments, positions, doc_slots, n_seg = self._pack_pairs(pairs)
-            Rb = _bucket(ids.shape[0])
+            rows_real = ids.shape[0]
+            Rb = _bucket(rows_real)
             ids, segments, positions = pad_packed_rows(
                 ids, segments, positions, Rb
             )
@@ -250,10 +261,13 @@ class CrossEncoderModel:
         )
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
+        t_dispatch = time.perf_counter_ns()
+        observe.record_occupancy("cross_encoder_packed", rows_real, Rb)
         flat_ix = np.asarray([r * Sb + s for r, s in doc_slots], np.int64)
 
         def complete() -> np.ndarray:
             arr = np.asarray(out, dtype=np.float32).reshape(-1)
+            _H_READY.observe_ns(time.perf_counter_ns() - t_dispatch)
             return arr[flat_ix][:n]
 
         return complete

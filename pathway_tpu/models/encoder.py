@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observe
 from ..ops.recompile_guard import RecompileTripwire
 from ._params import unbox as _unbox
 
@@ -26,6 +28,10 @@ from .tokenizer import HashTokenizer
 from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
 
 __all__ = ["SentenceEncoder"]
+
+# flight recorder: submit→ready latency of a blocking encode (dispatch
+# through host fetch) + batch occupancy per dispatch
+_H_READY = observe.histogram("pathway_serve_model_seconds", model="encoder")
 
 _BATCH_BUCKETS = (1, 4, 16, 64, 256)
 
@@ -170,13 +176,20 @@ class SentenceEncoder:
         # dispatch OFF the lock (lock-discipline): params/fn are stable
         # refs, so the launch needs no lock — holding it would serialize
         # concurrent encoders behind one device queue push
+        observe.record_occupancy("encoder", n, ids.shape[0])
         out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
         return out[:n]
 
     def encode(self, texts: Sequence[str]) -> np.ndarray:
         """Batch encode: [B] strings -> [B, d] float32."""
         out = self.encode_to_device(texts)
-        return np.asarray(out, dtype=np.float32)
+        # submit→ready clock starts AFTER the dispatch is enqueued — the
+        # same semantics as every other pathway_serve_model_seconds
+        # series (host prep/tokenize time is not device latency)
+        t0 = time.perf_counter_ns()
+        host = np.asarray(out, dtype=np.float32)
+        _H_READY.observe_ns(time.perf_counter_ns() - t0)
+        return host
 
     # -- sequence packing ---------------------------------------------------
     def _pack(self, texts: Sequence[str], max_docs_per_row: int = 8):
@@ -216,7 +229,9 @@ class SentenceEncoder:
 
             ids, mask, segments, positions, doc_slots, n_seg = self._pack(texts)
             # bucket the row count and segment width: few compile shapes
-            Rb = _bucket(ids.shape[0])
+            rows_real = ids.shape[0]
+            Rb = _bucket(rows_real)
+            observe.record_occupancy("encoder_packed", rows_real, Rb)
             ids, segments, positions = pad_packed_rows(
                 ids, segments, positions, Rb
             )

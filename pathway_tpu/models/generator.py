@@ -10,18 +10,24 @@ compile behavior are the product."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observe
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
 from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
 
 __all__ = ["TextGenerator"]
+
+# flight recorder: submit→ready latency of a full decode (dispatch
+# through host fetch) + batch occupancy per dispatch
+_H_READY = observe.histogram("pathway_serve_model_seconds", model="generator")
 
 
 class TextGenerator:
@@ -131,6 +137,8 @@ class TextGenerator:
         # the decode round trip serialized concurrent generates for the
         # full device latency); the lock only guards tokenization and the
         # compiled-fn cache
+        t0 = time.perf_counter_ns()
+        observe.record_occupancy("generator", n, b)
         toks = fn(
             self.params,
             jnp.asarray(ids),
@@ -139,6 +147,7 @@ class TextGenerator:
             jax.random.PRNGKey(seed),
         )
         toks = np.asarray(toks)[:n]
+        _H_READY.observe_ns(time.perf_counter_ns() - t0)
         # hashing tokenizer is not invertible; render token ids
         return [
             " ".join(f"<{int(t)}>" for t in row if t != self.tokenizer.PAD)

@@ -1,0 +1,80 @@
+"""Serve-path flight recorder — always-on, low-overhead observability
+for the ML hot path.
+
+PRs 1–2 made the fused retrieve→rerank serve fast (2 dispatches + 2
+fetches) and statically safe; this package makes it *visible*: where a
+serve call spends time (tokenize/pack on host, stage-1 dispatch→fetch
+RTT, stage-2 rescore RTT, post-process), how full the packed batches
+are, what the IVF index / recompile tripwires / exchange plane are doing
+— without re-running ``bench.py``.  Multi-stage ranking systems live or
+die by per-stage accounting (PAPERS.md: "An Exploration of Approaches to
+Integrating Neural Reranking Models in Multi-Stage Ranking
+Architectures"; "Accelerating Retrieval-Augmented Generation" names the
+retrieval-vs-inference stage breakdown as the prerequisite for every
+serving optimization).
+
+Design constraints, in order:
+
+1. **Nearly free.**  Fixed-slot power-of-two-bucket histograms (one
+   ``bit_length`` + three increments per event), pre-resolved series
+   objects on the hot sites, a bounded pre-allocated event ring, and
+   scrape-time *providers* for anything derivable from live state.  The
+   ``observe_overhead`` bench phase prices the recorder on-vs-off; the
+   budget is < 3% added serve latency.
+2. **Analyzer-clean.**  The recorder itself passes the PR 2
+   lock-discipline / hidden-sync / recompile-hazard rules: locks are
+   held only for integer updates, instrumentation points sit outside
+   dispatch scopes, and nothing here touches jax at all.
+3. **One surface.**  Everything renders on the existing scrape endpoint
+   (``internals/metrics.py``): ``pathway_serve_*`` stage histograms,
+   ``pathway_ivf_*`` index gauges, ``pathway_recompile_*`` census,
+   ``pathway_exchange_*`` plane counters — plus a ``/serve_stats`` JSON
+   view and OTLP spans via ``internals/telemetry.py`` when an endpoint
+   is configured.
+
+``PATHWAY_OBSERVE=0`` (or ``set_enabled(False)``) reduces every record
+call to a bool check.
+"""
+
+from .histogram import EventRing, LatencyHistogram, N_BUCKETS, bucket_bounds_s
+from .recorder import (
+    Counter,
+    Gauge,
+    count,
+    counter,
+    emit_span,
+    enabled,
+    gauge,
+    histogram,
+    next_id,
+    record_event,
+    record_occupancy,
+    register_provider,
+    render_prometheus,
+    reset,
+    set_enabled,
+    snapshot,
+)
+
+__all__ = [
+    "Counter",
+    "EventRing",
+    "Gauge",
+    "LatencyHistogram",
+    "N_BUCKETS",
+    "bucket_bounds_s",
+    "count",
+    "counter",
+    "emit_span",
+    "enabled",
+    "gauge",
+    "histogram",
+    "next_id",
+    "record_event",
+    "record_occupancy",
+    "register_provider",
+    "render_prometheus",
+    "reset",
+    "set_enabled",
+    "snapshot",
+]

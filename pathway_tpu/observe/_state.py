@@ -1,0 +1,21 @@
+"""The recorder's global enable flag, as a plain module attribute.
+
+Lives in its own leaf module so BOTH ``histogram.py`` (imported by
+``recorder.py``) and ``recorder.py`` read it without a circular import —
+and, critically, without per-call import machinery: the hot-path check
+is one module-attribute read (``_state.enabled``), which is the "bool
+check" the package docstring promises for the disabled path.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _truthy(raw, default: bool = True) -> bool:
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+enabled = _truthy(os.environ.get("PATHWAY_OBSERVE"))

@@ -7,36 +7,82 @@ serving paths report every compiled-function launch and every device→host
 result copy here; tests and bench install a counter around a steady-state
 call and assert on ground truth instead of wall clock.
 
-No-op (one dict lookup) unless a counter is installed — never on by
-default in production serving.
+Two consumers share each report:
+
+- the **flight recorder** (``pathway_tpu/observe``) — ALWAYS on: every
+  dispatch/fetch increments the ``pathway_serve_dispatches_total`` /
+  ``pathway_serve_fetches_total`` counters on the scrape endpoint, so the
+  budget is continuously visible in production, not only under a test;
+- an **installed ``DispatchCounter``** — the test/bench assertion hook,
+  still a no-op dict read when none is installed.
+
+Thread-safety: each ``DispatchCounter`` carries its OWN lock (the old
+module-global lock serialized unrelated counters and the ``_active`` read
+happened outside it), and ``events`` is bounded — a long soak under an
+installed counter keeps the first ``max_events`` events and counts the
+rest in ``events_dropped`` instead of growing without bound.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from .. import observe
 
 __all__ = ["DispatchCounter", "install", "uninstall", "record_dispatch", "record_fetch"]
 
-_lock = threading.Lock()
+_install_lock = threading.Lock()
 _active: Optional["DispatchCounter"] = None
+
+# pre-resolved recorder counters per tag (tags are a small fixed set of
+# serve-path literals; the cache makes the always-on path two dict reads
+# + one locked increment)
+_obs_counters: Dict[Tuple[str, str], observe.Counter] = {}
+
+
+def _obs_counter(kind: str, tag: str) -> observe.Counter:
+    key = (kind, tag)
+    c = _obs_counters.get(key)
+    if c is None:
+        c = _obs_counters[key] = observe.counter(
+            f"pathway_serve_{kind}es_total", tag=tag
+        )
+    return c
 
 
 class DispatchCounter:
     """Counts device dispatches and host fetches on the serving paths."""
 
-    def __init__(self) -> None:
+    def __init__(self, max_events: int = 4096) -> None:
+        self.max_events = int(max_events)
         self.dispatches = 0
         self.fetches = 0
         self.events: List[Tuple[str, str]] = []  # ("dispatch"|"fetch", tag)
+        self.events_dropped = 0
+        self._lock = threading.Lock()
+
+    def _record(self, kind: str, tag: str) -> None:
+        with self._lock:
+            if kind == "dispatch":
+                self.dispatches += 1
+            else:
+                self.fetches += 1
+            if len(self.events) < self.max_events:
+                self.events.append((kind, tag))
+            else:
+                self.events_dropped += 1
 
     def reset(self) -> None:
-        self.dispatches = 0
-        self.fetches = 0
-        self.events = []
+        with self._lock:
+            self.dispatches = 0
+            self.fetches = 0
+            self.events = []
+            self.events_dropped = 0
 
     def snapshot(self) -> Tuple[int, int]:
-        return self.dispatches, self.fetches
+        with self._lock:
+            return self.dispatches, self.fetches
 
     def __enter__(self) -> "DispatchCounter":
         install(self)
@@ -48,28 +94,26 @@ class DispatchCounter:
 
 def install(counter: Optional[DispatchCounter] = None) -> DispatchCounter:
     global _active
-    with _lock:
+    with _install_lock:
         _active = counter or DispatchCounter()
         return _active
 
 
 def uninstall() -> None:
     global _active
-    with _lock:
+    with _install_lock:
         _active = None
 
 
 def record_dispatch(tag: str) -> None:
+    _obs_counter("dispatch", tag).inc()
     c = _active
     if c is not None:
-        with _lock:
-            c.dispatches += 1
-            c.events.append(("dispatch", tag))
+        c._record("dispatch", tag)
 
 
 def record_fetch(tag: str) -> None:
+    _obs_counter("fetch", tag).inc()
     c = _active
     if c is not None:
-        with _lock:
-            c.fetches += 1
-            c.events.append(("fetch", tag))
+        c._record("fetch", tag)

@@ -31,16 +31,23 @@ IVF tier wins on FLOPs (multi-tenant packing, larger-than-sweep corpora).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observe
 from .knn import _bucket, normalize_metric
 from .recompile_guard import RecompileTripwire
 
 __all__ = ["IvfKnnIndex"]
+
+# maintenance-duration histograms (flight recorder): absorb/retrain wall
+# time, observed from the maintenance threads AFTER their lock sections
+_H_ABSORB = observe.histogram("pathway_ivf_absorb_seconds")
+_H_RETRAIN = observe.histogram("pathway_ivf_retrain_seconds")
 
 
 def _kmeans(
@@ -222,8 +229,46 @@ class IvfKnnIndex:
         # a retrain rebalances the layout
         self._absorb_stuck_at: Optional[int] = None
         # maintenance counters (observable by tests/bench: the serve path
-        # must show sync_builds frozen while absorbs/retrains advance)
-        self.stats = {"sync_builds": 0, "retrains": 0, "absorbs": 0}
+        # must show sync_builds frozen while absorbs/retrains advance);
+        # tail_cache_* counts device-upload reuse on the serve path
+        self.stats = {
+            "sync_builds": 0,
+            "retrains": 0,
+            "absorbs": 0,
+            "tail_cache_hits": 0,
+            "tail_cache_misses": 0,
+        }
+        # flight-recorder export: index gauges sampled at scrape time
+        # only (zero serve-path cost); id uniquifies multiple indexes
+        self._observe_id = observe.next_id()
+        observe.register_provider(self)
+
+    def observe_metrics(self):
+        """Scrape-time ``pathway_ivf_*`` samples (flight-recorder
+        provider): structure gauges from live state, maintenance and
+        tail-upload-cache counters from ``stats``.  Lock-free reads of
+        GIL-consistent attributes — a scrape never touches the index
+        lock."""
+        labels = {"index": str(self._observe_id)}
+        centroids = self._centroids
+        nlist = int(centroids.shape[0]) if centroids is not None else 0
+        yield ("gauge", "pathway_ivf_nlist", labels, nlist)
+        yield ("gauge", "pathway_ivf_resident_vectors", labels, len(self))
+        yield ("gauge", "pathway_ivf_tail_size", labels, len(self._tail))
+        for kind in ("sync_builds", "retrains", "absorbs", "absorb_errors"):
+            yield (
+                "counter",
+                "pathway_ivf_maintenance_total",
+                {**labels, "kind": kind},
+                self.stats.get(kind, 0),
+            )
+        for result, key in (("hit", "tail_cache_hits"), ("miss", "tail_cache_misses")):
+            yield (
+                "counter",
+                "pathway_ivf_tail_cache_total",
+                {**labels, "result": result},
+                self.stats.get(key, 0),
+            )
 
     def __len__(self) -> int:
         # built live keys + unbuilt tail — counts correctly both for the
@@ -366,10 +411,12 @@ class IvfKnnIndex:
                 return
             # the expensive part (k-means + layout + upload) runs WITHOUT
             # the lock: serving continues on the old slabs throughout
+            t0 = time.perf_counter_ns()
             built = self._train_layout(snapshot)
             with self._lock:
                 self._install(built, snapshot)
                 self.stats["retrains"] += 1
+            _H_RETRAIN.observe_ns(time.perf_counter_ns() - t0)
         finally:
             self._retraining = False
 
@@ -524,6 +571,7 @@ class IvfKnnIndex:
         continues throughout — then re-acquire the lock only for the
         donated scatter + bookkeeping."""
         try:
+            t0 = time.perf_counter_ns()
             with self._lock:
                 snap = self._absorb_snapshot()
             if snap is None:
@@ -531,6 +579,7 @@ class IvfKnnIndex:
             plan = self._plan_absorb(snap)
             with self._lock:
                 self._commit_absorb(snap, plan)
+            _H_ABSORB.observe_ns(time.perf_counter_ns() - t0)
         except Exception:
             # keep a visible trace of background failures (the threading
             # excepthook prints the traceback; the old synchronous absorb
@@ -736,6 +785,7 @@ class IvfKnnIndex:
         (ADVICE r5 #1)."""
         cache = self._tail_cache
         if cache is None:
+            self.stats["tail_cache_misses"] += 1
             tail, tail_mat, tail_valid, t_pad = self._tail_snapshot()
             if t_pad:
                 dev_mat = jnp.asarray(tail_mat[:t_pad], self.dtype)
@@ -748,6 +798,8 @@ class IvfKnnIndex:
                 dev_valid = jnp.asarray(np.zeros(1, bool))
             cache = (tail, dev_mat, dev_valid, t_pad)
             self._tail_cache = cache
+        else:
+            self.stats["tail_cache_hits"] += 1
         return cache
 
     def build_from_matrix(self, keys: Sequence[int], matrix_dev) -> None:

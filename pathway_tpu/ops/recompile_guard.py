@@ -71,6 +71,21 @@ class RecompileTripwire:
         self._sigs: Set[Any] = set()
         self._lock = threading.Lock()
         self.tripped = False
+        # flight-recorder export: the per-callable compile-signature
+        # census shows up as pathway_recompile_* gauges on /metrics
+        # (weakly registered — a dropped tripwire leaves the scrape);
+        # the id label uniquifies same-named callables across instances
+        from .. import observe
+
+        self._observe_id = observe.next_id()
+        observe.register_provider(self)
+
+    def observe_metrics(self):
+        """Scrape-time gauge samples (flight-recorder provider)."""
+        labels = {"callable": self.name, "id": str(self._observe_id)}
+        yield ("gauge", "pathway_recompile_signatures", labels, len(self._sigs))
+        yield ("gauge", "pathway_recompile_limit", labels, self.limit)
+        yield ("gauge", "pathway_recompile_tripped", labels, int(self.tripped))
 
     @property
     def signatures(self) -> int:

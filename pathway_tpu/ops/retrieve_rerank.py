@@ -29,17 +29,27 @@ from __future__ import annotations
 # pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observe
 from .dispatch_counter import record_dispatch, record_fetch
 from .recompile_guard import RecompileTripwire
 from .serving import FusedEncodeSearch
 
 __all__ = ["RetrieveRerankPipeline"]
+
+# flight-recorder stage histograms: stage2_pack is host-side pair
+# assembly + packing up to the rescore dispatch; stage2_rtt is the
+# rescore dispatch→fetch; postprocess (shared series with stage 1's
+# completion in ops/serving.py) is host result assembly.
+_H_S2PACK = observe.histogram("pathway_serve_stage_seconds", stage="stage2_pack")
+_H_S2RTT = observe.histogram("pathway_serve_stage_seconds", stage="stage2_rtt")
+_H_POST = observe.histogram("pathway_serve_stage_seconds", stage="postprocess")
 
 
 class _PendingServe:
@@ -180,6 +190,7 @@ class RetrieveRerankPipeline:
         kernel; returns a completion -> [[(key, rerank_score)]]."""
         from ..models.encoder import _bucket
 
+        t_pack = time.perf_counter_ns()
         ce = self.cross_encoder
         Kc = self.candidates
         k_out = min(k, Kc)
@@ -199,7 +210,8 @@ class RetrieveRerankPipeline:
         Qb = _bucket(nq)
         with ce._lock:
             ids, segments, positions, doc_slots, n_seg = ce._pack_pairs(pairs)
-        Rb = _bucket(ids.shape[0])
+        rows_real = ids.shape[0]
+        Rb = _bucket(rows_real)
         L = ids.shape[1]
         ids, segments, positions = pad_packed_rows(ids, segments, positions, Rb)
         Sb = seg_bucket(n_seg)
@@ -219,10 +231,19 @@ class RetrieveRerankPipeline:
             out.copy_to_host_async()
         self.stats["stage2_pairs"] += len(pairs)
         self.stats["stage2_rows"] += Rb
+        t_dispatch = time.perf_counter_ns()
+        _H_S2PACK.observe_ns(t_dispatch - t_pack)
+        # packing occupancy, both granularities: packed ROWS actually
+        # carrying tokens vs the bucketed row count, and real PAIR
+        # segments vs the padded [Rb, Sb] segment grid
+        observe.record_occupancy("stage2", rows_real, Rb)
+        observe.record_occupancy("stage2_pairs", len(pairs), Rb * Sb)
 
         def complete() -> List[List[Tuple[int, float]]]:
             arr = np.asarray(out)[:nq]
             record_fetch("rerank_stage2")
+            t_fetch = time.perf_counter_ns()
+            _H_S2RTT.observe_ns(t_fetch - t_dispatch)
             scores = np.ascontiguousarray(arr[:, :k_out]).view(np.float32)
             perm = arr[:, k_out:]
             results: List[List[Tuple[int, float]]] = []
@@ -236,6 +257,19 @@ class RetrieveRerankPipeline:
                         continue
                     row.append((cands[ci], s))
                 results.append(row[:k])
+            t_done = time.perf_counter_ns()
+            _H_POST.observe_ns(t_done - t_fetch)
+            observe.record_event(
+                "serve", "rerank_stage2", t_done - t_pack,
+                queries=nq, pairs=len(pairs), rows=Rb,
+            )
+            observe.emit_span(
+                "pathway.serve.rerank_stage2",
+                queries=nq, pairs=len(pairs),
+                pack_ms=(t_dispatch - t_pack) * 1e-6,
+                rtt_ms=(t_fetch - t_dispatch) * 1e-6,
+                postprocess_ms=(t_done - t_fetch) * 1e-6,
+            )
             return results
 
         return complete
@@ -246,14 +280,21 @@ class RetrieveRerankPipeline:
         just a max-length-padded batch)."""
         from ..models.encoder import _bucket
 
+        t_pack = time.perf_counter_ns()
         score_done = self.cross_encoder.submit(pairs, packed=False)
         record_dispatch("rerank_stage2_host")
         self.stats["stage2_pairs"] += len(pairs)
-        self.stats["stage2_rows"] += _bucket(len(pairs))  # one row per pair
+        rows = _bucket(len(pairs))  # one row per pair
+        self.stats["stage2_rows"] += rows
+        t_dispatch = time.perf_counter_ns()
+        _H_S2PACK.observe_ns(t_dispatch - t_pack)
+        observe.record_occupancy("stage2", len(pairs), rows)
 
         def complete() -> List[List[Tuple[int, float]]]:
             flat = score_done()
             record_fetch("rerank_stage2_host")
+            t_fetch = time.perf_counter_ns()
+            _H_S2RTT.observe_ns(t_fetch - t_dispatch)
             results: List[List[Tuple[int, float]]] = []
             pos = 0
             for qi in range(len(queries)):
@@ -264,6 +305,12 @@ class RetrieveRerankPipeline:
                 pos += n_c
                 scored.sort(key=lambda kv: -kv[1])
                 results.append(scored[:k_out])
+            t_done = time.perf_counter_ns()
+            _H_POST.observe_ns(t_done - t_fetch)
+            observe.record_event(
+                "serve", "rerank_stage2_host", t_done - t_pack,
+                queries=len(queries), pairs=len(pairs),
+            )
             return results
 
         return complete

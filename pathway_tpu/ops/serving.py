@@ -15,17 +15,28 @@ from __future__ import annotations
 # pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observe
 from .dispatch_counter import record_dispatch, record_fetch
 from .knn import _bucket
 from .recompile_guard import RecompileTripwire
 
 __all__ = ["FusedEncodeSearch"]
+
+# flight-recorder stage histograms (pathway_tpu/observe): resolved once
+# at import so the per-serve cost is one observe_ns per stage boundary.
+# tokenize_pack covers host prep (lock wait + tokenize + pad + compiled-fn
+# lookup) up to the dispatch; stage1_rtt is dispatch→fetch-complete of the
+# fused kernel; postprocess is the host-side result assembly.
+_H_TOKENIZE = observe.histogram("pathway_serve_stage_seconds", stage="tokenize_pack")
+_H_STAGE1 = observe.histogram("pathway_serve_stage_seconds", stage="stage1_rtt")
+_H_POST = observe.histogram("pathway_serve_stage_seconds", stage="postprocess")
 
 
 class FusedEncodeSearch:
@@ -175,7 +186,7 @@ class FusedEncodeSearch:
         self._fns[shape_key] = fused
         return fused, k_main, k_tail
 
-    def _submit_ivf(self, texts: Sequence[str], k: int):
+    def _submit_ivf(self, texts: Sequence[str], k: int, t_start: int):
         """IVF flavor of submit (holds both locks): encode + centroid probe
         + shortlist rescore + exact-tail scan + top-k in ONE dispatch.
         NEVER rebuilds (VERDICT r4 #2): fresh rows ride the exact tail
@@ -231,11 +242,18 @@ class FusedEncodeSearch:
         record_dispatch("serve_ivf")
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
+        # instrumentation: timestamps only between dispatch and fetch —
+        # the observe calls are integer updates, never a host sync
+        t_dispatch = time.perf_counter_ns()
+        _H_TOKENIZE.observe_ns(t_dispatch - t_start)
+        observe.record_occupancy("stage1", n_real, b)
         keys_by_slot = index._keys_by_slot  # rebuilds REPLACE the array
 
         def complete() -> List[List[Tuple[int, float]]]:
             arr = np.asarray(out)[:n_real]
             record_fetch("serve_ivf")
+            t_fetch = time.perf_counter_ns()
+            _H_STAGE1.observe_ns(t_fetch - t_dispatch)
             scores = np.ascontiguousarray(arr[:, :k_main]).view(np.float32)
             slots = arr[:, k_main : 2 * k_main]
             if k_tail:
@@ -269,6 +287,7 @@ class FusedEncodeSearch:
                         seen.add(key)
                         dedup.append((key, s))
                 results.append(dedup[:k])
+            _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
             return results
 
         return complete
@@ -281,11 +300,12 @@ class FusedEncodeSearch:
         of one host RTT per call."""
         k = k or self.k
         index = self.index
+        t_start = time.perf_counter_ns()
         if self._ivf:
             with index._lock, self._lock:
                 if not texts:
                     return lambda: []
-                return self._submit_ivf(texts, k)
+                return self._submit_ivf(texts, k, t_start)
         with index._lock, self._lock:
             n_items = len(index.key_to_slot)
             if not texts:
@@ -332,10 +352,15 @@ class FusedEncodeSearch:
         record_dispatch("serve_exact")
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
+        t_dispatch = time.perf_counter_ns()
+        _H_TOKENIZE.observe_ns(t_dispatch - t_start)
+        observe.record_occupancy("stage1", n_real, B)
 
         def complete() -> List[List[Tuple[int, float]]]:
             arr = np.asarray(out)[:n_real]
             record_fetch("serve_exact")
+            t_fetch = time.perf_counter_ns()
+            _H_STAGE1.observe_ns(t_fetch - t_dispatch)
             scores = np.ascontiguousarray(arr[:, :k_eff]).view(np.float32)
             ints = np.ascontiguousarray(arr[:, k_eff:]).view(np.uint32)
             hi = ints[:, :k_eff].astype(np.uint64)
@@ -350,6 +375,7 @@ class FusedEncodeSearch:
                         continue
                     row.append((int(keys[qi, j]), s))
                 results.append(row[:k])
+            _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
             return results
 
         return complete

@@ -50,6 +50,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import observe
+
 __all__ = ["ExchangePlane", "get_plane", "close_plane"]
 
 _HDR = struct.Struct("!Q")
@@ -83,6 +85,15 @@ class ExchangePlane:
         self._closed = False
         self._recv_threads: List[threading.Thread] = []
         self._last_recv: Dict[int, float] = {}
+        # flight-recorder accounting: per-peer wire traffic counters
+        # (bumped inline on the send/recv paths — plain int adds) and
+        # liveness gauges sampled at scrape time (pathway_exchange_*)
+        self._bytes_in: Dict[int, int] = {}
+        self._bytes_out: Dict[int, int] = {}
+        self._chunks_in: Dict[int, int] = {}
+        self._chunks_out: Dict[int, int] = {}
+        self._observe_id = observe.next_id()
+        observe.register_provider(self)
 
         # session secret: rank 0 mints it, everyone reads it from the jax
         # coordination KV (which only cluster members share).  Connections
@@ -194,6 +205,10 @@ class ExchangePlane:
                 hdr = _recv_exact(conn, _HDR.size, on_chunk=alive)
                 (length,) = _HDR.unpack(hdr)
                 payload = _recv_exact(conn, length, on_chunk=alive)
+                self._bytes_in[peer] = (
+                    self._bytes_in.get(peer, 0) + _HDR.size + length
+                )
+                self._chunks_in[peer] = self._chunks_in.get(peer, 0) + 1
                 edge, seq, obj = self._deserialize(peer, payload)
                 with self._cv:
                     self._last_recv[peer] = time.monotonic()
@@ -219,6 +234,11 @@ class ExchangePlane:
                 self._send_frame(peer, _HDR.pack(total))
                 for part in parts:
                     self._send_frame(peer, part)
+                # one wire MESSAGE sent — the unit the receiver counts
+                # too (_recv_loop's chunks_in), so in/out stay
+                # comparable; under the send lock like the ping-path
+                # increments, so concurrent senders cannot lose counts
+                self._chunks_out[peer] = self._chunks_out.get(peer, 0) + 1
         except OSError as exc:
             raise PeerLost(f"send to exchange peer {peer} failed: {exc!r}") from exc
 
@@ -273,7 +293,10 @@ class ExchangePlane:
         for peer, lock in self._send_locks.items():
             if lock.acquire(blocking=False):
                 try:
-                    self._send_frame(peer, self._ping_frame, best_effort=True)
+                    if self._send_frame(peer, self._ping_frame, best_effort=True):
+                        self._chunks_out[peer] = (
+                            self._chunks_out.get(peer, 0) + 1
+                        )
                 except PeerLost as exc:
                     # a ping partially written and then stalled against a
                     # silent peer: the byte stream to it is corrupt past
@@ -348,6 +371,7 @@ class ExchangePlane:
                         )
                     continue
                 view = view[sent:]
+            self._bytes_out[peer] = self._bytes_out.get(peer, 0) + len(frame)
             return True
         finally:
             try:
@@ -371,7 +395,10 @@ class ExchangePlane:
             for peer, lock in self._send_locks.items():
                 if lock.acquire(blocking=False):
                     try:
-                        self._send_frame(peer, frame, best_effort=True)
+                        if self._send_frame(peer, frame, best_effort=True):
+                            self._chunks_out[peer] = (
+                                self._chunks_out.get(peer, 0) + 1
+                            )
                     except PeerLost as exc:
                         # a ping that got partially written and then stalled
                         # against a silent peer: surface it to the engine
@@ -463,6 +490,60 @@ class ExchangePlane:
                     self._send_to(peer, edge, seq, obj)
             return obj
         return self._wait(edge, seq, [root], timeout)[root]
+
+    def observe_metrics(self):
+        """Scrape-time ``pathway_exchange_*`` samples (flight-recorder
+        provider): per-peer liveness (``peer_up`` mirrors the heartbeat
+        verdict: 1 while the peer has been heard from within the
+        heartbeat timeout), silence age (seconds since the peer's last
+        frame — the liveness clock ``_wait`` checks), and wire traffic
+        counters.  The ``plane`` id label uniquifies concurrent planes
+        (tests open several per process)."""
+        base = {"rank": str(self.rank), "plane": str(self._observe_id)}
+        now = time.monotonic()
+        down = self._closed or self._dead is not None
+        hb_timeout = _hb_timeout()
+        for peer in sorted(self._send):
+            labels = {**base, "peer": str(peer)}
+            last = self._last_recv.get(peer)
+            silence = max(0.0, now - last) if last is not None else None
+            up = int(
+                not down and silence is not None and silence <= hb_timeout
+            )
+            yield ("gauge", "pathway_exchange_peer_up", labels, up)
+            if silence is not None:
+                yield (
+                    "gauge",
+                    "pathway_exchange_heartbeat_silence_seconds",
+                    labels,
+                    silence,
+                )
+            for direction, store in (
+                ("in", self._bytes_in),
+                ("out", self._bytes_out),
+            ):
+                yield (
+                    "counter",
+                    "pathway_exchange_bytes_total",
+                    {**labels, "direction": direction},
+                    store.get(peer, 0),
+                )
+            for direction, store in (
+                ("in", self._chunks_in),
+                ("out", self._chunks_out),
+            ):
+                yield (
+                    "counter",
+                    "pathway_exchange_chunks_total",
+                    {**labels, "direction": direction},
+                    store.get(peer, 0),
+                )
+        yield (
+            "gauge",
+            "pathway_exchange_heartbeat_timeout_seconds",
+            base,
+            hb_timeout,
+        )
 
     def close(self) -> None:
         with self._cv:
