@@ -79,6 +79,7 @@ from .internals.api_reducers import BaseCustomAccumulator  # noqa: E402
 from . import persistence  # noqa: E402
 from .persistence import PersistenceMode  # noqa: E402
 from . import parallel  # noqa: E402
+from . import robust  # noqa: E402
 from . import stdlib  # noqa: E402
 from .stdlib import (  # noqa: E402
     graphs,

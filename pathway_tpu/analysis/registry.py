@@ -40,6 +40,13 @@ __all__ = [
 _CACHE_GETTER_RE = re.compile(r"^_(compiled\w*|forward_fn|packed_fn|search_fn)$")
 _LOCK_NAME_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
 _JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+# the robust retry wrapper (pathway_tpu/robust/retry.py): a call like
+# ``retry_call("site", fn, *args)`` DISPATCHES ``fn`` when ``fn`` is a
+# jitted callable — the rules must keep treating it as a device dispatch
+# (for lock-discipline) and its result as a device value (for the
+# hidden-sync fetch/budget checks), or wrapping a launch in a retry
+# would silently launder it out of both rules
+_RETRY_WRAPPERS = {"retry_call"}
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:
@@ -163,14 +170,36 @@ def scope_jit_and_device_vars(
                     jit_fns.add(names[0])
                 elif leaf in jit_fns or callee in jit_fns:
                     device_vars.update(names)
+                elif _is_retry_wrapped_dispatch(value, jit_fns):
+                    # x = retry_call("site", jitted_fn, ...) — the retry
+                    # wrapper returns the jitted call's (device) result
+                    device_vars.update(names)
     return jit_fns, device_vars
+
+
+def _is_retry_wrapped_dispatch(call: ast.Call, jit_fns: Set[str]) -> bool:
+    """``retry_call("site", fn, ...)`` with ``fn`` a jitted callable —
+    the robust wrapper dispatches its function argument, so the rules
+    treat the wrapper call itself as the dispatch."""
+    callee = dotted_name(call.func)
+    if callee is None or callee.rsplit(".", 1)[-1] not in _RETRY_WRAPPERS:
+        return False
+    for arg in call.args:
+        name = dotted_name(arg)
+        if name is None:
+            continue
+        if name in jit_fns or name.rsplit(".", 1)[-1] in jit_fns:
+            return True
+    return False
 
 
 def is_jit_call(call: ast.Call, jit_fns: Set[str]) -> bool:
     callee = dotted_name(call.func)
     if callee is None:
         return False
-    return callee in jit_fns or callee.rsplit(".", 1)[-1] in jit_fns
+    if callee in jit_fns or callee.rsplit(".", 1)[-1] in jit_fns:
+        return True
+    return _is_retry_wrapped_dispatch(call, jit_fns)
 
 
 def is_device_value_arg(

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..robust import retry_call
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
@@ -155,7 +156,9 @@ class ClipModel:
         # tokenization and the compiled-fn cache
         t0 = time.perf_counter_ns()
         observe.record_occupancy("clip_text", n, b)
-        out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        out = retry_call(
+            "clip.dispatch", fn, self.params, jnp.asarray(ids), jnp.asarray(mask)
+        )
         host = np.asarray(out)[:n]
         _H_TEXT.observe_ns(time.perf_counter_ns() - t0)
         return host
@@ -193,10 +196,11 @@ class ClipModel:
                     )
 
                 self._image_fns[key] = fn
-        # dispatch + fetch off-lock, same as encode_text
+        # dispatch + fetch off-lock, same as encode_text (and the same
+        # "clip.dispatch" retry/fault site)
         t0 = time.perf_counter_ns()
         observe.record_occupancy("clip_image", n, b)
-        out = fn(self.params, jnp.asarray(batch))
+        out = retry_call("clip.dispatch", fn, self.params, jnp.asarray(batch))
         host = np.asarray(out)[:n]
         _H_IMAGE.observe_ns(time.perf_counter_ns() - t0)
         return host

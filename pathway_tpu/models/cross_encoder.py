@@ -20,6 +20,7 @@ import numpy as np
 
 from .. import observe
 from ..ops.recompile_guard import RecompileTripwire
+from ..robust import Deadline, inject, retry_call
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
@@ -135,22 +136,32 @@ class CrossEncoderModel:
         return self.submit(pairs, packed=packed)()
 
     def submit(
-        self, pairs: Sequence[Tuple[str, str]], packed: Optional[bool] = None
+        self,
+        pairs: Sequence[Tuple[str, str]],
+        packed: Optional[bool] = None,
+        deadline: Optional[Deadline] = None,
     ):
         """Dispatch one scoring batch WITHOUT waiting; returns a zero-arg
         callable completing it (same submit/complete pattern as
         ``FusedEncodeSearch.submit``, so a serving pipeline can overlap
-        cross-encoder rescoring with the next call's retrieval)."""
+        cross-encoder rescoring with the next call's retrieval).
+        ``deadline`` bounds the dispatch retry budget and is re-checked
+        before the completion blocks on the fetch — a spent budget raises
+        ``DeadlineExceeded`` for the caller's degradation ladder."""
         n = len(pairs)
         if n == 0:
             return lambda: np.zeros((0,), np.float32)
         if packed is None:
             packed = not self._hf
         if packed and not self._hf:
-            return self._submit_packed(pairs)
-        return self._submit_unpacked(pairs)
+            return self._submit_packed(pairs, deadline=deadline)
+        return self._submit_unpacked(pairs, deadline=deadline)
 
-    def _submit_unpacked(self, pairs: Sequence[Tuple[str, str]]):
+    def _submit_unpacked(
+        self,
+        pairs: Sequence[Tuple[str, str]],
+        deadline: Optional[Deadline] = None,
+    ):
         """One pair per padded row — the HF path and the parity reference
         for the packed path.  The lock covers tokenization + the
         compiled-fn cache only; the dispatch launches OFF it
@@ -172,20 +183,33 @@ class CrossEncoderModel:
                 (np.arange(ids.shape[1])[None, :] > first_sep[:, None])
                 & (mask > 0)
             ).astype(np.int32)
-            out = fn(
+            out = retry_call(
+                "cross_encoder.dispatch",
+                fn,
                 self.params,
                 jnp.asarray(ids),
                 jnp.asarray(mask),
                 jnp.asarray(type_ids),
+                deadline=deadline,
             )
         else:
-            out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            out = retry_call(
+                "cross_encoder.dispatch",
+                fn,
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(mask),
+                deadline=deadline,
+            )
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         t_dispatch = time.perf_counter_ns()
         observe.record_occupancy("cross_encoder", n, b)
 
         def complete() -> np.ndarray:
+            inject.fire("cross_encoder.fetch", deadline=deadline)
+            if deadline is not None:
+                deadline.check("cross_encoder.fetch")
             scores = np.asarray(out, dtype=np.float32)[:n]
             _H_READY.observe_ns(time.perf_counter_ns() - t_dispatch)
             return scores
@@ -235,7 +259,11 @@ class CrossEncoderModel:
             self._fns[key] = fn
         return self._fns[key]
 
-    def _submit_packed(self, pairs: Sequence[Tuple[str, str]]):
+    def _submit_packed(
+        self,
+        pairs: Sequence[Tuple[str, str]],
+        deadline: Optional[Deadline] = None,
+    ):
         """Packed async scoring: pack, dispatch ONE forward over the packed
         rows, return a completion that gathers the per-pair scores back
         into input order.  Pack + compiled-fn lookup run under the lock;
@@ -253,11 +281,14 @@ class CrossEncoderModel:
             )
             Sb = seg_bucket(n_seg)
             fn = self._packed_fn(Rb, ids.shape[1], Sb)
-        out = fn(
+        out = retry_call(
+            "cross_encoder.dispatch",
+            fn,
             self.params,
             jnp.asarray(ids),
             jnp.asarray(segments),
             jnp.asarray(positions),
+            deadline=deadline,
         )
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
@@ -266,6 +297,9 @@ class CrossEncoderModel:
         flat_ix = np.asarray([r * Sb + s for r, s in doc_slots], np.int64)
 
         def complete() -> np.ndarray:
+            inject.fire("cross_encoder.fetch", deadline=deadline)
+            if deadline is not None:
+                deadline.check("cross_encoder.fetch")
             arr = np.asarray(out, dtype=np.float32).reshape(-1)
             _H_READY.observe_ns(time.perf_counter_ns() - t_dispatch)
             return arr[flat_ix][:n]

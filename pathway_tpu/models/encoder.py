@@ -22,6 +22,7 @@ import numpy as np
 
 from .. import observe
 from ..ops.recompile_guard import RecompileTripwire
+from ..robust import retry_call
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
@@ -175,9 +176,13 @@ class SentenceEncoder:
             fn = self._forward_fn(ids.shape[0], ids.shape[1])
         # dispatch OFF the lock (lock-discipline): params/fn are stable
         # refs, so the launch needs no lock — holding it would serialize
-        # concurrent encoders behind one device queue push
+        # concurrent encoders behind one device queue push.  Transient
+        # dispatch failures retry under the "encoder.dispatch" site
+        # budget (also the chaos-suite fault site — robust/inject.py).
         observe.record_occupancy("encoder", n, ids.shape[0])
-        out = fn(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        out = retry_call(
+            "encoder.dispatch", fn, self.params, jnp.asarray(ids), jnp.asarray(mask)
+        )
         return out[:n]
 
     def encode(self, texts: Sequence[str]) -> np.ndarray:
@@ -237,10 +242,13 @@ class SentenceEncoder:
             )
             Sb = seg_bucket(n_seg)
             fn = self._packed_fn(Rb, ids.shape[1], Sb)
-        # dispatch OFF the lock, same as encode_to_device
+        # dispatch OFF the lock, same as encode_to_device (and the same
+        # "encoder.dispatch" retry/fault site)
         # no separate mask transfer: segments>0 IS the token mask in
         # the packed forward
-        pooled = fn(
+        pooled = retry_call(
+            "encoder.dispatch",
+            fn,
             self.params,
             jnp.asarray(ids),
             jnp.asarray(segments),

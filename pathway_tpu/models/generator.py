@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..robust import retry_call
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
@@ -139,7 +140,12 @@ class TextGenerator:
         # compiled-fn cache
         t0 = time.perf_counter_ns()
         observe.record_occupancy("generator", n, b)
-        toks = fn(
+        # "generator.dispatch" is the retry/fault site: a generator that
+        # stays down raises out of here, and the QA layer's ladder rung
+        # answers extractively from the retrieved passages instead
+        toks = retry_call(
+            "generator.dispatch",
+            fn,
             self.params,
             jnp.asarray(ids),
             jnp.asarray(mask_full),

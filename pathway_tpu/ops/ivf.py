@@ -39,10 +39,27 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..robust import (
+    RetryPolicy,
+    TAIL_SKIPPED,
+    inject,
+    log_once,
+    record_degraded,
+    retry_call,
+)
 from .knn import _bucket, normalize_metric
 from .recompile_guard import RecompileTripwire
 
 __all__ = ["IvfKnnIndex"]
+
+# backoff schedule for failed background maintenance passes (absorb /
+# retrain): a transient device error must not leave the tail growing
+# unboundedly, but a persistent one must not spin the maintenance
+# thread either — bounded attempts, exponential backoff, seeded jitter
+_MAINT_RETRY = RetryPolicy(attempts=3, base_delay_s=0.05, max_delay_s=1.0)
+# the serve-path tail upload retries fast and briefly: it runs under
+# the index lock, so its whole retry budget must stay in the low ms
+_TAIL_RETRY = RetryPolicy(attempts=3, base_delay_s=0.002, max_delay_s=0.02)
 
 # maintenance-duration histograms (flight recorder): absorb/retrain wall
 # time, observed from the maintenance threads AFTER their lock sections
@@ -237,7 +254,14 @@ class IvfKnnIndex:
             "absorbs": 0,
             "tail_cache_hits": 0,
             "tail_cache_misses": 0,
+            "absorb_failures": 0,
+            "retrain_failures": 0,
         }
+        # degradation-ladder flag: True while the LAST tail-snapshot
+        # device upload failed past its retry budget (serving then runs
+        # resident-only, flagged tail_skipped); cleared by any
+        # successful snapshot.  Read by ops/serving.py under the lock.
+        self.tail_degraded = False
         # flight-recorder export: index gauges sampled at scrape time
         # only (zero serve-path cost); id uniquifies multiple indexes
         self._observe_id = observe.next_id()
@@ -255,12 +279,30 @@ class IvfKnnIndex:
         yield ("gauge", "pathway_ivf_nlist", labels, nlist)
         yield ("gauge", "pathway_ivf_resident_vectors", labels, len(self))
         yield ("gauge", "pathway_ivf_tail_size", labels, len(self._tail))
-        for kind in ("sync_builds", "retrains", "absorbs", "absorb_errors"):
+        for kind in ("sync_builds", "retrains", "absorbs"):
             yield (
                 "counter",
                 "pathway_ivf_maintenance_total",
                 {**labels, "kind": kind},
                 self.stats.get(kind, 0),
+            )
+        # legacy alias: the absorb_errors series pre-dates the
+        # maintenance_failures family; both read the ONE failure counter
+        yield (
+            "counter",
+            "pathway_ivf_maintenance_total",
+            {**labels, "kind": "absorb_errors"},
+            self.stats.get("absorb_failures", 0),
+        )
+        for kind, key in (
+            ("absorb", "absorb_failures"),
+            ("retrain", "retrain_failures"),
+        ):
+            yield (
+                "counter",
+                "pathway_ivf_maintenance_failures_total",
+                {**labels, "kind": kind},
+                self.stats.get(key, 0),
             )
         for result, key in (("hit", "tail_cache_hits"), ("miss", "tail_cache_misses")):
             yield (
@@ -404,19 +446,47 @@ class IvfKnnIndex:
         ).start()
 
     def _retrain_bg(self) -> None:
+        """Background retrain with a failure policy: an exception no
+        longer dies silently with the daemon thread — it is logged ONCE
+        per failure type, counted on
+        ``pathway_ivf_maintenance_failures_total{kind="retrain"}``, and
+        the pass retries with backoff from a FRESH snapshot (a stale one
+        could mask rows that changed during the failed attempt).  After
+        the attempt budget the thread exits; serving continues on the
+        old slabs and the next add()/search() re-kicks a retrain."""
         try:
-            with self._lock:
-                snapshot = dict(self._rows)
-            if not snapshot:
-                return
-            # the expensive part (k-means + layout + upload) runs WITHOUT
-            # the lock: serving continues on the old slabs throughout
-            t0 = time.perf_counter_ns()
-            built = self._train_layout(snapshot)
-            with self._lock:
-                self._install(built, snapshot)
-                self.stats["retrains"] += 1
-            _H_RETRAIN.observe_ns(time.perf_counter_ns() - t0)
+            for attempt in range(_MAINT_RETRY.attempts):
+                try:
+                    inject.fire("ivf.retrain")
+                    with self._lock:
+                        snapshot = dict(self._rows)
+                    if not snapshot:
+                        return
+                    # the expensive part (k-means + layout + upload) runs
+                    # WITHOUT the lock: serving continues on the old
+                    # slabs throughout
+                    t0 = time.perf_counter_ns()
+                    built = self._train_layout(snapshot)
+                    with self._lock:
+                        self._install(built, snapshot)
+                        self.stats["retrains"] += 1
+                    _H_RETRAIN.observe_ns(time.perf_counter_ns() - t0)
+                    return
+                except Exception as exc:
+                    with self._lock:
+                        self.stats["retrain_failures"] = (
+                            self.stats.get("retrain_failures", 0) + 1
+                        )
+                    log_once(
+                        f"ivf.retrain:{type(exc).__name__}",
+                        "IVF background retrain failed (%r); retrying with "
+                        "backoff — failures counted on "
+                        "pathway_ivf_maintenance_failures_total",
+                        exc,
+                    )
+                    if attempt + 1 >= _MAINT_RETRY.attempts:
+                        return
+                    time.sleep(_MAINT_RETRY.delay_s("ivf.retrain", attempt + 1))
         finally:
             self._retraining = False
 
@@ -569,26 +639,44 @@ class IvfKnnIndex:
         under the lock, run the expensive plan (centroid-preference matmul
         + host fetch + free-slot placement) WITHOUT the lock — serving
         continues throughout — then re-acquire the lock only for the
-        donated scatter + bookkeeping."""
+        donated scatter + bookkeeping.
+
+        Failure policy (ISSUE 4): an exception used to kill this daemon
+        thread with only an excepthook traceback, leaving the tail to
+        grow unboundedly until the next threshold crossing.  Now each
+        failure is logged ONCE per type, counted on
+        ``pathway_ivf_maintenance_failures_total{kind="absorb"}``, and
+        the pass retries with backoff from a FRESH snapshot (the failed
+        attempt may have raced a layout swap).  After the attempt budget
+        the flag clears and the next add() re-arms an absorb."""
         try:
-            t0 = time.perf_counter_ns()
-            with self._lock:
-                snap = self._absorb_snapshot()
-            if snap is None:
-                return
-            plan = self._plan_absorb(snap)
-            with self._lock:
-                self._commit_absorb(snap, plan)
-            _H_ABSORB.observe_ns(time.perf_counter_ns() - t0)
-        except Exception:
-            # keep a visible trace of background failures (the threading
-            # excepthook prints the traceback; the old synchronous absorb
-            # raised into add()); the cleared flag below re-arms a retry
-            with self._lock:
-                self.stats["absorb_errors"] = (
-                    self.stats.get("absorb_errors", 0) + 1
-                )
-            raise
+            for attempt in range(_MAINT_RETRY.attempts):
+                try:
+                    t0 = time.perf_counter_ns()
+                    with self._lock:
+                        snap = self._absorb_snapshot()
+                    if snap is None:
+                        return
+                    plan = self._plan_absorb(snap)
+                    with self._lock:
+                        self._commit_absorb(snap, plan)
+                    _H_ABSORB.observe_ns(time.perf_counter_ns() - t0)
+                    return
+                except Exception as exc:
+                    with self._lock:
+                        self.stats["absorb_failures"] = (
+                            self.stats.get("absorb_failures", 0) + 1
+                        )
+                    log_once(
+                        f"ivf.absorb:{type(exc).__name__}",
+                        "IVF background absorb failed (%r); retrying with "
+                        "backoff — failures counted on "
+                        "pathway_ivf_maintenance_failures_total",
+                        exc,
+                    )
+                    if attempt + 1 >= _MAINT_RETRY.attempts:
+                        return
+                    time.sleep(_MAINT_RETRY.delay_s("ivf.absorb", attempt + 1))
         finally:
             self._absorbing = False
 
@@ -619,6 +707,7 @@ class IvfKnnIndex:
         with spare capacity.  Lock-free: touches only the snapshot.  The
         device preference matmul + its host sync live here — the whole
         point of planning off the lock."""
+        inject.fire("ivf.absorb")  # chaos site: the off-lock planning pass
         data = snap["data"]
         t = data.shape[0]
         M_pad = snap["M_pad"]
@@ -787,19 +876,54 @@ class IvfKnnIndex:
         if cache is None:
             self.stats["tail_cache_misses"] += 1
             tail, tail_mat, tail_valid, t_pad = self._tail_snapshot()
-            if t_pad:
-                dev_mat = jnp.asarray(tail_mat[:t_pad], self.dtype)
-                dev_valid = jnp.asarray(tail_valid[:t_pad])
-            else:
+
+            def _upload():
+                if t_pad:
+                    return (
+                        jnp.asarray(tail_mat[:t_pad], self.dtype),
+                        jnp.asarray(tail_valid[:t_pad]),
+                    )
                 # placeholder shapes for the tail-less kernel signature
-                dev_mat = jnp.asarray(
-                    np.zeros((1, self.dimension), np.float32), self.dtype
+                return (
+                    jnp.asarray(
+                        np.zeros((1, self.dimension), np.float32), self.dtype
+                    ),
+                    jnp.asarray(np.zeros(1, bool)),
                 )
-                dev_valid = jnp.asarray(np.zeros(1, bool))
+
+            try:
+                # transient upload failures retry briefly (the caller
+                # holds the index lock, so the budget is milliseconds);
+                # "ivf.tail_upload" is the chaos-suite fault site
+                dev_mat, dev_valid = retry_call(
+                    "ivf.tail_upload", _upload, policy=_TAIL_RETRY
+                )
+            except Exception as exc:
+                # degradation ladder: tail unavailable ⇒ serve resident-
+                # only results, flagged + counted.  NOT cached, so the
+                # next serve retries the upload and recovery is automatic.
+                log_once(
+                    f"ivf.tail_upload:{type(exc).__name__}",
+                    "IVF exact-tail device upload failed (%r); serving "
+                    "resident-only (tail_skipped) until it recovers",
+                    exc,
+                )
+                record_degraded(TAIL_SKIPPED)
+                self.tail_degraded = True
+                return (
+                    [],
+                    jnp.asarray(
+                        np.zeros((1, self.dimension), np.float32), self.dtype
+                    ),
+                    jnp.asarray(np.zeros(1, bool)),
+                    0,
+                )
+            self.tail_degraded = False
             cache = (tail, dev_mat, dev_valid, t_pad)
             self._tail_cache = cache
         else:
             self.stats["tail_cache_hits"] += 1
+            self.tail_degraded = False
         return cache
 
     def build_from_matrix(self, keys: Sequence[int], matrix_dev) -> None:

@@ -37,11 +37,38 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..robust import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RERANK_SKIPPED,
+    RETRIEVAL_FAILED,
+    RetryPolicy,
+    ServeResult,
+    breaker as robust_breaker,
+    inject,
+    log_once,
+    record_degraded,
+    retry_call,
+    stage1_fraction,
+)
 from .dispatch_counter import record_dispatch, record_fetch
 from .recompile_guard import RecompileTripwire
 from .serving import FusedEncodeSearch
 
 __all__ = ["RetrieveRerankPipeline"]
+
+# the packed stage-2 dispatch launches under the pipeline lock (the
+# compile cache + stats it snapshots live there), so its retry backoff
+# must stay in the low milliseconds — a long sleep would stall every
+# concurrent serve's stage-2 submission
+_STAGE2_RETRY = RetryPolicy(attempts=3, base_delay_s=0.002, max_delay_s=0.02)
+# the HF host path wraps CrossEncoderModel.submit, whose OWN dispatch
+# already retries under the "cross_encoder.dispatch" site: one outer
+# attempt keeps the breaker gate + fault site without multiplying the
+# inner attempt budget (3x3 dispatches and triple-counted breaker
+# failures otherwise)
+_OUTER_RETRY = RetryPolicy(attempts=1)
 
 # flight-recorder stage histograms: stage2_pack is host-side pair
 # assembly + packing up to the rescore dispatch; stage2_rtt is the
@@ -57,14 +84,22 @@ class _PendingServe:
     stage 1 and dispatches stage 2 without blocking on the final fetch;
     calling the handle finishes the serve.  A per-handle lock makes both
     idempotent — a handle shared across threads (or completed twice)
-    dispatches stage 2 and fetches its result exactly once."""
+    dispatches stage 2 and fetches its result exactly once.
+
+    The handle is also where the degradation ladder lands (robust/):
+    stage-1 results that are already on host are NEVER discarded for a
+    stage-2 problem.  Reranker down / circuit open / deadline spent ⇒
+    the stage-1 ranking is served flagged ``rerank_skipped``; stage 1
+    itself failing (after its retry budget) ⇒ an empty result flagged
+    ``retrieval_failed``.  No failure mode raises out of the handle."""
 
     __slots__ = (
         "_pipeline", "_stage1", "_queries", "_k",
         "_stage2", "_result", "_done", "_hlock",
+        "_deadline", "_stage1_rows",
     )
 
-    def __init__(self, pipeline, stage1, queries, k) -> None:
+    def __init__(self, pipeline, stage1, queries, k, deadline=None) -> None:
         self._pipeline = pipeline
         self._stage1 = stage1
         self._queries = queries
@@ -73,25 +108,95 @@ class _PendingServe:
         self._result: Any = None
         self._done = False
         self._hlock = threading.Lock()
+        self._deadline: Optional[Deadline] = deadline
+        self._stage1_rows: Any = None
 
     def advance(self) -> None:
         with self._hlock:
             self._advance_locked()
 
     def _advance_locked(self) -> None:
-        if self._stage2 is None:
+        if self._stage2 is not None:
+            return
+        deadline = self._deadline
+        try:
             hits = self._stage1()  # host fetch #1 (stage-1 packed output)
-            cand_keys = [[key for key, _ in row] for row in hits]
+        except Exception as exc:  # ladder bottom: retrieval itself is down
+            if not isinstance(exc, DeadlineExceeded):
+                log_once(
+                    f"stage1:{type(exc).__name__}",
+                    "stage-1 retrieval failed (%r); serving empty degraded "
+                    "results — first occurrence, further ones counted on "
+                    "pathway_serve_degraded_total",
+                    exc,
+                )
+            record_degraded(RETRIEVAL_FAILED)
+            empty = ServeResult(
+                [[] for _ in self._queries], degraded=(RETRIEVAL_FAILED,)
+            )
+            self._stage2 = lambda: empty
+            return
+        self._stage1_rows = hits
+        cand_keys = [[key for key, _ in row] for row in hits]
+        try:
+            if deadline is not None:
+                # deadline-tight rung: no budget left for the rescore
+                # round trip — serve the stage-1 ranking immediately
+                deadline.check("stage2_submit")
             with self._pipeline._lock:
                 self._stage2 = self._pipeline._submit_stage2(
-                    self._queries, cand_keys, self._k
+                    self._queries, cand_keys, self._k,
+                    deadline=deadline,
+                    stage1_flags=getattr(hits, "degraded", ()),
                 )
+        except Exception as exc:
+            # CircuitOpen / DeadlineExceeded are policy outcomes (the
+            # breaker bookkeeping happened inside retry_call); anything
+            # else was a dispatch failure that exhausted its retries
+            if not isinstance(exc, DeadlineExceeded):
+                log_once(
+                    f"stage2:{type(exc).__name__}",
+                    "stage-2 rerank dispatch failed (%r); serving stage-1 "
+                    "scores flagged rerank_skipped",
+                    exc,
+                )
+            self._stage2 = self._stage1_fallback_fn()
+
+    def _stage1_fallback_fn(self):
+        """A completion serving the stage-1 ranking truncated to ``k``,
+        flagged ``rerank_skipped`` (stage-1's own flags carried over)."""
+        hits = self._stage1_rows
+        if hits is None:
+            hits = [[] for _ in self._queries]
+        k = self._k
+        result = ServeResult(
+            [list(row[:k]) for row in hits],
+            degraded=tuple(getattr(hits, "degraded", ())) + (RERANK_SKIPPED,),
+        )
+        record_degraded(RERANK_SKIPPED)
+        return lambda: result
 
     def __call__(self) -> List[List[Tuple[int, float]]]:
         with self._hlock:
             if not self._done:
                 self._advance_locked()
-                self._result = self._stage2()
+                try:
+                    self._result = self._stage2()
+                except DeadlineExceeded:
+                    # stage 2 missed the deadline mid-fetch: the stage-1
+                    # results already on host are the serve
+                    self._result = self._stage1_fallback_fn()()
+                except Exception as exc:
+                    # a stage-2 fetch failure is a cross-encoder failure:
+                    # feed the breaker so a persistent one opens it
+                    self._pipeline._breaker.record_failure()
+                    log_once(
+                        f"stage2_fetch:{type(exc).__name__}",
+                        "stage-2 rerank fetch failed (%r); serving stage-1 "
+                        "scores flagged rerank_skipped",
+                        exc,
+                    )
+                    self._result = self._stage1_fallback_fn()()
                 self._done = True
             return self._result
 
@@ -118,12 +223,22 @@ class RetrieveRerankPipeline:
         doc_text: Union[Mapping[int, str], Callable[[int], str]],
         k: int = 10,
         candidates: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        rerank_breaker: Optional[CircuitBreaker] = None,
     ):
         self.retriever = retriever
         self.cross_encoder = cross_encoder
         self.doc_text = doc_text
         self.k = k
         self.candidates = candidates or max(4 * k, 16)
+        # per-serve wall-clock budget: explicit arg beats the
+        # PATHWAY_SERVE_DEADLINE_MS env default; <= 0 disables
+        self.deadline_ms = deadline_ms
+        # per-model circuit breaker shared across pipelines scoring
+        # through the same cross-encoder: persistent rerank failures
+        # open it and every serve fast-paths to the rerank_skipped rung
+        # until the half-open probe succeeds (robust/retry.py)
+        self._breaker = rerank_breaker or robust_breaker("cross_encoder")
         self._lock = threading.Lock()
         self._fns: Dict[Tuple, Any] = {}
         # recompile tripwire (ops/recompile_guard.py): stage-2 shapes are
@@ -131,15 +246,35 @@ class RetrieveRerankPipeline:
         self._tripwire = RecompileTripwire("RetrieveRerankPipeline.stage2")
         self.stats = {"serves": 0, "stage2_pairs": 0, "stage2_rows": 0}
 
+    def _default_deadline(self) -> Optional[Deadline]:
+        if self.deadline_ms is not None:
+            return (
+                Deadline.after_ms(self.deadline_ms)
+                if self.deadline_ms > 0
+                else None
+            )
+        return Deadline.from_env()
+
     # -- host helpers -------------------------------------------------------
-    def _text_of(self, key: int) -> str:
+    def _text_of(self, key: int, missing: Optional[List[int]] = None) -> str:
+        """Document text for a stage-1 winner.  A key evicted between
+        retrieval and rerank (LookupError, or absent from the mapping)
+        must not sink the serve: it scores against empty text and is
+        reported in the response metadata (``meta["missing_docs"]``).
+        Any OTHER exception is a real bug in ``doc_text`` and surfaces."""
         src = self.doc_text
         try:
             if callable(src):
-                return str(src(key) or "")
-            return str(src.get(key, "") or "")
-        except LookupError:  # a missing doc must not sink a serve; anything
-            return ""  # else is a real bug in doc_text and must surface
+                text = src(key)
+            else:
+                if key not in src:
+                    raise LookupError(key)
+                text = src[key]
+        except LookupError:
+            if missing is not None:
+                missing.append(key)
+            return ""
+        return str(text or "")
 
     # -- stage 2 kernel -----------------------------------------------------
     def _compiled_stage2(self, R: int, L: int, S: int, Q: int, k_out: int):
@@ -185,9 +320,13 @@ class RetrieveRerankPipeline:
         queries: Sequence[str],
         cand_keys: List[List[int]],
         k: int,
+        deadline: Optional[Deadline] = None,
+        stage1_flags: Sequence[str] = (),
     ):
         """Pack the (query, candidate) pairs and dispatch the stage-2
-        kernel; returns a completion -> [[(key, rerank_score)]]."""
+        kernel; returns a completion -> ``ServeResult`` of
+        [[(key, rerank_score)]] carrying the stage-1 degradation flags
+        and any ``missing_docs`` metadata."""
         from ..models.encoder import _bucket
 
         t_pack = time.perf_counter_ns()
@@ -197,14 +336,21 @@ class RetrieveRerankPipeline:
         nq = len(queries)
         pairs: List[Tuple[str, str]] = []
         slot_ids: List[int] = []
+        missing: List[int] = []
         for qi, row in enumerate(cand_keys):
             for j, key in enumerate(row[:Kc]):
-                pairs.append((queries[qi], self._text_of(key)))
+                pairs.append((queries[qi], self._text_of(key, missing)))
                 slot_ids.append(qi * Kc + j)
+        meta = {"missing_docs": tuple(missing)} if missing else None
         if not pairs:
-            return lambda: [[] for _ in range(nq)]
+            return lambda: ServeResult(
+                [[] for _ in range(nq)], degraded=stage1_flags, meta=meta
+            )
         if getattr(ce, "_hf", False):
-            return self._submit_stage2_host(queries, cand_keys, pairs, k_out)
+            return self._submit_stage2_host(
+                queries, cand_keys, pairs, k_out,
+                deadline=deadline, stage1_flags=stage1_flags, meta=meta,
+            )
         from ..models.packing import pad_packed_rows, seg_bucket
 
         Qb = _bucket(nq)
@@ -219,12 +365,20 @@ class RetrieveRerankPipeline:
         for i, (r, s) in enumerate(doc_slots):
             pair_slot[r * Sb + s] = slot_ids[i]
         fn = self._compiled_stage2(Rb, L, Sb, Qb, k_out)
-        out = fn(
+        # retry transient dispatch failures; the per-model breaker both
+        # gates the attempts (CircuitOpen fast-fails to the ladder) and
+        # learns from their outcomes ("rerank.dispatch" is the chaos site)
+        out = retry_call(
+            "rerank.dispatch",
+            fn,
             ce.params,
             jnp.asarray(ids),
             jnp.asarray(segments),
             jnp.asarray(positions),
             jnp.asarray(pair_slot),
+            deadline=deadline,
+            policy=_STAGE2_RETRY,
+            breaker=self._breaker,
         )
         record_dispatch("rerank_stage2")
         if hasattr(out, "copy_to_host_async"):
@@ -240,6 +394,13 @@ class RetrieveRerankPipeline:
         observe.record_occupancy("stage2_pairs", len(pairs), Rb * Sb)
 
         def complete() -> List[List[Tuple[int, float]]]:
+            inject.fire("cross_encoder.fetch", deadline=deadline)
+            if deadline is not None:
+                # budget spent before blocking on the stage-2 copy: the
+                # stage-1 results already on host ARE the serve — the
+                # caller (_PendingServe) converts this into the
+                # rerank_skipped rung instead of waiting longer
+                deadline.check("cross_encoder.fetch")
             arr = np.asarray(out)[:nq]
             record_fetch("rerank_stage2")
             t_fetch = time.perf_counter_ns()
@@ -270,18 +431,39 @@ class RetrieveRerankPipeline:
                 rtt_ms=(t_fetch - t_dispatch) * 1e-6,
                 postprocess_ms=(t_done - t_fetch) * 1e-6,
             )
-            return results
+            return ServeResult(results, degraded=stage1_flags, meta=meta)
 
         return complete
 
-    def _submit_stage2_host(self, queries, cand_keys, pairs, k_out):
+    def _submit_stage2_host(
+        self,
+        queries,
+        cand_keys,
+        pairs,
+        k_out,
+        deadline: Optional[Deadline] = None,
+        stage1_flags: Sequence[str] = (),
+        meta=None,
+    ):
         """HF fallback: unpacked async scoring + host-side per-query sort
         (HF modules take no segment inputs; still one dispatch + one fetch,
         just a max-length-padded batch)."""
         from ..models.encoder import _bucket
 
         t_pack = time.perf_counter_ns()
-        score_done = self.cross_encoder.submit(pairs, packed=False)
+        # the lambda forwards the deadline to the MODEL's submit (so its
+        # inner "cross_encoder.dispatch" retries and its completion-time
+        # check are budget-bounded) — retry_call's own deadline= kwarg is
+        # consumed by the wrapper and would otherwise never reach it
+        score_done = retry_call(
+            "rerank.dispatch",
+            lambda: self.cross_encoder.submit(
+                pairs, packed=False, deadline=deadline
+            ),
+            deadline=deadline,
+            policy=_OUTER_RETRY,
+            breaker=self._breaker,
+        )
         record_dispatch("rerank_stage2_host")
         self.stats["stage2_pairs"] += len(pairs)
         rows = _bucket(len(pairs))  # one row per pair
@@ -291,6 +473,9 @@ class RetrieveRerankPipeline:
         observe.record_occupancy("stage2", len(pairs), rows)
 
         def complete() -> List[List[Tuple[int, float]]]:
+            inject.fire("cross_encoder.fetch", deadline=deadline)
+            if deadline is not None:
+                deadline.check("cross_encoder.fetch")
             flat = score_done()
             record_fetch("rerank_stage2_host")
             t_fetch = time.perf_counter_ns()
@@ -311,12 +496,17 @@ class RetrieveRerankPipeline:
                 "serve", "rerank_stage2_host", t_done - t_pack,
                 queries=len(queries), pairs=len(pairs),
             )
-            return results
+            return ServeResult(results, degraded=stage1_flags, meta=meta)
 
         return complete
 
     # -- serve --------------------------------------------------------------
-    def submit(self, queries: Sequence[str], k: Optional[int] = None):
+    def submit(
+        self,
+        queries: Sequence[str],
+        k: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
+    ):
         """Dispatch stage 1 WITHOUT waiting; returns a handle that is also
         the completion callable.  ``handle.advance()`` completes stage 1
         and dispatches stage 2 without blocking on the final fetch, so a
@@ -324,19 +514,54 @@ class RetrieveRerankPipeline:
         full (stage 2 of call N overlaps stage 1 of call N+1);
         ``handle()`` finishes the serve.  ``k`` is capped at the
         ``candidates`` pool width (standard top-k semantics: a serve cannot
-        return more documents than stage 1 retrieved)."""
+        return more documents than stage 1 retrieved).
+
+        ``deadline`` (default: ``deadline_ms`` ctor arg, then the
+        ``PATHWAY_SERVE_DEADLINE_MS`` env knob) is the serve's wall-clock
+        budget: stage 1 gets a ``stage1_fraction()`` sub-budget, stage 2
+        whatever remains, and a spent budget degrades the serve down the
+        ladder (rerank_skipped / retrieval_failed) instead of raising."""
         k = k or self.k
         queries = list(queries)
+        if deadline is None:
+            deadline = self._default_deadline()
         if not queries:
-            done = _PendingServe(self, lambda: [], [], k)
-            done._stage2 = lambda: []
+            done = _PendingServe(self, lambda: ServeResult(), [], k)
+            done._stage2 = lambda: ServeResult()
             return done
-        stage1 = self.retriever.submit(queries, self.candidates)
+        stage1_deadline = (
+            deadline.sub_budget(stage1_fraction()) if deadline else None
+        )
+        try:
+            # only pass the kwarg when there IS a deadline, so duck-typed
+            # retrievers with the pre-deadline submit(texts, k) signature
+            # keep working in the no-deadline configuration
+            if stage1_deadline is not None:
+                stage1 = self.retriever.submit(
+                    queries, self.candidates, deadline=stage1_deadline
+                )
+            else:
+                stage1 = self.retriever.submit(queries, self.candidates)
+        except TypeError:
+            # a signature mismatch is a programming error, not a
+            # retrieval outage — it must surface loudly at submit time,
+            # never masquerade as permanent retrieval_failed serves
+            raise
+        except Exception as exc:
+            # stage-1 dispatch failed past its retry budget: the handle
+            # re-raises at advance() time so the ladder lands in ONE
+            # place (_PendingServe), whether dispatch or fetch failed
+            def stage1(_exc: Exception = exc):
+                raise _exc
+
         with self._lock:
             self.stats["serves"] += 1
-        return _PendingServe(self, stage1, queries, k)
+        return _PendingServe(self, stage1, queries, k, deadline=deadline)
 
     def __call__(
-        self, queries: Sequence[str], k: Optional[int] = None
+        self,
+        queries: Sequence[str],
+        k: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
     ) -> List[List[Tuple[int, float]]]:
-        return self.submit(queries, k)()
+        return self.submit(queries, k, deadline=deadline)()

@@ -40,6 +40,7 @@ hand arbitrary-code-execution to anyone who could reach the port.
 
 from __future__ import annotations
 
+import errno
 import hmac
 import os
 import pickle
@@ -48,15 +49,29 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import observe
+from ..robust import RetryPolicy, inject
 
 __all__ = ["ExchangePlane", "get_plane", "close_plane"]
 
 _HDR = struct.Struct("!Q")
 _TOKEN_LEN = 32
 _HB_EDGE = "__hb__"
+# clean-shutdown control frame: a rank leaving on purpose announces it,
+# so its disconnect is goodbye, not PeerLost
+_BYE_EDGE = "__bye__"
+
+# socket errors that mean "try the same write again", NOT "the peer is
+# gone": interrupted syscalls and transient kernel buffer exhaustion.
+# Anything else (ECONNRESET, EPIPE, ...) stays fatal for the stream.
+_TRANSIENT_ERRNOS = frozenset(
+    {errno.EINTR, errno.EAGAIN, errno.EWOULDBLOCK, errno.ENOBUFS, errno.ENOMEM}
+)
+# pre-frame send retries (fault site "exchange.send"): safe only before
+# the first byte of a frame is on the wire
+_SEND_RETRY = RetryPolicy(attempts=3, base_delay_s=0.005, max_delay_s=0.1)
 
 
 def _hb_interval() -> float:
@@ -83,6 +98,10 @@ class ExchangePlane:
         self._cv = threading.Condition()
         self._dead: Optional[BaseException] = None
         self._closed = False
+        # peers that announced clean shutdown (__bye__): their later
+        # disconnect is expected, not PeerLost — but a collective still
+        # WAITING on one of them fails immediately with a clear message
+        self._peer_closed: Set[int] = set()
         self._recv_threads: List[threading.Thread] = []
         self._last_recv: Dict[int, float] = {}
         # flight-recorder accounting: per-peer wire traffic counters
@@ -212,12 +231,20 @@ class ExchangePlane:
                 edge, seq, obj = self._deserialize(peer, payload)
                 with self._cv:
                     self._last_recv[peer] = time.monotonic()
-                    if edge != _HB_EDGE:
+                    if edge == _BYE_EDGE:
+                        # clean shutdown announced: the disconnect that
+                        # follows is goodbye, not peer death
+                        self._peer_closed.add(peer)
+                    elif edge != _HB_EDGE:
                         self._inbox[(edge, seq, peer)] = obj
                     self._cv.notify_all()
         except BaseException as exc:  # noqa: BLE001 - any failure kills the run
             with self._cv:
-                if not self._closed and self._dead is None:
+                if (
+                    not self._closed
+                    and peer not in self._peer_closed
+                    and self._dead is None
+                ):
                     self._dead = PeerLost(
                         f"exchange peer {peer} disconnected: {exc!r}"
                     )
@@ -226,6 +253,24 @@ class ExchangePlane:
     def _send_to(self, peer: int, edge: str, seq: int, obj: Any) -> None:
         parts = self._serialize(edge, seq, obj)
         total = sum(len(p) for p in parts)
+        # chaos fault site, fired before the first byte of the frame is
+        # on the wire — the only point where a retry cannot desync the
+        # stream.  Injected faults retry with backoff under _SEND_RETRY;
+        # REAL transient socket errors are handled separately inside
+        # _send_frame's slice loop (this site has no real work of its
+        # own, so it deliberately bypasses retry_call — its retry
+        # counters must never suggest production sends were retried
+        # here).  An exhausted budget is a send failure: PeerLost.
+        for attempt in range(_SEND_RETRY.attempts):
+            try:
+                inject.fire("exchange.send")
+                break
+            except Exception as exc:
+                if attempt + 1 >= _SEND_RETRY.attempts:
+                    raise PeerLost(
+                        f"send to exchange peer {peer} failed: {exc!r}"
+                    ) from exc
+                time.sleep(_SEND_RETRY.delay_s("exchange.send", attempt + 1))
         try:
             with self._send_locks[peer]:
                 # header + chunks as sequential writes under the one lock:
@@ -352,6 +397,7 @@ class ExchangePlane:
                     # (serializing) thread for as long as the peer stays
                     # congested
                     ping_deadline = time.monotonic() + hb_timeout
+            transient = 0
             while view:
                 try:
                     sent = s.send(view)
@@ -369,6 +415,25 @@ class ExchangePlane:
                             f">{hb_timeout}s (receive side wedged); the "
                             "partially written stream is unrecoverable"
                         )
+                    continue
+                except OSError as exc:
+                    # TRANSIENT socket errors (EINTR, EAGAIN, ENOBUFS...)
+                    # retry the SAME slice with a short backoff — they
+                    # mean the kernel hiccuped, not that the peer died.
+                    # The peer-silence bound above still applies: a peer
+                    # that has ALSO stopped heartbeating is genuinely
+                    # gone and the retry loop must not mask that.
+                    if exc.errno not in _TRANSIENT_ERRNOS:
+                        raise
+                    transient += 1
+                    now = time.monotonic()
+                    if now - self._last_recv.get(peer, 0.0) > hb_timeout:
+                        raise PeerLost(
+                            f"send to exchange peer {peer} failing "
+                            f"transiently ({exc!r}) with no heartbeat from "
+                            f"it for >{hb_timeout}s (hung or partitioned)"
+                        ) from exc
+                    time.sleep(min(0.001 * (2.0 ** transient), 0.05))
                     continue
                 view = view[sent:]
             self._bytes_out[peer] = self._bytes_out.get(peer, 0) + len(frame)
@@ -429,6 +494,19 @@ class ExchangePlane:
                     return out
                 if self._dead is not None:
                     raise self._dead
+                closed = [
+                    p for p in peers if p not in out and p in self._peer_closed
+                ]
+                if closed:
+                    # clean shutdown is NOT peer death — but a peer that
+                    # said goodbye before sending this collective's part
+                    # will never send it; fail this wait immediately and
+                    # clearly WITHOUT poisoning the whole plane (other
+                    # collectives may already hold their data)
+                    raise PeerLost(
+                        f"exchange {edge!r}#{seq}: peers {closed} closed "
+                        "cleanly before sending (shutdown mid-collective)"
+                    )
                 now = time.monotonic()
                 stalled = [
                     p
@@ -508,7 +586,10 @@ class ExchangePlane:
             last = self._last_recv.get(peer)
             silence = max(0.0, now - last) if last is not None else None
             up = int(
-                not down and silence is not None and silence <= hb_timeout
+                not down
+                and peer not in self._peer_closed
+                and silence is not None
+                and silence <= hb_timeout
             )
             yield ("gauge", "pathway_exchange_peer_up", labels, up)
             if silence is not None:
@@ -546,9 +627,32 @@ class ExchangePlane:
         )
 
     def close(self) -> None:
+        """Clean shutdown: announce ``__bye__`` to every peer (so this
+        rank's disconnect reads as goodbye, not ``PeerLost``), then close
+        the sockets.  Idempotent; best-effort — a peer that is already
+        gone just misses a goodbye it no longer needs."""
         with self._cv:
+            if self._closed:
+                return
             self._closed = True
             self._cv.notify_all()
+        bye = pickle.dumps(
+            (_BYE_EDGE, 0, None), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        frame = _HDR.pack(len(bye)) + bye
+        for peer, s in self._send.items():
+            lock = self._send_locks[peer]
+            # a short bounded wait: never let one wedged peer stall the
+            # whole shutdown, and never interleave into an in-flight frame
+            if not lock.acquire(timeout=1.0):
+                continue
+            try:
+                s.settimeout(1.0)
+                s.sendall(frame)
+            except OSError:
+                pass
+            finally:
+                lock.release()
         for s in self._send.values():
             try:
                 s.close()

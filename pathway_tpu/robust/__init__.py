@@ -1,0 +1,73 @@
+"""Serve-path fault tolerance: deadlines, retries, circuit breakers,
+the degradation ladder, and deterministic fault injection.
+
+PR 1 made the retrieve→rerank serve fast (2 dispatches + 2 fetches),
+PR 2 made it statically checked, PR 3 made it observable; this package
+makes it *survivable*.  Individual device dispatches, peers, uploads,
+and maintenance passes WILL fail under production traffic — the serve
+surface must degrade instead of dying:
+
+- ``Deadline`` / ``DeadlineExceeded`` (``deadline.py``): a wall-clock
+  budget carried explicitly through serving → retrieve_rerank → model
+  submit/fetch, with per-stage sub-budgets;
+- ``retry_call`` + ``CircuitBreaker`` (``retry.py``): bounded,
+  seeded-jitter retries for transient failures; per-model breakers
+  that fail fast (and feed the ladder) when a model is persistently
+  down;
+- the degradation ladder (``degrade.py``): ``ServeResult`` response
+  flags + ``pathway_serve_degraded_total{reason=...}`` counters for
+  every rung — rerank_skipped / tail_skipped / extractive_answer /
+  retrieval_failed;
+- deterministic fault injection (``inject.py``): named sites
+  (``ivf.dispatch``, ``cross_encoder.fetch``, ``exchange.send``,
+  ``ivf.absorb``, …) armable to raise / delay / hang via
+  ``PATHWAY_FAULTS`` or a context manager, seeded and thread-safe —
+  the chaos suite (tests/test_robust.py) proves every rung with it.
+
+Nothing in this package touches jax or holds a lock across blocking
+work; the hot-path static analyzer (pathway_tpu/analysis) understands
+``retry_call(site, jitted_fn, ...)`` as a device dispatch so wrapped
+launches keep their lock-discipline and budget accounting.
+"""
+
+from .deadline import Deadline, DeadlineExceeded, stage1_fraction
+from .degrade import (
+    EXTRACTIVE_ANSWER,
+    RERANK_SKIPPED,
+    RETRIEVAL_FAILED,
+    TAIL_SKIPPED,
+    ServeResult,
+    extractive_answer,
+    record_degraded,
+)
+from .inject import FaultInjected
+from .retry import (
+    CircuitBreaker,
+    CircuitOpen,
+    RetryPolicy,
+    breaker,
+    log_once,
+    retry_call,
+)
+from . import inject
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpen",
+    "Deadline",
+    "DeadlineExceeded",
+    "EXTRACTIVE_ANSWER",
+    "FaultInjected",
+    "RERANK_SKIPPED",
+    "RETRIEVAL_FAILED",
+    "RetryPolicy",
+    "ServeResult",
+    "TAIL_SKIPPED",
+    "breaker",
+    "extractive_answer",
+    "inject",
+    "log_once",
+    "record_degraded",
+    "retry_call",
+    "stage1_fraction",
+]

@@ -1,0 +1,107 @@
+"""Serve-call deadlines with per-stage sub-budgets.
+
+The serving stack's latency contract is round-trip counts, not wall
+clock — but a production serve call still needs a wall-clock *budget*:
+a hung device dispatch, a wedged peer, or a pathological rerank batch
+must bound how long the caller waits, and the multi-stage pipeline must
+know how much of the budget each stage may spend ("Accelerating
+Retrieval-Augmented Generation" budgets retrieval vs inference
+explicitly; every SLO-bearing serving tier does).
+
+A ``Deadline`` is an absolute point on the monotonic clock, created
+from a budget and carried explicitly through ``serving.py`` →
+``retrieve_rerank.py`` → model ``submit()``/fetch.  It is cheap (one
+``time.monotonic`` read per check), immutable, and thread-safe by
+construction.  ``sub_budget`` carves a stage budget out of the
+remaining time without ever extending the parent — a stage can run out
+early, never late.
+
+Exceeding a deadline raises ``DeadlineExceeded`` *inside* the pipeline;
+the pipeline's contract with the user is degrade-not-die: stage-1
+results already on host are served (flagged ``rerank_skipped``) instead
+of the exception propagating (ops/retrieve_rerank.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+__all__ = ["Deadline", "DeadlineExceeded"]
+
+
+class DeadlineExceeded(TimeoutError):
+    """A serve stage ran past its deadline.  ``stage`` names the check
+    site; the serving pipeline converts this into a degraded response
+    rather than letting it reach the user."""
+
+    def __init__(self, stage: str, overshoot_s: float = 0.0):
+        super().__init__(
+            f"deadline exceeded at {stage!r}"
+            + (f" (by {overshoot_s * 1e3:.1f} ms)" if overshoot_s > 0 else "")
+        )
+        self.stage = stage
+        self.overshoot_s = overshoot_s
+
+
+class Deadline:
+    """An absolute monotonic-clock deadline.
+
+    ``Deadline(0.25)`` — a quarter second from now.  Immutable;
+    share freely across threads.
+    """
+
+    __slots__ = ("_at",)
+
+    def __init__(self, budget_s: float, *, _at: Optional[float] = None):
+        self._at = _at if _at is not None else time.monotonic() + float(budget_s)
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(float(ms) * 1e-3)
+
+    @classmethod
+    def from_env(cls) -> Optional["Deadline"]:
+        """Per-serve default budget from ``PATHWAY_SERVE_DEADLINE_MS``;
+        None (no deadline) when unset or <= 0."""
+        ms = float(os.environ.get("PATHWAY_SERVE_DEADLINE_MS", "0") or 0)
+        return cls.after_ms(ms) if ms > 0 else None
+
+    # -- queries ------------------------------------------------------------
+    def remaining_s(self) -> float:
+        """Seconds left (negative once expired)."""
+        return self._at - time.monotonic()
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self._at
+
+    def check(self, stage: str) -> None:
+        """Raise ``DeadlineExceeded`` if the budget is spent."""
+        over = time.monotonic() - self._at
+        if over >= 0:
+            raise DeadlineExceeded(stage, over)
+
+    def sub_budget(self, fraction: float) -> "Deadline":
+        """A stage deadline spending at most ``fraction`` of the time
+        REMAINING now — never later than the parent (a stage may finish
+        the serve early, it cannot extend it)."""
+        remaining = self.remaining_s()
+        if remaining <= 0:
+            return Deadline(0.0, _at=self._at)
+        child_at = time.monotonic() + remaining * max(0.0, min(1.0, fraction))
+        return Deadline(0.0, _at=min(child_at, self._at))
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining_s() * 1e3:.1f}ms)"
+
+
+def stage1_fraction() -> float:
+    """Share of a serve budget granted to stage 1 (retrieval); stage 2
+    runs on whatever remains of the parent budget.  Clamped to (0, 1]."""
+    try:
+        frac = float(os.environ.get("PATHWAY_SERVE_STAGE1_FRACTION", "0.6"))
+    except ValueError:
+        frac = 0.6
+    return min(1.0, max(0.05, frac))

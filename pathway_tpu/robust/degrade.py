@@ -1,0 +1,145 @@
+"""The degradation ladder: what a serve returns when a stage is down.
+
+The north star is a serving tier that stays up for millions of users
+while individual pieces fail (ROADMAP; PAPERS.md's multi-stage ranking
+architectures all assume the retrieval tier outlives the rerank tier).
+Each rung trades quality for availability, never silently — every
+degraded serve is flagged on the response AND counted on the metrics
+surface (``pathway_serve_degraded_total{reason=...}``):
+
+===================  ==========================  =====================
+failure              rung served                 response flag
+===================  ==========================  =====================
+reranker down /      stage-1 (retrieval) scores  ``rerank_skipped``
+circuit open /
+deadline tight
+exact tail           resident-only IVF search    ``tail_skipped``
+unavailable
+generator down       extractive answer from      ``extractive_answer``
+                     the top passages
+stage 1 down         empty result set            ``retrieval_failed``
+===================  ==========================  =====================
+
+``ServeResult`` is a ``list`` subclass, so every existing caller that
+iterates/compares rows keeps working; the ladder metadata rides on
+``.degraded`` (tuple of rung flags) and ``.meta`` (e.g. the
+``missing_docs`` ids whose text was evicted between retrieval and
+rerank).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .. import observe
+
+__all__ = [
+    "EXTRACTIVE_ANSWER",
+    "RERANK_SKIPPED",
+    "RETRIEVAL_FAILED",
+    "TAIL_SKIPPED",
+    "ServeResult",
+    "extractive_answer",
+    "record_degraded",
+]
+
+RERANK_SKIPPED = "rerank_skipped"
+TAIL_SKIPPED = "tail_skipped"
+EXTRACTIVE_ANSWER = "extractive_answer"
+RETRIEVAL_FAILED = "retrieval_failed"
+
+# pre-resolved per-reason counters (reasons are the small fixed rung set)
+_degraded_counters: Dict[str, observe.Counter] = {}
+
+
+def record_degraded(reason: str, n: int = 1) -> None:
+    """Count ``n`` degraded serves for ``reason`` on the existing
+    /metrics surface (``pathway_serve_degraded_total{reason=...}``)."""
+    c = _degraded_counters.get(reason)
+    if c is None:
+        c = _degraded_counters[reason] = observe.counter(
+            "pathway_serve_degraded_total", reason=reason
+        )
+    c.inc(n)
+
+
+class ServeResult(list):
+    """Serve rows plus ladder metadata.  Compares equal to a plain list
+    of the same rows (existing tests and callers keep working); carries
+    ``degraded`` — the tuple of rung flags that applied to this serve —
+    and ``meta`` (e.g. ``missing_docs``)."""
+
+    __slots__ = ("degraded", "meta")
+
+    def __init__(
+        self,
+        rows: Iterable[Any] = (),
+        degraded: Sequence[str] = (),
+        meta: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(rows)
+        self.degraded = tuple(degraded)
+        self.meta = dict(meta or {})
+
+    @property
+    def ok(self) -> bool:
+        return not self.degraded
+
+    def with_flags(
+        self,
+        degraded: Sequence[str] = (),
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> "ServeResult":
+        """A copy with extra flags/meta merged in (dedup, order kept)."""
+        merged = list(self.degraded)
+        for flag in degraded:
+            if flag not in merged:
+                merged.append(flag)
+        out_meta = dict(self.meta)
+        out_meta.update(meta or {})
+        return ServeResult(self, degraded=merged, meta=out_meta)
+
+
+def _sentences(text: str) -> List[str]:
+    out: List[str] = []
+    cur: List[str] = []
+    for ch in str(text):
+        cur.append(ch)
+        if ch in ".!?":
+            s = "".join(cur).strip()
+            if s:
+                out.append(s)
+            cur = []
+    tail = "".join(cur).strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
+def extractive_answer(
+    question: str, docs: Sequence[str], max_sentences: int = 2
+) -> str:
+    """Generator-down rung: a cheap extractive answer — the sentences
+    from the top passages sharing the most terms with the question
+    (ranked by overlap, ties broken by passage rank so the retriever's
+    ordering still matters).  Not an LLM answer; an honest degraded one
+    that keeps the QA surface returning *grounded* text instead of 500s."""
+    q_terms = {t for t in str(question).lower().split() if len(t) > 2}
+    scored: List[Tuple[float, int, str]] = []
+    for rank, doc in enumerate(docs):
+        for sent in _sentences(doc):
+            terms = set(sent.lower().split())
+            overlap = len(q_terms & terms)
+            if overlap:
+                scored.append((-(overlap / (1 + len(terms) ** 0.5)), rank, sent))
+    scored.sort()
+    picked = [s for _, _, s in scored[:max_sentences]]
+    if not picked:
+        # nothing overlaps: fall back to the leading sentence of the
+        # top passage — still grounded in the retrieved context
+        for doc in docs:
+            lead = _sentences(doc)[:1]
+            if lead:
+                picked = lead
+                break
+    return " ".join(picked)

@@ -1,0 +1,251 @@
+"""Deterministic fault-injection registry for the serve stack.
+
+Every failure-handling path in this repo (retry, circuit breaker,
+degradation ladder — ``robust/retry.py``, ``robust/degrade.py``) must be
+*provable* by a test, and real device/socket failures are neither
+deterministic nor portable to CPU CI.  This registry gives each
+instrumented failure point a NAME — ``ivf.dispatch``,
+``cross_encoder.fetch``, ``exchange.send``, ``ivf.absorb``, … — and
+lets a test (or an operator running a game-day) arm any site to
+
+- ``raise`` a ``FaultInjected`` (a transient dispatch/socket error),
+- ``delay`` execution by a fixed duration (a slow link or device), or
+- ``hang`` until the caller's deadline (or a bounded cap) expires,
+
+either via the ``PATHWAY_FAULTS`` environment variable or the
+``armed(...)`` context manager.  Triggering is seeded and thread-safe:
+a probability ``p < 1`` draws from a per-site ``random.Random`` keyed
+by ``(seed, site)``, so a 1%-failure soak replays identically.
+
+The disarmed fast path is one module-global integer compare — serving
+code calls ``fire(site)`` unconditionally and pays nothing in
+production.  Sites are instrumented through ``robust.retry_call`` (which
+fires its site before every attempt) plus explicit ``fire`` calls on
+fetch/maintenance paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .. import observe
+from .deadline import Deadline, DeadlineExceeded
+
+__all__ = [
+    "FaultInjected",
+    "arm",
+    "armed",
+    "disarm",
+    "fire",
+    "fired_count",
+    "load_env",
+]
+
+_MODES = ("raise", "delay", "hang")
+
+# cached fired-counter per (site, mode): the label sets are tiny
+_fired_counters: Dict[Tuple[str, str], observe.Counter] = {}
+
+
+def _fired_counter(site: str, mode: str) -> observe.Counter:
+    key = (site, mode)
+    c = _fired_counters.get(key)
+    if c is None:
+        c = _fired_counters[key] = observe.counter(
+            "pathway_robust_faults_fired_total", site=site, mode=mode
+        )
+    return c
+
+
+class FaultInjected(RuntimeError):
+    """The error an armed ``raise`` site throws — stands in for a
+    transient device dispatch / socket / upload failure."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at {site!r}")
+        self.site = site
+
+
+class _Site:
+    """One armed site (internal).  All mutation under the module lock;
+    ``fire`` copies what it needs and sleeps OFF the lock."""
+
+    __slots__ = (
+        "site", "mode", "times", "p", "delay_s", "hang_s", "rng",
+        "fired", "disarmed",
+    )
+
+    def __init__(
+        self,
+        site: str,
+        mode: str,
+        times: Optional[int],
+        p: float,
+        delay_s: float,
+        hang_s: float,
+        seed: int,
+    ):
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r} (want {_MODES})")
+        self.site = site
+        self.mode = mode
+        self.times = times  # None = unlimited
+        self.p = float(p)
+        self.delay_s = float(delay_s)
+        self.hang_s = float(hang_s)
+        self.rng = random.Random(f"{seed}:{site}")
+        self.fired = 0
+        self.disarmed = threading.Event()
+
+
+_lock = threading.Lock()
+_sites: Dict[str, _Site] = {}
+_armed_count = 0  # fast-path guard: fire() is a no-op while this is 0
+_env_loaded = False
+
+
+def arm(
+    site: str,
+    mode: str = "raise",
+    *,
+    times: Optional[int] = None,
+    p: float = 1.0,
+    delay_s: float = 0.0,
+    hang_s: float = 30.0,
+    seed: int = 0,
+) -> None:
+    """Arm ``site``.  ``times`` bounds how often it triggers (None =
+    every eligible call); ``p`` is the per-call trigger probability
+    (seeded, deterministic); ``delay_s`` is the ``delay`` duration;
+    ``hang_s`` caps a ``hang`` so an un-deadlined caller is released
+    (as a ``FaultInjected``) instead of wedged forever."""
+    global _armed_count
+    spec = _Site(site, mode, times, p, delay_s, hang_s, seed)
+    with _lock:
+        old = _sites.get(site)
+        if old is not None:
+            old.disarmed.set()
+        else:
+            _armed_count += 1
+        _sites[site] = spec
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site (or every site when None); releases hung calls."""
+    global _armed_count
+    with _lock:
+        targets = [site] if site is not None else list(_sites)
+        for name in targets:
+            spec = _sites.pop(name, None)
+            if spec is not None:
+                spec.disarmed.set()
+                _armed_count -= 1
+
+
+@contextlib.contextmanager
+def armed(site: str, mode: str = "raise", **kwargs: Any) -> Iterator[None]:
+    """``with inject.armed("ivf.dispatch", "raise", times=1): ...`` —
+    the test-suite front door; always disarms on exit."""
+    arm(site, mode, **kwargs)
+    try:
+        yield
+    finally:
+        disarm(site)
+
+
+def fired_count(site: str) -> int:
+    with _lock:
+        spec = _sites.get(site)
+        return spec.fired if spec is not None else 0
+
+
+def fire(site: str, deadline: Optional[Deadline] = None) -> None:
+    """The instrumentation point: no-op unless ``site`` is armed.
+
+    ``raise`` → ``FaultInjected``; ``delay`` → sleep ``delay_s`` (capped
+    at the caller's remaining deadline, then the deadline check is the
+    caller's to make); ``hang`` → block until the deadline expires
+    (raising ``DeadlineExceeded``), the site is disarmed, or ``hang_s``
+    elapses (raising ``FaultInjected`` so no caller wedges forever)."""
+    if not _env_loaded:
+        load_env()
+    if _armed_count == 0:
+        return
+    with _lock:
+        spec = _sites.get(site)
+        if spec is None:
+            return
+        if spec.times is not None and spec.fired >= spec.times:
+            return
+        if spec.p < 1.0 and spec.rng.random() >= spec.p:
+            return
+        spec.fired += 1
+        mode = spec.mode
+        delay_s = spec.delay_s
+        hang_s = spec.hang_s
+        disarmed = spec.disarmed
+    _fired_counter(site, mode).inc()
+    if mode == "raise":
+        raise FaultInjected(site)
+    if mode == "delay":
+        if deadline is not None:
+            delay_s = min(delay_s, max(0.0, deadline.remaining_s()) + 0.01)
+        time.sleep(delay_s)
+        return
+    # hang: block in short slices so disarm()/deadline can release us
+    t_end = time.monotonic() + hang_s
+    while True:
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded(site)
+        if disarmed.wait(timeout=0.01):
+            return
+        if time.monotonic() >= t_end:
+            raise FaultInjected(site)
+
+
+def load_env(value: Optional[str] = None) -> List[str]:
+    """Parse ``PATHWAY_FAULTS`` (or an explicit spec string) and arm the
+    sites it names.  Syntax — ``;``- or ``,``-separated entries::
+
+        site=mode[:key=val[:key=val...]]
+        PATHWAY_FAULTS="ivf.dispatch=raise:p=0.01:seed=7;exchange.send=delay:ms=50"
+
+    keys: ``p`` (probability), ``times`` (trigger budget), ``ms``
+    (delay/hang duration), ``hang_ms`` (hang cap), ``seed``.  Returns
+    the list of armed site names (tests use it to assert parsing)."""
+    global _env_loaded
+    _env_loaded = True
+    raw = value if value is not None else os.environ.get("PATHWAY_FAULTS", "")
+    armed_sites: List[str] = []
+    for entry in raw.replace(",", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, rest = entry.partition("=")
+        parts = rest.split(":") if rest else ["raise"]
+        mode = parts[0].strip() or "raise"
+        kwargs: Dict[str, Any] = {}
+        for opt in parts[1:]:
+            k, _, v = opt.partition("=")
+            k = k.strip()
+            if k == "p":
+                kwargs["p"] = float(v)
+            elif k == "times":
+                kwargs["times"] = int(v)
+            elif k == "ms":
+                if mode == "hang":
+                    kwargs["hang_s"] = float(v) * 1e-3
+                else:
+                    kwargs["delay_s"] = float(v) * 1e-3
+            elif k == "hang_ms":
+                kwargs["hang_s"] = float(v) * 1e-3
+            elif k == "seed":
+                kwargs["seed"] = int(v)
+        arm(site.strip(), mode, **kwargs)
+        armed_sites.append(site.strip())
+    return armed_sites

@@ -1,0 +1,336 @@
+"""Retry with exponential backoff + deterministic jitter, and per-model
+circuit breakers.
+
+Transient failures — a device dispatch rejected by a full queue, a
+socket hiccup mid-exchange, a tail-matrix upload racing a device OOM —
+must not surface to a serve caller when simply trying again would
+succeed.  ``retry_call`` wraps one named *site* (the same name the
+fault-injection registry uses — ``robust/inject.py`` fires before every
+attempt, so every retry site is automatically chaos-testable) with a
+bounded attempt budget and exponential backoff whose jitter is seeded
+per ``(site, attempt)``: a failure soak replays identically.
+
+Persistent failures must stop being retried before they melt the serve
+path: a ``CircuitBreaker`` per model opens after N *consecutive*
+failures (every call then fails fast with ``CircuitOpen``, which the
+degradation ladder turns into a flagged stage-skip — see
+``ops/retrieve_rerank.py``), and half-opens after a cool-down to let
+ONE probe through; a probe success closes it, a probe failure re-opens
+it and restarts the timer.
+
+Everything here is host-side integer/float work — no jax, no locks held
+across anything blocking — so the analyzer's lock-discipline and
+hidden-sync rules see nothing to flag (ISSUE 4's "robust calls must be
+lock-clean").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Set, Tuple, Type
+
+from .. import observe
+from . import inject
+from .deadline import Deadline, DeadlineExceeded
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpen",
+    "RetryPolicy",
+    "breaker",
+    "log_once",
+    "retry_call",
+]
+
+_logger = logging.getLogger("pathway_tpu.robust")
+_logged_keys: Set[str] = set()
+_logged_lock = threading.Lock()
+
+
+def log_once(key: str, msg: str, *args: Any) -> None:
+    """Log ``msg`` at WARNING the FIRST time ``key`` is seen (per
+    process).  Degradation paths swallow exceptions by design — this
+    keeps the first instance of each failure mode visible in logs
+    without letting a hot failing path flood them."""
+    with _logged_lock:
+        if key in _logged_keys:
+            return
+        _logged_keys.add(key)
+    _logger.warning(msg, *args)
+
+
+class CircuitOpen(RuntimeError):
+    """Fail-fast: the named breaker is open (recent consecutive
+    failures); callers degrade instead of queueing more doomed work."""
+
+    def __init__(self, name: str):
+        super().__init__(f"circuit breaker {name!r} is open")
+        self.name = name
+
+
+class RetryPolicy:
+    """Attempt budget + backoff schedule for one retry site."""
+
+    __slots__ = ("attempts", "base_delay_s", "max_delay_s", "seed")
+
+    def __init__(
+        self,
+        attempts: int = 3,
+        base_delay_s: float = 0.005,
+        max_delay_s: float = 0.2,
+        seed: int = 0,
+    ):
+        self.attempts = max(1, int(attempts))
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.seed = int(seed)
+
+    def delay_s(self, site: str, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based): exponential with
+        full deterministic jitter — ``Random((seed, site, attempt))``
+        picks a point in [base/2, base*2^a], so concurrent failing
+        sites de-synchronize yet every run replays identically."""
+        cap = min(self.max_delay_s, self.base_delay_s * (2.0 ** attempt))
+        lo = min(self.base_delay_s * 0.5, cap)
+        return lo + random.Random(
+            f"{self.seed}:{site}:{attempt}"
+        ).random() * max(0.0, cap - lo)
+
+    @classmethod
+    def from_env(cls, site: str) -> "RetryPolicy":
+        """Global knobs ``PATHWAY_RETRY_{ATTEMPTS,BASE_MS,MAX_MS,SEED}``
+        with per-site attempt overrides ``PATHWAY_RETRY_ATTEMPTS_<SITE>``
+        (site upper-cased, dots → underscores)."""
+        env = os.environ
+        site_key = site.upper().replace(".", "_").replace("-", "_")
+        attempts = env.get(f"PATHWAY_RETRY_ATTEMPTS_{site_key}") or env.get(
+            "PATHWAY_RETRY_ATTEMPTS", "3"
+        )
+        return cls(
+            attempts=int(attempts),
+            base_delay_s=float(env.get("PATHWAY_RETRY_BASE_MS", "5")) * 1e-3,
+            max_delay_s=float(env.get("PATHWAY_RETRY_MAX_MS", "200")) * 1e-3,
+            seed=int(env.get("PATHWAY_RETRY_SEED", "0")),
+        )
+
+
+# cached per-site policies + observe counters (sites are a small fixed
+# set of serve-path literals)
+_policies: Dict[str, RetryPolicy] = {}
+_retry_counters: Dict[str, observe.Counter] = {}
+_exhausted_counters: Dict[str, observe.Counter] = {}
+
+
+def _policy_for(site: str) -> RetryPolicy:
+    p = _policies.get(site)
+    if p is None:
+        p = _policies[site] = RetryPolicy.from_env(site)
+    return p
+
+
+def _count_retry(site: str, exhausted: bool) -> None:
+    store = _exhausted_counters if exhausted else _retry_counters
+    c = store.get(site)
+    if c is None:
+        name = (
+            "pathway_robust_retry_exhausted_total"
+            if exhausted
+            else "pathway_robust_retries_total"
+        )
+        c = store[site] = observe.counter(name, site=site)
+    c.inc()
+
+
+def retry_call(
+    site: str,
+    fn: Callable[..., Any],
+    *args: Any,
+    deadline: Optional[Deadline] = None,
+    policy: Optional[RetryPolicy] = None,
+    retryable: Tuple[Type[BaseException], ...] = (Exception,),
+    breaker: Optional["CircuitBreaker"] = None,
+    **kwargs: Any,
+) -> Any:
+    """Call ``fn(*args, **kwargs)`` with the site's retry budget.
+
+    Per attempt: the breaker (if any) gates entry, the fault-injection
+    site ``site`` fires (so chaos tests reach this exact code path),
+    then ``fn`` runs.  ``DeadlineExceeded`` and ``CircuitOpen`` are
+    never retried — they are policy outcomes, not transient failures.
+    The backoff sleep is capped at the deadline's remaining budget and
+    the final failure re-raises the last error."""
+    pol = policy or _policy_for(site)
+    last: Optional[BaseException] = None
+    for attempt in range(pol.attempts):
+        if deadline is not None:
+            deadline.check(site)
+        if breaker is not None and not breaker.allow():
+            raise CircuitOpen(breaker.name)
+        try:
+            inject.fire(site, deadline=deadline)
+            result = fn(*args, **kwargs)
+        except (DeadlineExceeded, CircuitOpen):
+            # policy outcomes, not model outcomes: a half-open probe
+            # cancelled by its deadline proved nothing — release the
+            # probe slot or the breaker wedges in fail-fast forever
+            # (no caller could ever record an outcome again)
+            if breaker is not None:
+                breaker.abort_probe()
+            raise
+        except retryable as exc:
+            if breaker is not None:
+                breaker.record_failure()
+            last = exc
+            if attempt + 1 >= pol.attempts:
+                break
+            delay = pol.delay_s(site, attempt + 1)
+            if deadline is not None:
+                remaining = deadline.remaining_s()
+                if remaining <= 0:
+                    break  # budget spent: no retry happens, count none
+                delay = min(delay, remaining)
+            _count_retry(site, exhausted=False)
+            time.sleep(delay)
+            continue
+        if breaker is not None:
+            breaker.record_success()
+        return result
+    _count_retry(site, exhausted=True)
+    assert last is not None
+    raise last
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with timed half-open probes.
+
+    closed → (``failure_threshold`` consecutive failures) → open →
+    (``reset_s`` cool-down) → half-open, ONE probe allowed → success
+    closes / failure re-opens.  ``allow()`` is the gate; callers report
+    outcomes through ``record_success``/``record_failure`` (or let
+    ``retry_call`` do it).  State is exported at scrape time as
+    ``pathway_robust_breaker_open{breaker=...}`` via the flight-recorder
+    provider registry — zero hot-path cost."""
+
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: Optional[int] = None,
+        reset_s: Optional[float] = None,
+    ):
+        env = os.environ
+        self.name = name
+        self.failure_threshold = int(
+            failure_threshold
+            if failure_threshold is not None
+            else env.get("PATHWAY_BREAKER_THRESHOLD", "5")
+        )
+        self.reset_s = float(
+            reset_s
+            if reset_s is not None
+            else env.get("PATHWAY_BREAKER_RESET_S", "30")
+        )
+        self._lock = threading.Lock()
+        self._failures = 0  # consecutive
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        self.stats = {"opens": 0, "fail_fast": 0}
+        observe.register_provider(self)
+
+    # -- state machine ------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if time.monotonic() - self._opened_at >= self.reset_s:
+            return "half_open"
+        return "open"
+
+    def allow(self) -> bool:
+        """True when a call may proceed (closed, or the single
+        half-open probe); False = fail fast."""
+        with self._lock:
+            state = self._state_locked()
+            if state == "closed":
+                return True
+            if state == "half_open" and not self._probing:
+                self._probing = True
+                return True
+            self.stats["fail_fast"] += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def abort_probe(self) -> None:
+        """Release the half-open probe slot WITHOUT recording an outcome
+        — for a probe call cancelled by policy (deadline) before the
+        model could prove anything.  Harmless when no probe is held; in
+        the rare race where another thread holds the probe this may
+        admit one extra probe, which is benign (a wedged breaker is
+        not)."""
+        with self._lock:
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._probing or (
+                self._opened_at is None
+                and self._failures >= self.failure_threshold
+            ):
+                # probe failed, or the consecutive-failure budget spent:
+                # (re)open and restart the cool-down clock
+                self._opened_at = time.monotonic()
+                self._probing = False
+                self.stats["opens"] += 1
+
+    def reset(self) -> None:
+        self.record_success()
+
+    # -- flight-recorder provider ------------------------------------------
+    def observe_metrics(self):
+        labels = {"breaker": self.name}
+        state = self.state
+        yield (
+            "gauge",
+            "pathway_robust_breaker_open",
+            labels,
+            {"closed": 0.0, "half_open": 0.5, "open": 1.0}[state],
+        )
+        yield (
+            "counter",
+            "pathway_robust_breaker_opens_total",
+            labels,
+            self.stats["opens"],
+        )
+        yield (
+            "counter",
+            "pathway_robust_breaker_fail_fast_total",
+            labels,
+            self.stats["fail_fast"],
+        )
+
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker(name: str, **kwargs: Any) -> CircuitBreaker:
+    """Process-wide breaker registry — one breaker per model/site name,
+    shared by every pipeline that scores through that model."""
+    with _breakers_lock:
+        b = _breakers.get(name)
+        if b is None:
+            b = _breakers[name] = CircuitBreaker(name, **kwargs)
+        return b

@@ -17,6 +17,23 @@ from ...internals.expression import ApplyExpression
 from ...internals.schema import Schema, column_definition
 from ...internals.table import Table
 from ...internals.thisclass import this
+from ...robust import (
+    EXTRACTIVE_ANSWER,
+    RERANK_SKIPPED,
+    RetryPolicy,
+    breaker as robust_breaker,
+    extractive_answer,
+    inject,
+    log_once,
+    record_degraded,
+    retry_call,
+)
+
+# the wrapped reranker's predict() owns its own dispatch retries when it
+# is a CrossEncoderModel (the "cross_encoder.dispatch" site): one outer
+# attempt keeps the "qa.rerank" breaker gate + fault site without
+# multiplying attempt budgets or triple-counting breaker failures
+_QA_RERANK_RETRY = RetryPolicy(attempts=1)
 from .document_store import DocumentStore
 from .prompts import prompt_qa, prompt_qa_geometric_rag, prompt_summarize
 
@@ -188,25 +205,61 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
             if reranker is not None
             else search_topk
         )
+        # per-model circuit breakers (robust/retry.py), shared process-
+        # wide: the "cross_encoder" breaker is the same one the fused
+        # RetrieveRerankPipeline feeds, so a reranker persistently down
+        # under EITHER surface fast-paths both to the rerank_skipped
+        # rung; the "generator" breaker gates the LLM chat calls
+        self._rerank_breaker = robust_breaker("cross_encoder")
+        self._llm_breaker = robust_breaker("generator")
         self.server = None
 
     def _rerank_docs(
-        self, question: str, docs: list, keep: Optional[int] = None
+        self,
+        question: str,
+        docs: list,
+        keep: Optional[int] = None,
+        flags: Optional[list] = None,
     ) -> list:
         """Reorder retrieved doc dicts by cross-encoder pair score and keep
         the best ``keep`` (default ``search_topk``); no-op without a
-        reranker."""
+        reranker.
+
+        Degradation ladder: a reranker failure (after its retry budget,
+        or an open circuit) serves the RETRIEVAL ordering instead —
+        flagged through ``flags``, counted on
+        ``pathway_serve_degraded_total{reason="rerank_skipped"}`` — and
+        never sinks the answer."""
         if self._rerank_model is None or not docs:
             return docs
         model = self._rerank_model
         pairs = [(question, str(d.get("text", ""))) for d in docs]
-        if self._rerank_packed is None:
-            scores = np.asarray(model.predict(pairs), dtype=np.float64)
-        else:
-            scores = np.asarray(
-                model.predict(pairs, packed=self._rerank_packed),
-                dtype=np.float64,
+        try:
+            if self._rerank_packed is None:
+                raw = retry_call(
+                    "qa.rerank", model.predict, pairs,
+                    policy=_QA_RERANK_RETRY,
+                    breaker=self._rerank_breaker,
+                )
+            else:
+                raw = retry_call(
+                    "qa.rerank", model.predict, pairs,
+                    packed=self._rerank_packed,
+                    policy=_QA_RERANK_RETRY,
+                    breaker=self._rerank_breaker,
+                )
+            scores = np.asarray(raw, dtype=np.float64)
+        except Exception as exc:
+            log_once(
+                f"qa.rerank:{type(exc).__name__}",
+                "QA reranker failed (%r); serving retrieval order flagged "
+                "rerank_skipped",
+                exc,
             )
+            record_degraded(RERANK_SKIPPED)
+            if flags is not None:
+                flags.append(RERANK_SKIPPED)
+            return docs[: keep or self.search_topk]
         order = np.argsort(-scores, kind="stable")[: keep or self.search_topk]
         out = []
         for j in order:
@@ -214,6 +267,35 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
             d["rerank_score"] = float(scores[int(j)])
             out.append(d)
         return out
+
+    def _chat_or_extract(
+        self, question: str, doc_texts: Sequence[str], chat, flags=None
+    ) -> str:
+        """Run ``chat()`` (the LLM call) under the "generator" circuit
+        breaker.  Generator down / circuit open ⇒ the ladder's last
+        answer-bearing rung: an extractive answer from the top retrieved
+        passages, flagged + counted — the QA surface keeps answering
+        with grounded text instead of erroring."""
+        b = self._llm_breaker
+        if b.allow():
+            try:
+                inject.fire("generator.chat")
+                response = chat()
+            except Exception as exc:
+                b.record_failure()
+                log_once(
+                    f"generator.chat:{type(exc).__name__}",
+                    "LLM chat failed (%r); answering extractively from the "
+                    "retrieved passages",
+                    exc,
+                )
+            else:
+                b.record_success()
+                return response
+        record_degraded(EXTRACTIVE_ANSWER)
+        if flags is not None:
+            flags.append(EXTRACTIVE_ANSWER)
+        return extractive_answer(question, list(doc_texts))
 
     # -- dataflow endpoints -------------------------------------------------
     def answer_query(self, queries: Table) -> Table:
@@ -230,13 +312,25 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         llm = self.llm
         template = self.prompt_template
         rerank = self._rerank_docs
+        chat_or_extract = self._chat_or_extract
 
         def answer(prompt, docs, return_docs):
-            docs = rerank(prompt, list(docs or []))
+            flags: list = []
+            docs = rerank(prompt, list(docs or []), flags=flags)
             doc_texts = [d["text"] for d in docs]
-            response = _call_chat(llm, template(prompt, doc_texts))
+            response = chat_or_extract(
+                prompt,
+                doc_texts,
+                lambda: _call_chat(llm, template(prompt, doc_texts)),
+                flags=flags,
+            )
             if return_docs:
-                return {"response": response, "context_docs": docs}
+                out = {"response": response, "context_docs": docs}
+                if flags:
+                    # ladder visibility: which degraded rungs served this
+                    # answer (rerank_skipped / extractive_answer)
+                    out["degraded"] = flags
+                return out
             return response
 
         combined = queries.select(
@@ -319,6 +413,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         llm = self.llm
         n0, factor, iters = self.n_starting_documents, self.factor, self.max_iterations
         rerank = self._rerank_docs
+        chat_or_extract = self._chat_or_extract
 
         def answer(prompt, docs):
             # rerank BEFORE the geometric loop: adaptive RAG answers from
@@ -327,8 +422,12 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
             # candidate list to grow into)
             docs = rerank(prompt, list(docs or []), keep=len(docs or []))
             doc_texts = [d["text"] for d in docs]
-            return answer_with_geometric_rag_strategy(
-                prompt, doc_texts, llm, n0, factor, iters
+            return chat_or_extract(
+                prompt,
+                doc_texts,
+                lambda: answer_with_geometric_rag_strategy(
+                    prompt, doc_texts, llm, n0, factor, iters
+                ),
             )
 
         combined = queries.select(

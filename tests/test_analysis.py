@@ -92,6 +92,66 @@ def test_lock_discipline_clean_when_work_moves_off_lock():
     assert _live(_run(good), "lock-discipline") == []
 
 
+def test_lock_discipline_sees_through_retry_wrapper():
+    """``retry_call("site", jitted_fn, ...)`` IS a dispatch (ISSUE 4:
+    wrapping a launch in the robust retry helper must not launder it out
+    of the lock-discipline rule) — and its result is a device value, so
+    a host coercion of it under the lock is still a sync."""
+    bad = """
+        import threading
+
+        import jax
+        import numpy as np
+
+        from pathway_tpu.robust import retry_call
+
+        @jax.jit
+        def _score(x):
+            return x * 2
+
+        class Index:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def search(self, q):
+                with self._lock:
+                    out = retry_call("ivf.dispatch", _score, q)
+                    host = np.asarray(out)
+                return host
+    """
+    found = _live(_run(bad), "lock-discipline")
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 2, messages
+    assert "jitted dispatch" in messages
+    assert "np.asarray" in messages
+
+
+def test_retry_wrapped_dispatch_clean_off_lock():
+    good = """
+        import threading
+
+        import jax
+        import numpy as np
+
+        from pathway_tpu.robust import retry_call
+
+        @jax.jit
+        def _score(x):
+            return x * 2
+
+        class Index:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def search(self, q):
+                with self._lock:
+                    snapshot = dict(self.state)
+                out = retry_call("ivf.dispatch", _score, q)
+                return np.asarray(out)
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
 def test_lock_discipline_ignores_closures_defined_under_lock():
     # a completion closure DEFINED under the lock runs later, off it
     good = """
@@ -297,6 +357,56 @@ def test_hidden_sync_budget_clean_when_recorded():
 
         def submit(q):
             out = _fused(q)
+            record_dispatch("serve")
+            def complete():
+                arr = np.asarray(out)
+                record_fetch("serve")
+                return arr
+            return complete
+    """)
+    assert _live(analyze_source(good, "fixtures/serve.py"), "hidden-sync") == []
+
+
+def test_hidden_sync_budget_crosscheck_sees_retry_wrapped_dispatch():
+    """A retry-wrapped launch still needs its record_dispatch, and its
+    result is a device value whose fetch needs record_fetch — the robust
+    wrapper must not launder the 2+2 budget accounting (ISSUE 4)."""
+    bad = _SERVE_HDR + textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+        from pathway_tpu.robust import retry_call
+
+        @jax.jit
+        def _fused(x):
+            return x
+
+        def submit(q):
+            out = retry_call("serve.dispatch", _fused, q)  # missing record_dispatch
+            def complete():
+                return np.asarray(out)  # missing record_fetch
+            return complete
+    """)
+    found = _live(analyze_source(bad, "fixtures/serve.py"), "hidden-sync")
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 2, messages
+    assert "record_dispatch" in messages
+    assert "record_fetch" in messages
+
+    good = _SERVE_HDR + textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+        from pathway_tpu.robust import retry_call
+
+        @jax.jit
+        def _fused(x):
+            return x
+
+        def submit(q):
+            out = retry_call("serve.dispatch", _fused, q)
             record_dispatch("serve")
             def complete():
                 arr = np.asarray(out)

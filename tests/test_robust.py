@@ -1,0 +1,822 @@
+"""Chaos suite for the serve-path fault-tolerance layer (ISSUE 4).
+
+Every named fault site in ``pathway_tpu/robust/inject.py``'s registry is
+armed in at least one test here — raise, delay-past-deadline, and hang —
+and each must produce either a successful retry or the documented
+degradation-ladder rung, with the ``pathway_serve_degraded_total``
+counter incremented.  NEVER an unhandled exception out of a serve call.
+
+Sites covered: serve.dispatch, serve.fetch, ivf.dispatch,
+ivf.tail_upload, ivf.absorb, ivf.retrain, rerank.dispatch,
+cross_encoder.dispatch, cross_encoder.fetch, encoder.dispatch,
+generator.dispatch, generator.chat, clip.dispatch, exchange.send,
+qa.rerank.
+
+Plus: Deadline / RetryPolicy / CircuitBreaker / ServeResult units,
+``PATHWAY_FAULTS`` parsing, the missing-doc response-metadata
+regression (retrieve_rerank.py ``_text_of``), and the happy-path
+2-dispatch + 2-fetch budget with the robust wrappers in place.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe, robust
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.ops import dispatch_counter
+from pathway_tpu.ops.ivf import IvfKnnIndex
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+from pathway_tpu.ops.serving import FusedEncodeSearch
+from pathway_tpu.robust import (
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    FaultInjected,
+    RetryPolicy,
+    ServeResult,
+    inject,
+    retry_call,
+)
+
+DOCS = {
+    i: f"document number {i} about {topic} case {i % 7} with live updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+            "mesh collectives", "tokenizer ingest", "serving latency",
+            "cross encoders", "top k selection", "packing rows",
+        ]
+        * 2
+    )
+}
+QUERIES = ["rag retrieval serving", "exactly once stream", "packing rows"]
+
+
+def _degraded(reason: str) -> int:
+    return observe.counter("pathway_serve_degraded_total", reason=reason).value
+
+
+@pytest.fixture(autouse=True)
+def _clean_robust_state():
+    """Disarm every fault and close the process-wide breakers around each
+    test — chaos must not leak into its neighbors."""
+    inject.disarm()
+    robust.breaker("cross_encoder").reset()
+    robust.breaker("generator").reset()
+    yield
+    inject.disarm()
+    robust.breaker("cross_encoder").reset()
+    robust.breaker("generator").reset()
+
+
+@pytest.fixture(scope="module")
+def stack():
+    enc = SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    ce = CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=64,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    index.add(sorted(DOCS), enc.encode([DOCS[i] for i in sorted(DOCS)]))
+    return enc, ce, index
+
+
+def _pipeline(stack, **kwargs):
+    enc, ce, index = stack
+    kwargs.setdefault(
+        "rerank_breaker",
+        CircuitBreaker("test-ce", failure_threshold=100, reset_s=60),
+    )
+    return RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=5, candidates=16,
+        **kwargs,
+    )
+
+
+# -- units: deadline ---------------------------------------------------------
+
+
+def test_deadline_basics():
+    d = Deadline.after_ms(100)
+    assert 0.0 < d.remaining_s() <= 0.1
+    assert not d.expired()
+    d.check("x")  # no raise
+    sub = d.sub_budget(0.5)
+    assert sub.remaining_s() <= d.remaining_s() + 1e-9
+    spent = Deadline(0.0)
+    assert spent.expired()
+    with pytest.raises(DeadlineExceeded) as exc:
+        spent.check("stage2_submit")
+    assert exc.value.stage == "stage2_submit"
+    # sub-budget of a spent deadline is itself spent, never extends
+    assert spent.sub_budget(0.9).expired()
+
+
+def test_deadline_from_env(monkeypatch):
+    monkeypatch.delenv("PATHWAY_SERVE_DEADLINE_MS", raising=False)
+    assert Deadline.from_env() is None
+    monkeypatch.setenv("PATHWAY_SERVE_DEADLINE_MS", "250")
+    d = Deadline.from_env()
+    assert d is not None and 0.0 < d.remaining_s() <= 0.25
+    monkeypatch.setenv("PATHWAY_SERVE_DEADLINE_MS", "0")
+    assert Deadline.from_env() is None
+
+
+# -- units: retry + breaker --------------------------------------------------
+
+
+def test_retry_backoff_is_deterministic_and_bounded():
+    pol = RetryPolicy(attempts=4, base_delay_s=0.01, max_delay_s=0.05, seed=3)
+    a = [pol.delay_s("site.x", i) for i in range(1, 4)]
+    b = [pol.delay_s("site.x", i) for i in range(1, 4)]
+    assert a == b, "jitter must be seeded-deterministic"
+    assert all(0.0 <= d <= 0.05 for d in a)
+    assert pol.delay_s("site.x", 1) != pol.delay_s("site.y", 1)
+
+
+def test_retry_call_retries_then_raises():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    pol = RetryPolicy(attempts=3, base_delay_s=0.001, max_delay_s=0.002)
+    assert retry_call("t.flaky", flaky, policy=pol) == "ok"
+    assert len(calls) == 3
+
+    def always():
+        raise RuntimeError("down")
+
+    with pytest.raises(RuntimeError, match="down"):
+        retry_call("t.always", always, policy=pol)
+
+
+def test_retry_call_honors_deadline():
+    spent = Deadline(0.0)
+    calls = []
+    with pytest.raises(DeadlineExceeded):
+        retry_call("t.dl", lambda: calls.append(1), deadline=spent)
+    assert calls == [], "no attempt once the budget is spent"
+
+
+def test_circuit_breaker_state_machine():
+    b = CircuitBreaker("t-b", failure_threshold=2, reset_s=0.05)
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    assert b.stats["opens"] == 1
+    time.sleep(0.06)
+    assert b.state == "half_open"
+    assert b.allow(), "half-open admits one probe"
+    assert not b.allow(), "...exactly one"
+    b.record_failure()  # probe failed: reopen + restart the timer
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_half_open_probe_cancelled_by_deadline_is_released():
+    """A half-open probe whose attempt dies on DeadlineExceeded proved
+    nothing about the model — the probe slot must be released, or the
+    breaker wedges in fail-fast forever (review finding)."""
+    b = CircuitBreaker("t-probe", failure_threshold=1, reset_s=0.03)
+    b.record_failure()
+    time.sleep(0.04)
+    assert b.state == "half_open"
+    with inject.armed("probe.site", "hang", hang_s=30):
+        with pytest.raises(DeadlineExceeded):
+            retry_call(
+                "probe.site", lambda: "ok",
+                deadline=Deadline.after_ms(40), breaker=b,
+            )
+    assert b.state == "half_open"
+    assert b.allow(), "probe slot must be free again after the abort"
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_feeds_metrics_surface():
+    b = CircuitBreaker("t-metrics", failure_threshold=1, reset_s=60)
+    b.record_failure()
+    samples = {name: value for _k, name, _l, value in b.observe_metrics()}
+    assert samples["pathway_robust_breaker_open"] == 1.0
+    assert samples["pathway_robust_breaker_opens_total"] == 1
+
+
+# -- units: fault injection --------------------------------------------------
+
+
+def test_inject_env_syntax_and_budget():
+    armed = inject.load_env("a.b=raise:times=2;c.d=delay:ms=1")
+    assert armed == ["a.b", "c.d"]
+    with pytest.raises(FaultInjected):
+        inject.fire("a.b")
+    with pytest.raises(FaultInjected):
+        inject.fire("a.b")
+    inject.fire("a.b")  # times budget spent: disarmed in effect
+    t0 = time.monotonic()
+    inject.fire("c.d")  # delay, not raise
+    assert time.monotonic() - t0 >= 0.0005
+    inject.disarm()
+    inject.fire("a.b")  # disarmed: no-op
+
+
+def test_inject_probability_is_seeded_deterministic():
+    def run() -> int:
+        inject.arm("p.site", "raise", p=0.3, seed=11)
+        fired = 0
+        for _ in range(200):
+            try:
+                inject.fire("p.site")
+            except FaultInjected:
+                fired += 1
+        inject.disarm("p.site")
+        return fired
+
+    first, second = run(), run()
+    assert first == second, "seeded probability must replay identically"
+    assert 30 < first < 90, f"~30% of 200, got {first}"
+
+
+def test_inject_hang_released_by_deadline():
+    with inject.armed("h.site", "hang", hang_s=30):
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            inject.fire("h.site", deadline=Deadline.after_ms(60))
+        assert time.monotonic() - t0 < 5.0
+
+
+def test_inject_hang_released_by_disarm():
+    inject.arm("h2.site", "hang", hang_s=30)
+    released = []
+
+    def hang():
+        inject.fire("h2.site")
+        released.append(True)
+
+    t = threading.Thread(target=hang)
+    t.start()
+    time.sleep(0.05)
+    inject.disarm("h2.site")
+    t.join(5)
+    assert released == [True]
+
+
+def test_serve_result_is_a_list_with_flags():
+    r = ServeResult([[(1, 0.5)]], degraded=("rerank_skipped",))
+    assert r == [[(1, 0.5)]]
+    assert not r.ok and r.degraded == ("rerank_skipped",)
+    r2 = r.with_flags(("tail_skipped", "rerank_skipped"), {"missing_docs": (7,)})
+    assert r2.degraded == ("rerank_skipped", "tail_skipped")
+    assert r2.meta["missing_docs"] == (7,)
+    assert ServeResult().ok
+
+
+# -- chaos: stage 1 (serving.py) --------------------------------------------
+
+
+def test_exact_dispatch_transient_failure_retries(stack):
+    pipe = _pipeline(stack)
+    clean = pipe(QUERIES)
+    retries = observe.counter(
+        "pathway_robust_retries_total", site="serve.dispatch"
+    ).value
+    with inject.armed("serve.dispatch", "raise", times=1):
+        got = pipe(QUERIES)
+    assert got == clean
+    assert got.ok, got.degraded
+    assert (
+        observe.counter(
+            "pathway_robust_retries_total", site="serve.dispatch"
+        ).value
+        > retries
+    )
+
+
+def test_stage1_persistent_dispatch_failure_degrades(stack):
+    pipe = _pipeline(stack)
+    pipe(QUERIES)  # warm
+    before = _degraded("retrieval_failed")
+    with inject.armed("serve.dispatch", "raise"):
+        got = pipe(QUERIES)  # must NOT raise
+    assert got == [[], [], []]
+    assert "retrieval_failed" in got.degraded
+    assert _degraded("retrieval_failed") == before + 1
+
+
+def test_stage1_fetch_failure_degrades(stack):
+    pipe = _pipeline(stack)
+    pipe(QUERIES)
+    before = _degraded("retrieval_failed")
+    with inject.armed("serve.fetch", "raise"):
+        got = pipe(QUERIES)
+    assert got == [[], [], []]
+    assert "retrieval_failed" in got.degraded
+    assert _degraded("retrieval_failed") == before + 1
+
+
+# -- chaos: stage 2 (retrieve_rerank.py) -------------------------------------
+
+
+def _stage1_reference(pipe, queries):
+    hits = pipe.retriever(queries, pipe.candidates)
+    return [list(row[: pipe.k]) for row in hits]
+
+
+def test_rerank_dispatch_failure_serves_stage1_scores(stack):
+    pipe = _pipeline(stack)
+    pipe(QUERIES)  # warm both stages
+    want = _stage1_reference(pipe, QUERIES)
+    before = _degraded("rerank_skipped")
+    with inject.armed("rerank.dispatch", "raise"):
+        got = pipe(QUERIES)
+    assert "rerank_skipped" in got.degraded
+    assert got == want, "degraded serve must be the stage-1 ranking"
+    assert _degraded("rerank_skipped") == before + 1
+
+
+def test_rerank_circuit_open_fast_paths_to_stage1(stack):
+    b = CircuitBreaker("test-ce-open", failure_threshold=1, reset_s=60)
+    pipe = _pipeline(stack, rerank_breaker=b)
+    pipe(QUERIES)  # warm
+    with inject.armed("rerank.dispatch", "raise"):
+        got = pipe(QUERIES)
+    assert "rerank_skipped" in got.degraded
+    assert b.state == "open"
+    pairs_before = pipe.stats["stage2_pairs"]
+    got2 = pipe(QUERIES)  # fault disarmed, but the circuit is open
+    assert "rerank_skipped" in got2.degraded
+    assert pipe.stats["stage2_pairs"] == pairs_before, (
+        "open circuit must fail fast, not dispatch stage 2"
+    )
+    assert got2 == _stage1_reference(pipe, QUERIES)
+
+
+def test_rerank_fetch_hang_bounded_by_deadline(stack):
+    pipe = _pipeline(stack)
+    pipe(QUERIES)  # warm: no compiles inside the timed serve
+    before = _degraded("rerank_skipped")
+    with inject.armed("cross_encoder.fetch", "hang", hang_s=30):
+        t0 = time.monotonic()
+        got = pipe(QUERIES, deadline=Deadline.after_ms(400))
+        wall = time.monotonic() - t0
+    assert "rerank_skipped" in got.degraded
+    assert got == _stage1_reference(pipe, QUERIES)
+    assert wall < 5.0, f"hang must be bounded by the deadline, took {wall}s"
+    assert _degraded("rerank_skipped") == before + 1
+
+
+def test_rerank_fetch_delay_past_deadline_falls_back(stack):
+    pipe = _pipeline(stack)
+    pipe(QUERIES)
+    with inject.armed("cross_encoder.fetch", "delay", delay_s=1.0):
+        got = pipe(QUERIES, deadline=Deadline.after_ms(200))
+    assert "rerank_skipped" in got.degraded
+    assert got == _stage1_reference(pipe, QUERIES)
+
+
+def test_deadline_spent_before_stage2_serves_stage1(stack):
+    pipe = _pipeline(stack)
+    pipe(QUERIES)  # warm
+    handle = pipe.submit(QUERIES, deadline=Deadline.after_ms(250))
+    time.sleep(0.3)  # budget gone between submit and completion
+    got = handle()
+    assert "rerank_skipped" in got.degraded
+    assert got == _stage1_reference(pipe, QUERIES)
+
+
+def test_cross_encoder_model_sites(stack):
+    _, ce, _ = stack
+    pairs = [(q, DOCS[i]) for q in QUERIES for i in (0, 3, 9)]
+    clean = ce.predict(pairs)
+    with inject.armed("cross_encoder.dispatch", "raise", times=1):
+        got = ce.predict(pairs)  # transient: retried inside submit
+    np.testing.assert_allclose(got, clean, rtol=1e-6)
+    done = ce.submit(pairs, deadline=Deadline.after_ms(30_000))
+    np.testing.assert_allclose(done(), clean, rtol=1e-6)
+    with inject.armed("cross_encoder.fetch", "raise"):
+        with pytest.raises(FaultInjected):
+            ce.submit(pairs)()  # model-level: the PIPELINE owns the ladder
+
+
+# -- chaos: IVF (ivf.py) -----------------------------------------------------
+
+
+def test_ivf_dispatch_transient_failure_retries(stack):
+    enc, ce, _ = stack
+    ivf = IvfKnnIndex(dimension=32, metric="cos", n_clusters=8, n_probe=8)
+    keys = sorted(DOCS)
+    ivf.add(keys, enc.encode([DOCS[i] for i in keys]))
+    ivf.build()
+    serve = FusedEncodeSearch(enc, ivf, k=5)
+    clean = serve(QUERIES)
+    with inject.armed("ivf.dispatch", "raise", times=1):
+        got = serve(QUERIES)
+    assert got == clean and got.ok
+
+
+def test_ivf_tail_upload_failure_serves_resident_only(stack):
+    enc, _, _ = stack
+    ivf = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=8, n_probe=8,
+        absorb_threshold=4096,
+    )
+    keys = sorted(DOCS)
+    vecs = enc.encode([DOCS[i] for i in keys])
+    ivf.add(keys[:24], vecs[:24])
+    ivf.build()
+    ivf.add(keys[24:], vecs[24:])  # rides the exact tail
+    serve = FusedEncodeSearch(enc, ivf, k=8)
+    clean = serve(QUERIES)
+    tail_keys = set(keys[24:])
+    assert any(k in tail_keys for row in clean for k, _ in row), (
+        "sanity: tail keys are retrievable when the tail is up"
+    )
+    before = _degraded("tail_skipped")
+    with ivf._lock:
+        ivf._tail_cache = None  # force a re-upload on the next serve
+    with inject.armed("ivf.tail_upload", "raise"):
+        got = serve(QUERIES)
+    assert "tail_skipped" in got.degraded
+    assert all(k not in tail_keys for row in got for k, _ in row), (
+        "resident-only serve must not hallucinate tail keys"
+    )
+    assert all(len(row) > 0 for row in got), "resident rows still served"
+    assert _degraded("tail_skipped") == before + 1
+    assert ivf.tail_degraded
+    # recovery is automatic: the failed upload was NOT cached
+    got2 = serve(QUERIES)
+    assert got2 == clean and got2.ok and not ivf.tail_degraded
+
+
+def test_ivf_absorb_failure_is_counted_and_retried(stack):
+    enc, _, _ = stack
+    ivf = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+        absorb_threshold=8,
+    )
+    keys = sorted(DOCS)
+    vecs = enc.encode([DOCS[i] for i in keys])
+    ivf.add(keys[:20], vecs[:20])
+    ivf.build()
+    inject.arm("ivf.absorb", "raise", times=1)  # first attempt fails
+    try:
+        ivf.add(keys[20:32], vecs[20:32])  # crosses the absorb threshold
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if ivf.stats["absorbs"] >= 1 and not ivf._absorbing:
+                break
+            time.sleep(0.05)
+    finally:
+        inject.disarm("ivf.absorb")
+    assert ivf.stats["absorbs"] >= 1, "retry after the injected failure"
+    assert ivf.stats["absorb_failures"] >= 1
+    samples = {
+        (name, labels.get("kind")): value
+        for kind_, name, labels, value in ivf.observe_metrics()
+        if name == "pathway_ivf_maintenance_failures_total"
+    }
+    assert samples[("pathway_ivf_maintenance_failures_total", "absorb")] >= 1
+
+
+def test_ivf_retrain_failure_is_counted_and_retried(stack):
+    enc, _, _ = stack
+    ivf = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+        rebuild_fraction=0.01, absorb_threshold=4096,
+    )
+    keys = sorted(DOCS)
+    vecs = enc.encode([DOCS[i] for i in keys])
+    ivf.add(keys[:16], vecs[:16])
+    ivf.build()
+    inject.arm("ivf.retrain", "raise", times=1)
+    try:
+        # growth must clear _needs_rebuild's 64-row floor to kick the
+        # background retrain
+        rng = np.random.default_rng(0)
+        extra = rng.normal(size=(80, 32)).astype(np.float32)
+        ivf.add([1000 + i for i in range(80)], extra)
+        ivf.maybe_retrain_async()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if ivf.stats["retrains"] >= 1 and not ivf._retraining:
+                break
+            time.sleep(0.05)
+    finally:
+        inject.disarm("ivf.retrain")
+    assert ivf.stats["retrains"] >= 1, "retry after the injected failure"
+    assert ivf.stats["retrain_failures"] >= 1
+
+
+# -- chaos: models -----------------------------------------------------------
+
+
+def test_encoder_dispatch_transient_failure_retries(stack):
+    enc, _, _ = stack
+    clean = enc.encode(QUERIES)
+    with inject.armed("encoder.dispatch", "raise", times=1):
+        got = enc.encode(QUERIES)
+    np.testing.assert_allclose(got, clean, rtol=1e-6)
+
+
+def test_generator_dispatch_transient_failure_retries():
+    from pathway_tpu.models.generator import TextGenerator
+
+    gen = TextGenerator(
+        dimension=32, n_layers=1, n_heads=4, max_length=32, vocab_size=512,
+    )
+    clean = gen.generate(["hello world"], max_new_tokens=4)
+    with inject.armed("generator.dispatch", "raise", times=1):
+        got = gen.generate(["hello world"], max_new_tokens=4)
+    assert got == clean
+
+
+def test_clip_dispatch_transient_failure_retries():
+    from pathway_tpu.models.clip import ClipModel
+
+    clip = ClipModel(
+        dimension=32, n_layers=1, n_heads=4, max_length=16,
+        vocab_size=512, image_size=32, patch=16, proj_dim=16,
+    )
+    clean = clip.encode_text(["a slide about latency"])
+    with inject.armed("clip.dispatch", "raise", times=1):
+        got = clip.encode_text(["a slide about latency"])
+    np.testing.assert_allclose(got, clean, rtol=1e-6)
+
+
+# -- chaos: exchange plane ---------------------------------------------------
+
+
+class _FakeKV:
+    def __init__(self):
+        self._kv = {}
+        self._cv = threading.Condition()
+
+    def set(self, key, value):
+        with self._cv:
+            self._kv[key] = value
+            self._cv.notify_all()
+
+    def get(self, key, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - time.monotonic()
+                assert left > 0, f"KV rendezvous timed out waiting for {key}"
+                self._cv.wait(timeout=left)
+            return self._kv[key]
+
+
+def _mesh(monkeypatch, namespace):
+    from pathway_tpu.parallel.exchange import ExchangePlane
+
+    monkeypatch.setenv("PATHWAY_EXCHANGE_HEARTBEAT", "0.2")
+    monkeypatch.setenv("PATHWAY_EXCHANGE_HEARTBEAT_TIMEOUT", "2.0")
+    kv = _FakeKV()
+    planes, errs = {}, []
+
+    def build(rank):
+        try:
+            planes[rank] = ExchangePlane(
+                rank, 2, kv.set, kv.get, namespace=namespace
+            )
+        except BaseException as exc:  # pragma: no cover - surface in main
+            errs.append(exc)
+
+    t0 = threading.Thread(target=build, args=(0,))
+    t1 = threading.Thread(target=build, args=(1,))
+    t0.start(); t1.start(); t0.join(30); t1.join(30)
+    assert not errs and 0 in planes and 1 in planes
+    return planes
+
+
+def test_exchange_send_transient_failure_retries(monkeypatch):
+    planes = _mesh(monkeypatch, "robust-send")
+    try:
+        with inject.armed("exchange.send", "raise", times=1):
+            got = [None, None]
+
+            def side0():
+                got[0] = planes[0].all_to_all("e", 0, ["a0", "a1"], timeout=30)
+
+            t = threading.Thread(target=side0)
+            t.start()
+            got[1] = planes[1].all_to_all("e", 0, ["b0", "b1"], timeout=30)
+            t.join(30)
+        assert got[0] == ["a0", "b0"] and got[1] == ["a1", "b1"]
+        assert planes[0]._dead is None and planes[1]._dead is None
+    finally:
+        for p in planes.values():
+            p.close()
+
+
+def test_exchange_clean_shutdown_is_not_peer_lost(monkeypatch):
+    from pathway_tpu.parallel.exchange import PeerLost
+
+    planes = _mesh(monkeypatch, "robust-bye")
+    try:
+        planes[0].close()  # clean shutdown: sends __bye__ first
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if 0 in planes[1]._peer_closed:
+                break
+            time.sleep(0.02)
+        assert 0 in planes[1]._peer_closed, "bye frame must arrive"
+        # the disconnect after a bye must NOT poison the plane...
+        time.sleep(0.3)
+        assert planes[1]._dead is None, planes[1]._dead
+        # ...but a collective still waiting on the departed peer fails
+        # immediately with a clean-shutdown message, not a stall
+        with pytest.raises(PeerLost, match="closed cleanly"):
+            planes[1].gather("after-bye", 0, None, root=1, timeout=30)
+        # liveness export reflects the departure
+        ups = {
+            labels["peer"]: value
+            for kind, name, labels, value in planes[1].observe_metrics()
+            if name == "pathway_exchange_peer_up"
+        }
+        assert ups["0"] == 0
+    finally:
+        for p in planes.values():
+            p.close()
+
+
+# -- chaos: QA layer ---------------------------------------------------------
+
+
+class _RaisingLlm:
+    batched = False
+
+    @staticmethod
+    def func(messages):
+        raise RuntimeError("generator down")
+
+
+class _RaisingReranker:
+    def predict(self, pairs, packed=None):
+        raise RuntimeError("cross-encoder down")
+
+
+def _qa(llm=None, reranker=None):
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+    )
+
+    qa = BaseRAGQuestionAnswerer(
+        llm if llm is not None else _RaisingLlm(),
+        indexer=object(),
+        reranker=reranker,
+    )
+    # isolate breakers from the process-wide singletons
+    qa._llm_breaker = CircuitBreaker("test-gen", failure_threshold=2, reset_s=60)
+    qa._rerank_breaker = CircuitBreaker("test-qa-ce", failure_threshold=100, reset_s=60)
+    return qa
+
+
+def test_generator_down_answers_extractively():
+    qa = _qa()
+    docs = [
+        "Stream joins need low latency. Windows close on ticks.",
+        "Nothing relevant in this one at all!",
+    ]
+    before = _degraded("extractive_answer")
+    flags: list = []
+    answer = qa._chat_or_extract(
+        "stream joins latency", docs,
+        lambda: (_ for _ in ()).throw(RuntimeError("llm down")),
+        flags=flags,
+    )
+    assert "Stream joins" in answer
+    assert flags == ["extractive_answer"]
+    assert _degraded("extractive_answer") == before + 1
+    # second failure opens the breaker; the third call never invokes chat
+    qa._chat_or_extract("q", docs, lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    calls: list = []
+    answer3 = qa._chat_or_extract("stream joins", docs, lambda: calls.append(1))
+    assert calls == [], "open circuit must not call the generator"
+    assert "Stream joins" in answer3
+
+
+def test_generator_chat_fault_site_triggers_extractive_rung():
+    qa = _qa()
+    docs = ["Serving latency is budgeted per stage."]
+    with inject.armed("generator.chat", "raise"):
+        answer = qa._chat_or_extract("serving latency", docs, lambda: "llm says")
+    assert answer != "llm says" and "latency" in answer
+
+
+def test_qa_rerank_failure_keeps_retrieval_order():
+    qa = _qa(reranker=_RaisingReranker())
+    docs = [{"text": f"doc {i}"} for i in range(8)]
+    before = _degraded("rerank_skipped")
+    flags: list = []
+    out = qa._rerank_docs("a question", docs, flags=flags)
+    assert out == docs[: qa.search_topk], "retrieval order, truncated"
+    assert flags == ["rerank_skipped"]
+    assert _degraded("rerank_skipped") == before + 1
+    assert all("rerank_score" not in d for d in out)
+
+
+def test_extractive_answer_prefers_overlapping_sentences():
+    text = robust.extractive_answer(
+        "window aggregation latency",
+        [
+            "Commit ticks drive progress. Window aggregation has low latency.",
+            "Key ownership is sharded.",
+        ],
+    )
+    assert "Window aggregation" in text
+    # no overlap at all: still grounded in the top passage
+    fallback = robust.extractive_answer("zzz qqq", ["First sentence. Second."])
+    assert fallback == "First sentence."
+
+
+# -- regression: missing doc text (retrieve_rerank.py _text_of) --------------
+
+
+def test_missing_doc_visible_in_response_metadata(stack):
+    enc, ce, index = stack
+    partial = {k: v for k, v in DOCS.items() if k % 3 != 0}  # evict a third
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, partial, k=5, candidates=16,
+        rerank_breaker=CircuitBreaker("test-ce-miss", failure_threshold=100, reset_s=60),
+    )
+    got = pipe(QUERIES)
+    assert all(len(row) == 5 for row in got), "one evicted doc must not sink the serve"
+    assert got.ok, "missing text degrades quality, not availability"
+    missing = got.meta.get("missing_docs", ())
+    assert missing and all(k % 3 == 0 for k in missing)
+    # callable doc_text raising LookupError behaves identically
+    def doc_text(key):
+        if key % 3 == 0:
+            raise KeyError(key)
+        return DOCS[key]
+
+    pipe2 = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, doc_text, k=5, candidates=16,
+        rerank_breaker=CircuitBreaker("test-ce-miss2", failure_threshold=100, reset_s=60),
+    )
+    got2 = pipe2(QUERIES)
+    assert got2.meta.get("missing_docs", ()) == missing
+
+
+# -- happy path: budget + surface -------------------------------------------
+
+
+def test_happy_path_budget_holds_with_robust_wrappers(stack):
+    """The fault-tolerance layer must cost ZERO extra round trips: a
+    steady-state serve with a live deadline still issues at most 2
+    dispatches + 2 fetches, and is not degraded."""
+    pipe = _pipeline(stack, deadline_ms=30_000)
+    pipe(QUERIES)  # warmup compiles both stages
+    with dispatch_counter.DispatchCounter() as counter:
+        got = pipe(QUERIES)
+    assert got and all(got) and got.ok
+    assert counter.dispatches <= 2, counter.events
+    assert counter.fetches <= 2, counter.events
+
+
+def test_degraded_counter_renders_on_metrics_surface(stack):
+    pipe = _pipeline(stack)
+    pipe(QUERIES)
+    with inject.armed("rerank.dispatch", "raise"):
+        pipe(QUERIES)
+    text = "\n".join(observe.render_prometheus())
+    assert 'pathway_serve_degraded_total{reason="rerank_skipped"}' in text
+    assert "pathway_robust_faults_fired_total" in text
+
+
+def test_robust_package_is_analyzer_clean():
+    import os
+
+    from pathway_tpu.analysis import analyze_paths
+
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pathway_tpu",
+        "robust",
+    )
+    live = [f for f in analyze_paths([root]) if not f.suppressed]
+    assert live == [], "\n".join(f.format() for f in live)
