@@ -37,6 +37,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -607,6 +608,167 @@ def phase_fault_tolerance(backend: str, extras: dict) -> float:
     extras["dispatch_retries"] = int(retries)
     overhead_pct = (p50_fault - p50_clean) / max(p50_clean, 1e-9) * 100.0
     return round(overhead_pct, 3)
+
+
+def phase_concurrent_serve(backend: str, extras: dict) -> float:
+    """Continuous cross-request batching (pathway_tpu/serve/scheduler.py):
+    the SAME steady-state retrieve→rerank stack driven by concurrent
+    single-query callers at concurrency {1, 4, 16}, scheduler OFF
+    (each caller pays its own 2+2 serve, serializing on the pipeline)
+    vs scheduler ON (callers coalesce into shared bucketed batches with
+    double-buffered stage pipelining + in-window dedup).  The workload
+    has a hot query head (~1/3 of requests hit 4 hot queries — the
+    serving-traffic shape dedup exists for).  Reports QPS and p50/p99
+    per cell plus coalesce occupancy and dedup rate; the phase value is
+    the QPS speedup at concurrency 16 (acceptance bar: >= 2x, with
+    p99_on within 1.5x of the solo p50 on RTT-bound hardware)."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu.ops import dispatch_counter
+    from pathway_tpu.serve import ServeScheduler
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_CS_DOCS", "20000" if on_tpu else "1000"))
+    k, candidates = 10, 32
+    pipe, _cross, docs, _queries = _build_rr_pipeline(
+        n_docs, 16, k, candidates, small=not on_tpu
+    )
+
+    # short queries against long docs (the serving shape: questions are a
+    # few words, passages are paragraphs) — uniform tokenized length, so
+    # the stage-1 compile shapes are the handful the warmup covers
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(64)
+    ]
+    hot = pool[:4]
+    hot_every = int(os.environ.get("BENCH_CS_HOT_EVERY", "2"))
+
+    def workload(n: int):
+        # deterministic hot-head mix: every ``hot_every``-th request hits
+        # one of 4 hot queries (zipf-ish serving traffic — what in-window
+        # dedup exists for)
+        return [
+            hot[i % len(hot)]
+            if i % hot_every == 0
+            else pool[(i * 7) % len(pool)]
+            for i in range(n)
+        ]
+
+    # warm the compile shapes both arms touch: every pool query solo
+    # (the scheduler-off arm serves B=1 batches) and coalesced batch
+    # compositions at every unique-count the scheduler can form (stage-2
+    # row/segment buckets shift with composition; an in-measurement
+    # compile would charge ~seconds to one arm's p99)
+    for q in pool:
+        pipe([q], k)
+    for b in range(2, 17):
+        pipe(sorted(set(workload(3 * b)))[:b], k)
+
+    window_us = float(os.environ.get("BENCH_CS_WINDOW_US", "5000"))
+    # bucket-aligned cap on UNIQUE queries per device batch: on CPU the
+    # device compute scales with the padded bucket, so a small full
+    # bucket beats a large half-empty one; on TPU (RTT-bound) bigger
+    # batches amortize the round trip further
+    cs_max_batch = int(
+        os.environ.get("BENCH_CS_MAX_BATCH", "16" if on_tpu else "4")
+    )
+
+    def drive(conc: int, scheduler_on: bool):
+        n_req = int(
+            os.environ.get("BENCH_CS_REQUESTS", str(max(32, conc * 12)))
+        )
+        reqs = workload(n_req)
+        lats: list = [None] * n_req
+        errors: list = []
+        sched = (
+            ServeScheduler(pipe, window_us=window_us, max_batch=cs_max_batch)
+            if scheduler_on
+            else None
+        )
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    t0 = time.perf_counter()
+                    if sched is not None:
+                        rows = sched.serve([reqs[i]], k)
+                    else:
+                        rows = pipe([reqs[i]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    assert rows and rows[0]
+            except Exception as exc:  # surfaces in the cell's stats
+                errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        t_all = time.perf_counter()
+        with dispatch_counter.DispatchCounter(max_events=16) as counter:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        elapsed = time.perf_counter() - t_all
+        stats = dict(sched.stats) if sched is not None else {}
+        if sched is not None:
+            sched.stop()
+        if errors:
+            raise RuntimeError(f"concurrent_serve c{conc} failed: {errors[:3]}")
+        done = np.asarray([l for l in lats if l is not None])
+        # device round trips per request: the hardware-independent number
+        # behind the speedup — on a tunneled TPU every dispatch/fetch
+        # pair is a ~70 ms wire RTT, so this ratio IS the ceiling
+        stats["round_trips_per_request"] = round(
+            (counter.dispatches + counter.fetches) / (2 * n_req), 3
+        )
+        return n_req / elapsed, done, stats
+
+    speedup_c16 = 0.0
+    solo_p50 = None
+    for conc in (1, 4, 16):
+        qps = {}
+        for mode in (False, True):
+            tag = "on" if mode else "off"
+            # unmeasured pre-pass: the scheduler's batch compositions are
+            # timing-dependent, so their stage-2 compile shapes can only
+            # be warmed by actually running the arm once — a mid-
+            # measurement compile would charge ~seconds to one p99
+            drive(conc, mode)
+            qps[tag], lat, stats = drive(conc, mode)
+            extras[f"qps_{tag}_c{conc}"] = round(qps[tag], 2)
+            extras[f"p50_{tag}_c{conc}_ms"] = round(float(np.percentile(lat, 50)), 3)
+            extras[f"p99_{tag}_c{conc}_ms"] = round(float(np.percentile(lat, 99)), 3)
+            extras[f"rtt_per_request_{tag}_c{conc}"] = stats.get(
+                "round_trips_per_request"
+            )
+            if mode and stats.get("batches"):
+                extras[f"coalesce_occupancy_c{conc}"] = round(
+                    stats["items"] / stats["batches"], 2
+                )
+                extras[f"dedup_rate_c{conc}"] = round(
+                    stats["dedup_hits"] / max(stats["items"], 1), 3
+                )
+        if conc == 1:
+            solo_p50 = extras["p50_off_c1_ms"]
+        if conc == 16:
+            speedup_c16 = qps["on"] / max(qps["off"], 1e-9)
+            extras["serve_coalesce_speedup_c16"] = round(speedup_c16, 3)
+            extras["rtt_reduction_c16"] = round(
+                extras["rtt_per_request_off_c16"]
+                / max(extras["rtt_per_request_on_c16"], 1e-9), 2
+            )
+            if solo_p50:
+                # the acceptance bar's latency arm: coalesced p99 vs the
+                # uncontended solo p50
+                extras["p99_on_c16_vs_solo_p50"] = round(
+                    extras["p99_on_c16_ms"] / solo_p50, 3
+                )
+    extras["coalesce_window_us"] = window_us
+    return round(speedup_c16, 3)
 
 
 _PEAK_BF16_FLOPS = {
@@ -1278,6 +1440,7 @@ _PHASES = {
     "retrieve_rerank": (phase_retrieve_rerank, 900),
     "observe_overhead": (phase_observe_overhead, 450),
     "fault_tolerance": (phase_fault_tolerance, 450),
+    "concurrent_serve": (phase_concurrent_serve, 600),
     "ingest": (phase_ingest, 900),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
@@ -1431,6 +1594,7 @@ def main() -> None:
         ("retrieve_rerank", lambda: device_phase("retrieve_rerank")),
         ("observe_overhead", lambda: device_phase("observe_overhead")),
         ("fault_tolerance", lambda: device_phase("fault_tolerance")),
+        ("concurrent_serve", lambda: device_phase("concurrent_serve")),
         ("ingest", lambda: device_phase("ingest")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)
@@ -1452,6 +1616,8 @@ def main() -> None:
             extras["observe_overhead_pct"] = round(value, 3)
         elif name == "fault_tolerance" and value is not None:
             extras["fault_overhead_pct"] = round(value, 3)
+        elif name == "concurrent_serve" and value is not None:
+            extras["serve_coalesce_speedup_c16"] = round(value, 3)
         elif name == "ingest" and value is not None:
             extras["ingest_docs_per_sec"] = round(value, 1)
         elif name == "wordcount" and value is not None:

@@ -80,6 +80,7 @@ from . import persistence  # noqa: E402
 from .persistence import PersistenceMode  # noqa: E402
 from . import parallel  # noqa: E402
 from . import robust  # noqa: E402
+from . import serve  # noqa: E402
 from . import stdlib  # noqa: E402
 from .stdlib import (  # noqa: E402
     graphs,

@@ -20,7 +20,14 @@ they execute later, not under the lock):
 - ``np.asarray``/``np.array``/``float``/``int``/``.item()`` on a value
   produced by a jitted call — an implicit device→host sync;
 - ``pickle.dumps`` / ``pickle.loads`` / ``Pickler.dump`` /
-  ``Unpickler.load`` — one GIL-holding C call for the whole payload.
+  ``Unpickler.load`` — one GIL-holding C call for the whole payload;
+- completing a serve handle (``handle = <obj>.submit(...)`` then
+  ``handle()`` / ``handle.result()`` / ``handle.advance()``) — the
+  completion IS the host fetch.  The coalescing scheduler's
+  future-handoff contract (serve/scheduler.py) is dispatch on the
+  scheduler thread, fetch on the WAITER: blocking on a batch while
+  holding the admission lock would stall every admitter for a full
+  device round trip.
 
 Deliberate cases (e.g. a dispatch-only launch under the lock that
 snapshots device state consistently and never blocks on the result) are
@@ -38,8 +45,10 @@ from .registry import (
     dotted_name,
     is_device_value_arg,
     is_device_value_base,
+    is_handle_fetch,
     is_jit_call,
     is_lock_context,
+    scope_handle_vars,
     scope_jit_and_device_vars,
     walk_scope,
 )
@@ -66,36 +75,37 @@ class LockDisciplineRule(Rule):
     )
 
     def run(self, ctx: ModuleContext) -> None:
-        # map each function scope to its (jit callables, device vars),
-        # inheriting through closures so `with` bodies resolve names bound
-        # by the enclosing function
+        # map each function scope to its (jit callables, device vars,
+        # serve handles), inheriting through closures so `with` bodies
+        # resolve names bound by the enclosing function
         scope_envs = {}
 
-        def visit_scope(scope, inherited_fns, inherited_vars):
+        def visit_scope(scope, inherited_fns, inherited_vars, inherited_handles):
             fns, dvars = scope_jit_and_device_vars(
                 scope, ctx.jit_names, inherited_fns, inherited_vars
             )
-            scope_envs[scope] = (fns, dvars)
+            handles = scope_handle_vars(scope, inherited_handles)
+            scope_envs[scope] = (fns, dvars, handles)
             # walk_scope stops at nested defs; recurse into them explicitly
             # so closures inherit the enclosing scope's environment
             for child in ast.iter_child_nodes(scope):
-                self._recurse_defs(child, fns, dvars, visit_scope)
+                self._recurse_defs(child, fns, dvars, handles, visit_scope)
 
-        visit_scope(ctx.tree, None, None)
+        visit_scope(ctx.tree, None, None, None)
 
-        for scope, (jit_fns, device_vars) in scope_envs.items():
+        for scope, (jit_fns, device_vars, handles) in scope_envs.items():
             for node in walk_scope(scope):
                 if isinstance(node, ast.With) and is_lock_context(node):
-                    self._check_lock_body(ctx, node, jit_fns, device_vars)
+                    self._check_lock_body(ctx, node, jit_fns, device_vars, handles)
 
-    def _recurse_defs(self, node, fns, dvars, visit_scope) -> None:
+    def _recurse_defs(self, node, fns, dvars, handles, visit_scope) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            visit_scope(node, fns, dvars)
+            visit_scope(node, fns, dvars, handles)
             return
         if isinstance(node, (ast.Lambda,)):
             return
         for child in ast.iter_child_nodes(node):
-            self._recurse_defs(child, fns, dvars, visit_scope)
+            self._recurse_defs(child, fns, dvars, handles, visit_scope)
 
     def _check_lock_body(
         self,
@@ -103,6 +113,7 @@ class LockDisciplineRule(Rule):
         with_node: ast.With,
         jit_fns: Set[str],
         device_vars: Set[str],
+        handle_vars: Set[str],
     ) -> None:
         for node in walk_scope(with_node):
             if not isinstance(node, ast.Call):
@@ -151,3 +162,14 @@ class LockDisciplineRule(Rule):
                     "`.item()` on a jitted-call result under lock — "
                     "implicit device→host sync while holding the lock",
                 )
+            else:
+                handle = is_handle_fetch(node, handle_vars)
+                if handle is not None:
+                    ctx.report(
+                        self.name, node,
+                        f"serve handle `{handle}(...)` completed under lock "
+                        "— the completion is the host fetch; the "
+                        "future-handoff contract is dispatch on the "
+                        "scheduler thread, fetch on the WAITER off-lock "
+                        "(blocking here stalls every admitter)",
+                    )

@@ -19,6 +19,15 @@ answer directly:
    expression whose terminal identifier contains ``lock``/``mutex``/
    ``cv``/``cond`` (``self._lock``, ``index._lock``,
    ``self._send_locks[peer]``, condition variables).
+4. **Is this variable a serve completion handle?**  The serve stack's
+   submit/complete contract hands back a handle whose CALL performs the
+   host fetch (``handle = pipe.submit(...)`` then ``handle()`` /
+   ``handle.result()`` / ``handle.advance()``).  The coalescing
+   scheduler's future-handoff pattern (serve/scheduler.py) dispatches on
+   the scheduler thread and fetches on the WAITER — completing a handle
+   while holding a lock (e.g. the admission-queue lock) would stall
+   every admitter for a device round trip, so the lock-discipline rule
+   treats a handle completion under a lock as a violation.
 """
 
 from __future__ import annotations
@@ -30,7 +39,9 @@ from typing import Dict, Iterable, List, Optional, Set
 __all__ = [
     "collect_jit_names",
     "dotted_name",
+    "is_handle_fetch",
     "is_lock_context",
+    "scope_handle_vars",
     "scope_jit_and_device_vars",
     "walk_scope",
 ]
@@ -225,3 +236,63 @@ def is_device_value_base(call: ast.Call, device_vars: Set[str]) -> bool:
         return False
     base = dotted_name(call.func.value)
     return base is not None and base in device_vars
+
+
+# a serve completion handle comes back from the submit/complete contract:
+# ``handle = <obj>.submit(...)`` (FusedEncodeSearch, RetrieveRerankPipeline,
+# CrossEncoderModel, ServeScheduler all follow it).  Dotted only — a bare
+# ``submit(...)`` is some local helper, not the serving contract.
+_SUBMIT_LEAF_RE = re.compile(r"^submit$")
+# ...but ``executor.submit``/``pool.submit`` is the concurrent.futures
+# convention, not a serve handle: waiting on a thread-pool future under a
+# lock can be legitimate off the serve path, and flagging it with a
+# "serve handle" diagnostic would be a false positive with a misleading
+# message.  Receivers named like executors are excluded by convention.
+_EXECUTOR_RECEIVER_RE = re.compile(r"(pool|executor)s?$", re.IGNORECASE)
+# completing methods: ``handle()`` is the fetch itself; ``.result()`` is
+# the ticket/future spelling; ``.advance()`` completes stage 1 (a host
+# fetch) and dispatches stage 2
+_HANDLE_COMPLETE_ATTRS = ("result", "advance")
+
+
+def scope_handle_vars(
+    scope: ast.AST, inherited: Optional[Set[str]] = None
+) -> Set[str]:
+    """Local names holding serve completion handles — assigned from a
+    dotted ``<obj>.submit(...)`` call.  ``inherited`` seeds closures with
+    the enclosing scope's handles (a completion closure capturing one is
+    how the fetch legally escapes the dispatching scope)."""
+    handles: Set[str] = set(inherited or ())
+    for node in walk_scope(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        callee = dotted_name(value.func)
+        if (
+            callee is None
+            or "." not in callee
+            or not _SUBMIT_LEAF_RE.match(callee.rsplit(".", 1)[-1])
+        ):
+            continue
+        receiver = callee.rsplit(".", 2)[-2]
+        if _EXECUTOR_RECEIVER_RE.search(receiver):
+            continue  # concurrent.futures convention, not a serve handle
+        for tgt in node.targets:
+            handles.update(_target_names(tgt))
+    return handles
+
+
+def is_handle_fetch(call: ast.Call, handle_vars: Set[str]) -> Optional[str]:
+    """The spelled-out completion of a tracked handle: ``handle()``,
+    ``handle.result(...)``, or ``handle.advance()``.  Returns the dotted
+    spelling for the diagnostic, or None."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in handle_vars:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _HANDLE_COMPLETE_ATTRS:
+        base = dotted_name(func.value)
+        if base is not None and base in handle_vars:
+            return f"{base}.{func.attr}"
+    return None

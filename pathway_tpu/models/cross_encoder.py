@@ -163,18 +163,19 @@ class CrossEncoderModel:
         deadline: Optional[Deadline] = None,
     ):
         """One pair per padded row — the HF path and the parity reference
-        for the packed path.  The lock covers tokenization + the
-        compiled-fn cache only; the dispatch launches OFF it
+        for the packed path.  Tokenization runs OFF the lock (stateless
+        host prep: concurrent rerank callers overlap it); the lock covers
+        only the compiled-fn cache, and the dispatch launches OFF it too
         (lock-discipline: concurrent rerank callers must not serialize
         behind one thread's enqueue)."""
         from .encoder import _bucket
 
         n = len(pairs)
+        b = _bucket(n)
+        qs = [str(p[0]) for p in pairs] + [""] * (b - n)
+        ds = [str(p[1]) for p in pairs] + [""] * (b - n)
+        ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
         with self._lock:
-            b = _bucket(n)
-            qs = [str(p[0]) for p in pairs] + [""] * (b - n)
-            ds = [str(p[1]) for p in pairs] + [""] * (b - n)
-            ids, mask = self.tokenizer.encode_batch(qs, pairs=ds)
             fn = self._forward_fn(ids.shape)
         if self._hf:
             # BERT pair segments: tokens after the first [SEP] are type 1
@@ -266,20 +267,22 @@ class CrossEncoderModel:
     ):
         """Packed async scoring: pack, dispatch ONE forward over the packed
         rows, return a completion that gathers the per-pair scores back
-        into input order.  Pack + compiled-fn lookup run under the lock;
-        the dispatch launches OFF it (lock-discipline)."""
+        into input order.  Tokenize + pack run OFF the lock (stateless
+        host prep — concurrent rerank callers overlap it); the lock
+        covers only the compiled-fn cache, and the dispatch launches OFF
+        it too (lock-discipline)."""
         from .encoder import _bucket
         from .packing import pad_packed_rows, seg_bucket
 
         n = len(pairs)
+        ids, segments, positions, doc_slots, n_seg = self._pack_pairs(pairs)
+        rows_real = ids.shape[0]
+        Rb = _bucket(rows_real)
+        ids, segments, positions = pad_packed_rows(
+            ids, segments, positions, Rb
+        )
+        Sb = seg_bucket(n_seg)
         with self._lock:
-            ids, segments, positions, doc_slots, n_seg = self._pack_pairs(pairs)
-            rows_real = ids.shape[0]
-            Rb = _bucket(rows_real)
-            ids, segments, positions = pad_packed_rows(
-                ids, segments, positions, Rb
-            )
-            Sb = seg_bucket(n_seg)
             fn = self._packed_fn(Rb, ids.shape[1], Sb)
         out = retry_call(
             "cross_encoder.dispatch",

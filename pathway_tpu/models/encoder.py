@@ -165,14 +165,18 @@ class SentenceEncoder:
         """Batch encode with the result left in HBM ([B, d] jax array) —
         feed ``DeviceKnnIndex.add_from_device`` for device-to-device ingest
         with no host round trip (the SURVEY §7.6 pipeline shape)."""
+        texts = ["" if t is None else str(t) for t in texts]
+        n = len(texts)
+        if n == 0:
+            return jnp.zeros((0, self.config.d_model), jnp.float32)
+        # tokenize + pad OFF the lock: the tokenizer is stateless, so
+        # concurrent encoders overlap their host prep instead of
+        # serializing behind one thread's lock hold; the lock covers
+        # only the compiled-fn cache lookup
+        b = _bucket(n)
+        padded = list(texts) + [""] * (b - n)
+        ids, mask = self.tokenizer.encode_batch(padded)
         with self._lock:
-            texts = ["" if t is None else str(t) for t in texts]
-            n = len(texts)
-            if n == 0:
-                return jnp.zeros((0, self.config.d_model), jnp.float32)
-            b = _bucket(n)
-            padded = list(texts) + [""] * (b - n)
-            ids, mask = self.tokenizer.encode_batch(padded)
             fn = self._forward_fn(ids.shape[0], ids.shape[1])
         # dispatch OFF the lock (lock-discipline): params/fn are stable
         # refs, so the launch needs no lock — holding it would serialize
@@ -225,22 +229,24 @@ class SentenceEncoder:
             # HF-imported modules don't take segment inputs; packing is a
             # shape optimization, so fall back to the plain path
             return self.encode_to_device(texts)
-        with self._lock:
-            texts = ["" if t is None else str(t) for t in texts]
-            n = len(texts)
-            if n == 0:
-                return jnp.zeros((0, self.config.d_model), jnp.float32)
-            from .packing import pad_packed_rows, seg_bucket
+        # tokenize + pack OFF the lock (stateless host prep, same reason
+        # as encode_to_device); the lock covers only the compiled-fn cache
+        texts = ["" if t is None else str(t) for t in texts]
+        n = len(texts)
+        if n == 0:
+            return jnp.zeros((0, self.config.d_model), jnp.float32)
+        from .packing import pad_packed_rows, seg_bucket
 
-            ids, mask, segments, positions, doc_slots, n_seg = self._pack(texts)
-            # bucket the row count and segment width: few compile shapes
-            rows_real = ids.shape[0]
-            Rb = _bucket(rows_real)
-            observe.record_occupancy("encoder_packed", rows_real, Rb)
-            ids, segments, positions = pad_packed_rows(
-                ids, segments, positions, Rb
-            )
-            Sb = seg_bucket(n_seg)
+        ids, mask, segments, positions, doc_slots, n_seg = self._pack(texts)
+        # bucket the row count and segment width: few compile shapes
+        rows_real = ids.shape[0]
+        Rb = _bucket(rows_real)
+        observe.record_occupancy("encoder_packed", rows_real, Rb)
+        ids, segments, positions = pad_packed_rows(
+            ids, segments, positions, Rb
+        )
+        Sb = seg_bucket(n_seg)
+        with self._lock:
             fn = self._packed_fn(Rb, ids.shape[1], Sb)
         # dispatch OFF the lock, same as encode_to_device (and the same
         # "encoder.dispatch" retry/fault site)

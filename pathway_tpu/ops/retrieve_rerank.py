@@ -96,10 +96,12 @@ class _PendingServe:
     __slots__ = (
         "_pipeline", "_stage1", "_queries", "_k",
         "_stage2", "_result", "_done", "_hlock",
-        "_deadline", "_stage1_rows",
+        "_deadline", "_stage1_rows", "_n_requests",
     )
 
-    def __init__(self, pipeline, stage1, queries, k, deadline=None) -> None:
+    def __init__(
+        self, pipeline, stage1, queries, k, deadline=None, n_requests=1
+    ) -> None:
         self._pipeline = pipeline
         self._stage1 = stage1
         self._queries = queries
@@ -110,6 +112,12 @@ class _PendingServe:
         self._hlock = threading.Lock()
         self._deadline: Optional[Deadline] = deadline
         self._stage1_rows: Any = None
+        # how many coalesced caller REQUESTS ride this serve (the serve
+        # scheduler packs several into one batch): degradation flags are
+        # batch-scoped but the ladder counters must count affected
+        # requests, not batches — a 16-rider batch failing stage 1 is 16
+        # degraded serves on pathway_serve_degraded_total
+        self._n_requests = max(1, int(n_requests))
 
     def advance(self) -> None:
         with self._hlock:
@@ -130,7 +138,10 @@ class _PendingServe:
                     "pathway_serve_degraded_total",
                     exc,
                 )
-            record_degraded(RETRIEVAL_FAILED)
+            # per-request accounting: every coalesced rider of this batch
+            # is an affected request (the scheduler demuxes the flagged
+            # empty rows to each of them); later batches start clean
+            record_degraded(RETRIEVAL_FAILED, self._n_requests)
             empty = ServeResult(
                 [[] for _ in self._queries], degraded=(RETRIEVAL_FAILED,)
             )
@@ -143,12 +154,14 @@ class _PendingServe:
                 # deadline-tight rung: no budget left for the rescore
                 # round trip — serve the stage-1 ranking immediately
                 deadline.check("stage2_submit")
-            with self._pipeline._lock:
-                self._stage2 = self._pipeline._submit_stage2(
-                    self._queries, cand_keys, self._k,
-                    deadline=deadline,
-                    stage1_flags=getattr(hits, "degraded", ()),
-                )
+            # NO pipeline lock here: stage-2 pack is pure host prep and
+            # must overlap other batches' device time (the compiled-fn
+            # cache + stats take the lock internally, briefly)
+            self._stage2 = self._pipeline._submit_stage2(
+                self._queries, cand_keys, self._k,
+                deadline=deadline,
+                stage1_flags=getattr(hits, "degraded", ()),
+            )
         except Exception as exc:
             # CircuitOpen / DeadlineExceeded are policy outcomes (the
             # breaker bookkeeping happened inside retry_call); anything
@@ -173,7 +186,7 @@ class _PendingServe:
             [list(row[:k]) for row in hits],
             degraded=tuple(getattr(hits, "degraded", ())) + (RERANK_SKIPPED,),
         )
-        record_degraded(RERANK_SKIPPED)
+        record_degraded(RERANK_SKIPPED, self._n_requests)
         return lambda: result
 
     def __call__(self) -> List[List[Tuple[int, float]]]:
@@ -283,37 +296,42 @@ class RetrieveRerankPipeline:
         packed int32 output [Q, 2*k_out] (score bit-patterns, then the
         winning stage-1 candidate indices).  Scores ride int lanes for the
         same reason as serving.py: TPU float lanes canonicalize NaN
-        payloads; int lanes survive bit-exact."""
+        payloads; int lanes survive bit-exact.
+
+        Takes the pipeline lock internally (cache dict + tripwire only):
+        callers pack and dispatch OFF the lock so concurrent batches'
+        host prep overlaps."""
         Kc = self.candidates
         key = (R, L, S, Q, k_out)
-        fn = self._fns.get(key)
-        if fn is not None:
-            return fn
-        self._tripwire.observe(key)
-        module = self.cross_encoder.module
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                return fn
+            self._tripwire.observe(key)
+            module = self.cross_encoder.module
 
-        @jax.jit
-        def fused(params, ids, segments, positions, pair_slot):
-            scores = module.apply(
-                {"params": params},
-                ids,
-                segments > 0,
-                segments=segments,
-                positions=positions,
-                n_segments=S,
-            )  # [R, S] per-segment pair scores
-            flat = scores.reshape(R * S).astype(jnp.float32)
-            # pair_slot[r*S+s] = q*Kc + j for real pairs, Q*Kc (out of
-            # range -> dropped) for pad segments; absent candidates keep
-            # -inf and can never outrank real ones
-            table = jnp.full((Q * Kc,), -jnp.inf, jnp.float32)
-            table = table.at[pair_slot].set(flat, mode="drop")
-            s, perm = jax.lax.top_k(table.reshape(Q, Kc), k_out)
-            s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
-            return jnp.concatenate([s_bits, perm.astype(jnp.int32)], axis=1)
+            @jax.jit
+            def fused(params, ids, segments, positions, pair_slot):
+                scores = module.apply(
+                    {"params": params},
+                    ids,
+                    segments > 0,
+                    segments=segments,
+                    positions=positions,
+                    n_segments=S,
+                )  # [R, S] per-segment pair scores
+                flat = scores.reshape(R * S).astype(jnp.float32)
+                # pair_slot[r*S+s] = q*Kc + j for real pairs, Q*Kc (out of
+                # range -> dropped) for pad segments; absent candidates keep
+                # -inf and can never outrank real ones
+                table = jnp.full((Q * Kc,), -jnp.inf, jnp.float32)
+                table = table.at[pair_slot].set(flat, mode="drop")
+                s, perm = jax.lax.top_k(table.reshape(Q, Kc), k_out)
+                s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
+                return jnp.concatenate([s_bits, perm.astype(jnp.int32)], axis=1)
 
-        self._fns[key] = fused
-        return fused
+            self._fns[key] = fused
+            return fused
 
     def _submit_stage2(
         self,
@@ -354,8 +372,10 @@ class RetrieveRerankPipeline:
         from ..models.packing import pad_packed_rows, seg_bucket
 
         Qb = _bucket(nq)
-        with ce._lock:
-            ids, segments, positions, doc_slots, n_seg = ce._pack_pairs(pairs)
+        # pack OFF every lock: tokenization + row packing are pure host
+        # work on stateless helpers, and under the coalescing scheduler
+        # batch N+1's pack must overlap batch N's device time
+        ids, segments, positions, doc_slots, n_seg = ce._pack_pairs(pairs)
         rows_real = ids.shape[0]
         Rb = _bucket(rows_real)
         L = ids.shape[1]
@@ -383,8 +403,9 @@ class RetrieveRerankPipeline:
         record_dispatch("rerank_stage2")
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
-        self.stats["stage2_pairs"] += len(pairs)
-        self.stats["stage2_rows"] += Rb
+        with self._lock:
+            self.stats["stage2_pairs"] += len(pairs)
+            self.stats["stage2_rows"] += Rb
         t_dispatch = time.perf_counter_ns()
         _H_S2PACK.observe_ns(t_dispatch - t_pack)
         # packing occupancy, both granularities: packed ROWS actually
@@ -465,9 +486,10 @@ class RetrieveRerankPipeline:
             breaker=self._breaker,
         )
         record_dispatch("rerank_stage2_host")
-        self.stats["stage2_pairs"] += len(pairs)
         rows = _bucket(len(pairs))  # one row per pair
-        self.stats["stage2_rows"] += rows
+        with self._lock:
+            self.stats["stage2_pairs"] += len(pairs)
+            self.stats["stage2_rows"] += rows
         t_dispatch = time.perf_counter_ns()
         _H_S2PACK.observe_ns(t_dispatch - t_pack)
         observe.record_occupancy("stage2", len(pairs), rows)
@@ -506,6 +528,7 @@ class RetrieveRerankPipeline:
         queries: Sequence[str],
         k: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        n_requests: int = 1,
     ):
         """Dispatch stage 1 WITHOUT waiting; returns a handle that is also
         the completion callable.  ``handle.advance()`` completes stage 1
@@ -520,7 +543,13 @@ class RetrieveRerankPipeline:
         ``PATHWAY_SERVE_DEADLINE_MS`` env knob) is the serve's wall-clock
         budget: stage 1 gets a ``stage1_fraction()`` sub-budget, stage 2
         whatever remains, and a spent budget degrades the serve down the
-        ladder (rerank_skipped / retrieval_failed) instead of raising."""
+        ladder (rerank_skipped / retrieval_failed) instead of raising.
+
+        ``n_requests`` is the coalesced-rider count when a serve
+        scheduler packed several caller requests into this one batch:
+        degradation COUNTERS then count affected requests, not batches
+        (the flags on the shared ``ServeResult`` are demuxed to each
+        rider by the scheduler)."""
         k = k or self.k
         queries = list(queries)
         if deadline is None:
@@ -556,7 +585,9 @@ class RetrieveRerankPipeline:
 
         with self._lock:
             self.stats["serves"] += 1
-        return _PendingServe(self, stage1, queries, k, deadline=deadline)
+        return _PendingServe(
+            self, stage1, queries, k, deadline=deadline, n_requests=n_requests
+        )
 
     def __call__(
         self,

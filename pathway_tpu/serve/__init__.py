@@ -1,0 +1,20 @@
+"""Serve-path front-end: continuous cross-request batching.
+
+The fused retrieve→rerank pipeline (ops/retrieve_rerank.py) meets its
+latency budget per CALL — 2 dispatches + 2 fetches — but concurrent
+callers each pay that budget alone and serialize behind one another.
+``ServeScheduler`` coalesces concurrent serve calls into shared bucketed
+device batches (one 2+2 budget amortized across every rider) and
+double-buffers them so stage-2 rerank of batch N overlaps stage-1
+encode/search of batch N+1; ``SharedBatcher`` is the same engine for
+flat scoring calls (e.g. the QA layer's cross-encoder rerank).
+"""
+
+from .scheduler import ServeScheduler, SharedBatcher, coalesce_window_s, max_batch_queries
+
+__all__ = [
+    "ServeScheduler",
+    "SharedBatcher",
+    "coalesce_window_s",
+    "max_batch_queries",
+]

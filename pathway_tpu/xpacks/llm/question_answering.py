@@ -8,6 +8,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import json
+import os
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
@@ -161,6 +162,7 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         prompt_template: Callable[[str, Sequence[str]], str] = prompt_qa,
         reranker=None,
         rerank_candidates: Optional[int] = None,
+        coalesce_rerank: Optional[bool] = None,
     ):
         """``reranker`` plugs a second ranking stage between retrieval and
         the LLM prompt (the multi-stage ranking architecture from
@@ -170,7 +172,15 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         over-fetches ``rerank_candidates`` docs (default 4x ``search_topk``)
         and the reranker's packed pair scoring keeps the best
         ``search_topk`` — the same retrieve→rerank shape the fused
-        ``ops.RetrieveRerankPipeline`` serves at two device round trips."""
+        ``ops.RetrieveRerankPipeline`` serves at two device round trips.
+
+        ``coalesce_rerank`` (default: ``PATHWAY_QA_RERANK_COALESCE`` env,
+        off) routes the per-row pair scoring through a
+        ``serve.SharedBatcher``: concurrent QA rows' (question, doc)
+        pairs coalesce into ONE packed cross-encoder dispatch inside the
+        ``PATHWAY_SERVE_COALESCE_US`` window instead of each row paying
+        its own device round trip — the same continuous cross-request
+        batching the serve scheduler applies to retrieval."""
         self.llm = llm
         self.indexer = indexer
         self.search_topk = search_topk
@@ -198,6 +208,35 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         # it here too, not just on its own dataflow scoring path (non-None
         # only when the wrapped model's predict takes packed)
         self._rerank_packed = getattr(reranker, "_predict_packed", None)
+        # cross-request rerank coalescing: concurrent QA rows share one
+        # packed cross-encoder dispatch through a SharedBatcher fronting
+        # the model's submit/complete contract
+        if coalesce_rerank is None:
+            coalesce_rerank = os.environ.get(
+                "PATHWAY_QA_RERANK_COALESCE", ""
+            ).lower() in ("1", "true", "yes", "on")
+        self._rerank_batcher = None
+        if (
+            coalesce_rerank
+            and self._rerank_model is not None
+            and callable(getattr(self._rerank_model, "submit", None))
+        ):
+            from ... import observe
+            from ...serve import SharedBatcher
+
+            model = self._rerank_model
+            packed = self._rerank_packed
+            if packed is None:
+                submit_fn = model.submit
+            else:
+                def submit_fn(items, deadline=None, _m=model, _p=packed):
+                    return _m.submit(items, packed=_p, deadline=deadline)
+
+            # per-instance name: two QA answerers must not collide into
+            # one Prometheus label set (duplicate samples fail the scrape)
+            self._rerank_batcher = SharedBatcher(
+                submit_fn, name=f"qa-rerank-{observe.next_id()}"
+            )
         # without a reranker there is no second stage to over-fetch for:
         # retrieval stays at search_topk even if rerank_candidates is set
         self.rerank_candidates = (
@@ -235,7 +274,16 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         model = self._rerank_model
         pairs = [(question, str(d.get("text", ""))) for d in docs]
         try:
-            if self._rerank_packed is None:
+            if self._rerank_batcher is not None:
+                # coalesced path: this row's pairs ride a shared packed
+                # dispatch with every other row in the window (a batch
+                # failure re-raises here and lands on the same ladder)
+                raw = retry_call(
+                    "qa.rerank", self._rerank_batcher.score, pairs,
+                    policy=_QA_RERANK_RETRY,
+                    breaker=self._rerank_breaker,
+                )
+            elif self._rerank_packed is None:
                 raw = retry_call(
                     "qa.rerank", model.predict, pairs,
                     policy=_QA_RERANK_RETRY,

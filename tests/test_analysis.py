@@ -246,6 +246,86 @@ def test_lock_discipline_sees_subscripted_device_values():
     assert len(live) == 1 and "np.asarray" in live[0].message
 
 
+def test_lock_discipline_flags_handle_completion_under_lock():
+    """The serve scheduler's future-handoff contract: dispatch on the
+    scheduler thread, fetch on the WAITER.  Completing a submit handle
+    (``handle()`` / ``handle.result()`` / ``handle.advance()``) while
+    holding a lock is the host fetch under the admission lock — every
+    admitter stalls for a device round trip."""
+    bad = """
+        import threading
+
+        class Scheduler:
+            def __init__(self):
+                self._qlock = threading.Lock()
+
+            def demux(self, pipe, q):
+                with self._qlock:
+                    handle = pipe.submit([q])
+                    rows = handle()
+                    handle.advance()
+                return rows
+
+            def wait_all(self, tickets):
+                with self._qlock:
+                    ticket = tickets.pop()
+                    return ticket.result(5.0)
+    """
+    found = _live(_run(bad), "lock-discipline")
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 2, messages
+    assert "handle" in messages and "future-handoff" in messages
+    assert "handle.advance" in messages
+    # `ticket.result` is NOT flagged: `ticket` was never assigned from a
+    # submit call in scope, so the rule cannot prove it is a serve handle
+
+
+def test_lock_discipline_ignores_executor_futures():
+    """``executor.submit``/``pool.submit`` is the concurrent.futures
+    convention, not the serve contract — waiting on a thread-pool future
+    under a lock off the serve path must not be reported as a serve
+    handle (a misleading diagnostic would force pragmas on unrelated
+    code)."""
+    src = """
+        import threading
+
+        class Runner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self, job):
+                with self._lock:
+                    fut = self._pool.submit(job)
+                    other = self.executor.submit(job)
+                    return fut.result(), other.result()
+    """
+    assert _live(_run(src), "lock-discipline") == []
+
+
+def test_lock_discipline_accepts_future_handoff_pattern():
+    """The scheduler's actual shape: the lock only ever guards queue and
+    handoff bookkeeping; the dispatch happens on the scheduler thread off
+    the lock, and the waiter completes the handle off it too."""
+    good = """
+        import threading
+
+        class Scheduler:
+            def __init__(self):
+                self._qlock = threading.Lock()
+                self._pending = []
+
+            def dispatch(self, pipe, batch):
+                handle = pipe.submit(batch)     # scheduler thread, off-lock
+                with self._qlock:
+                    self._pending.append(handle)  # handoff only
+                return handle
+
+            def wait(self, handle):
+                return handle()                 # waiter fetch, off-lock
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
 def test_pragma_without_reason_is_itself_flagged():
     src = """
         import threading

@@ -1,0 +1,362 @@
+"""Coalescing serve scheduler tests (pathway_tpu/serve/scheduler.py).
+
+Correctness bar: N concurrent callers coalesced into one shared batch get
+the same results they would have gotten serving alone (keys rank-for-rank,
+scores to float tolerance) and BIT-identical results to one sequential
+serve of the same shared batch (composition is sorted-unique, so identical
+windows produce identical device batches).  Budget bar: one coalesced
+batch costs 2 dispatches + 2 fetches TOTAL, regardless of rider count
+(asserted via the dispatch-counter hook, not timing).  Policy bar: tight
+deadlines pre-empt the window (solo serve), duplicate queries dispatch
+once and scatter to every waiter, and a stage-1 failure degrades exactly
+the riders of the faulted batch — per-request flags and counters, next
+batch clean.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.ops import dispatch_counter
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+from pathway_tpu.ops.serving import FusedEncodeSearch
+from pathway_tpu.robust import Deadline, RETRIEVAL_FAILED, inject
+from pathway_tpu.serve import ServeScheduler, SharedBatcher
+
+
+DOCS = {
+    i: f"document number {i} about {topic} case {i % 7} with live updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+            "mesh collectives", "tokenizer ingest", "serving latency",
+            "cross encoders", "top k selection", "packing rows",
+        ]
+        * 2
+    )
+}
+QUERIES = [
+    "rag retrieval serving", "exactly once stream", "packing segment rows",
+    "kafka offsets replay", "vector index search", "mesh collective sync",
+]
+
+
+@pytest.fixture(scope="module")
+def stack():
+    enc = SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    ce = CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=64,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    index.add(sorted(DOCS), enc.encode([DOCS[i] for i in sorted(DOCS)]))
+    return enc, ce, index
+
+
+def _pipeline(stack, k=5, candidates=16):
+    enc, ce, index = stack
+    return RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=k,
+        candidates=candidates,
+    )
+
+
+def _concurrent(sched, queries, k=None, deadline=None):
+    """Fire one single-query request per thread through a barrier so all
+    of them land inside one coalescing window; returns {query: result}."""
+    results, errors = {}, []
+    barrier = threading.Barrier(len(queries))
+
+    def worker(q):
+        try:
+            barrier.wait(timeout=10)
+            results[q] = sched.serve([q], k, deadline=deadline)
+        except Exception as exc:  # surfaces in the main thread's assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(q,)) for q in queries]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    return results
+
+
+def test_concurrent_callers_match_sequential(stack):
+    pipe = _pipeline(stack)
+    solo = {q: pipe([q]) for q in QUERIES}  # sequential reference (+ warmup)
+    with ServeScheduler(pipe, window_us=200_000) as sched:
+        results = _concurrent(sched, QUERIES)
+        assert sched.stats["batches"] == 1, sched.stats
+        assert sched.stats["requests"] == len(QUERIES)
+    for q in QUERIES:
+        got, want = results[q][0], solo[q][0]
+        assert [key for key, _ in got] == [key for key, _ in want]
+        np.testing.assert_allclose(
+            [s for _, s in got], [s for _, s in want], rtol=1e-5, atol=1e-5
+        )
+        assert results[q].degraded == ()
+
+
+def test_bit_identical_to_sequential_shared_batch(stack):
+    """Batch composition is the SORTED unique text list, so the coalesced
+    dispatch is byte-for-byte the same device batch a sequential caller
+    serving those texts in one call would launch — per-rider results are
+    bit-identical to that sequential serve, regardless of arrival order."""
+    pipe = _pipeline(stack)
+    reference = pipe(sorted(QUERIES), k=5)  # sequential serve of the batch
+    with ServeScheduler(pipe, window_us=200_000) as sched:
+        results = _concurrent(sched, QUERIES)
+    order = sorted(QUERIES)
+    for q in QUERIES:
+        assert results[q][0] == reference[order.index(q)]  # floats: bit-equal
+
+
+def test_dedup_encodes_once_and_scatters(stack):
+    pipe = _pipeline(stack)
+    pipe([QUERIES[0]])  # warmup compiles
+    hot = QUERIES[0]
+    with ServeScheduler(pipe, window_us=200_000) as sched:
+        with dispatch_counter.DispatchCounter() as counter:
+            # 8 identical requests: one batch, ONE unique query
+            res, errors = {}, []
+            barrier = threading.Barrier(8)
+
+            def worker(i):
+                try:
+                    barrier.wait(timeout=10)
+                    res[i] = sched.serve([hot])
+                except Exception as exc:
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+        assert sched.stats["dedup_hits"] >= 7, sched.stats
+        rows = [res[i] for i in range(8)]
+        assert all(r == rows[0] for r in rows)  # shared result, every waiter
+    # the whole 8-rider storm cost at most 2 batches * (2+2)
+    assert counter.dispatches <= 4, counter.events
+    assert counter.fetches <= 4, counter.events
+
+
+def test_per_batch_dispatch_budget_amortizes(stack):
+    """The 2-dispatch + 2-fetch budget is per BATCH: six concurrent
+    riders coalesced into one batch cost 2+2 total, not 6x(2+2)."""
+    pipe = _pipeline(stack)
+    pipe(QUERIES)  # warmup: compiles both stages at the shared shapes
+    with ServeScheduler(pipe, window_us=200_000) as sched:
+        with dispatch_counter.DispatchCounter() as counter:
+            _concurrent(sched, QUERIES)
+        assert sched.stats["batches"] == 1, sched.stats
+    assert counter.dispatches <= 2, counter.events
+    assert counter.fetches <= 2, counter.events
+
+
+def test_tight_deadline_preempts_window(stack):
+    """A request whose deadline cannot afford the coalescing wait serves
+    SOLO immediately instead of queueing."""
+    pipe = _pipeline(stack)
+    solo_want = pipe([QUERIES[0]])
+    with ServeScheduler(pipe, window_us=400_000) as sched:
+        t0 = time.perf_counter()
+        got = sched.serve([QUERIES[0]], deadline=Deadline.after_ms(800))
+        elapsed = time.perf_counter() - t0
+        assert sched.stats["solo"] == 1, sched.stats
+        assert sched.stats["batches"] == 0, sched.stats
+    # no window wait: well under the 400 ms coalescing window
+    assert elapsed < 0.35, elapsed
+    assert [key for key, _ in got[0]] == [key for key, _ in solo_want[0]]
+
+
+def test_mixed_k_requests_truncate_from_shared_batch(stack):
+    pipe = _pipeline(stack, k=8)
+    want3 = pipe([QUERIES[0]], k=3)
+    want7 = pipe([QUERIES[1]], k=7)
+    with ServeScheduler(pipe, window_us=200_000) as sched:
+        out = {}
+        barrier = threading.Barrier(2)
+
+        def worker(q, k):
+            barrier.wait(timeout=10)
+            out[k] = sched.serve([q], k)
+
+        t1 = threading.Thread(target=worker, args=(QUERIES[0], 3))
+        t2 = threading.Thread(target=worker, args=(QUERIES[1], 7))
+        t1.start(), t2.start(), t1.join(60), t2.join(60)
+        assert sched.stats["batches"] == 1, sched.stats
+    assert len(out[3][0]) == 3 and len(out[7][0]) == 7
+    assert [key for key, _ in out[3][0]] == [key for key, _ in want3[0]]
+    assert [key for key, _ in out[7][0]] == [key for key, _ in want7[0]]
+
+
+def test_stage1_failure_degrades_only_affected_requests(stack):
+    """A stage-1 dispatch failure inside a coalesced batch flags and
+    COUNTS retrieval_failed for each rider of that batch — and the next
+    batch starts clean (regression for per-request degradation demux)."""
+    pipe = _pipeline(stack)
+    pipe(QUERIES)  # warmup
+    degraded_counter = observe.counter(
+        "pathway_serve_degraded_total", reason=RETRIEVAL_FAILED
+    )
+    before = degraded_counter.value
+    riders = QUERIES[:4]
+    with ServeScheduler(pipe, window_us=200_000) as sched:
+        # 3 raises = the full serve.dispatch retry budget for ONE batch
+        with inject.armed("serve.dispatch", "raise", times=3):
+            results = _concurrent(sched, riders)
+        for q in riders:
+            assert results[q] == [[]]
+            assert RETRIEVAL_FAILED in results[q].degraded
+        # per-REQUEST accounting: 4 degraded serves, not 1 degraded batch
+        assert degraded_counter.value - before == len(riders)
+        # the fault does not leak into the next window
+        clean = sched.serve([QUERIES[4]])
+        assert clean.degraded == () and clean[0]
+
+
+def test_stop_drains_pending_tickets(stack):
+    pipe = _pipeline(stack)
+    sched = ServeScheduler(pipe, window_us=50_000)
+    tickets = [sched.submit([q]) for q in QUERIES[:3]]
+    sched.stop()
+    for t, q in zip(tickets, QUERIES[:3]):
+        assert t()[0]
+    # after stop, admissions serve solo on the caller's thread
+    assert sched.serve([QUERIES[0]])[0]
+    assert sched.stats["solo"] >= 1
+
+
+def test_tokenize_runs_off_the_serve_lock(stack):
+    """Satellite regression: FusedEncodeSearch tokenization must happen
+    BEFORE the serve lock is taken, so host prep of batch N+1 overlaps
+    device time of batch N (verified structurally here, and by the
+    tokenize_pack histogram still covering the prep)."""
+    enc, _, index = stack
+    serve = FusedEncodeSearch(enc, index, k=4)
+    calls = []
+    orig = enc.tokenizer.encode_batch
+
+    def checked(*args, **kwargs):
+        calls.append(serve._lock.locked())
+        return orig(*args, **kwargs)
+
+    enc.tokenizer.encode_batch = checked
+    try:
+        hist = observe.histogram(
+            "pathway_serve_stage_seconds", stage="tokenize_pack"
+        )
+        count_before = hist.snapshot()[2]
+        assert serve.submit([QUERIES[0]])()[0]
+    finally:
+        enc.tokenizer.encode_batch = orig
+    assert calls and not any(calls), "tokenization ran under the serve lock"
+    assert hist.snapshot()[2] == count_before + 1
+
+
+def test_shared_batcher_matches_predict_and_dedups(stack):
+    _, ce, _ = stack
+    pairs_a = [(QUERIES[0], DOCS[i]) for i in (0, 3, 9, 17)]
+    pairs_b = [(QUERIES[0], DOCS[i]) for i in (3, 9, 21, 25)]  # overlaps a
+    want_a = ce.predict(pairs_a)
+    want_b = ce.predict(pairs_b)
+    with SharedBatcher(ce.submit, window_us=200_000) as batcher:
+        out = {}
+        barrier = threading.Barrier(2)
+
+        def worker(tag, items):
+            barrier.wait(timeout=10)
+            out[tag] = batcher(items)
+
+        t1 = threading.Thread(target=worker, args=("a", pairs_a))
+        t2 = threading.Thread(target=worker, args=("b", pairs_b))
+        t1.start(), t2.start(), t1.join(60), t2.join(60)
+        assert batcher.stats["batches"] == 1, batcher.stats
+        assert batcher.stats["dedup_hits"] == 2, batcher.stats  # (3, 9)
+    np.testing.assert_allclose(out["a"], want_a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out["b"], want_b, rtol=1e-4, atol=1e-4)
+
+
+def test_qa_rerank_coalesces_through_shared_batcher(stack):
+    """The QA layer's reranker rides the same engine: coalesce_rerank=True
+    routes _rerank_docs through a SharedBatcher with unchanged ordering."""
+    from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+
+    _, ce, _ = stack
+
+    class _Llm:
+        func = staticmethod(lambda messages: "ok")
+
+    docs = [{"text": DOCS[i]} for i in (0, 3, 8, 14, 21, 30)]
+    qa_plain = BaseRAGQuestionAnswerer(
+        _Llm(), None, reranker=ce, search_topk=4
+    )
+    qa_coal = BaseRAGQuestionAnswerer(
+        _Llm(), None, reranker=ce, search_topk=4, coalesce_rerank=True
+    )
+    assert qa_coal._rerank_batcher is not None
+    try:
+        want = qa_plain._rerank_docs(QUERIES[0], list(docs))
+        got = qa_coal._rerank_docs(QUERIES[0], list(docs))
+        assert [d["text"] for d in got] == [d["text"] for d in want]
+        np.testing.assert_allclose(
+            [d["rerank_score"] for d in got],
+            [d["rerank_score"] for d in want],
+            rtol=1e-4, atol=1e-4,
+        )
+        assert qa_coal._rerank_batcher.stats["batches"] >= 1
+    finally:
+        qa_coal._rerank_batcher.stop()
+
+
+def test_scheduler_thread_survives_bad_items(stack):
+    """A request whose items cannot hash/sort (so dedup/packing throws)
+    must fail ONLY its own ticket — the scheduler thread stays alive and
+    the next request serves normally (a dead thread would hang every
+    future ticket forever)."""
+    _, ce, _ = stack
+    good = [(QUERIES[0], DOCS[0]), (QUERIES[0], DOCS[3])]
+    want = ce.predict(good)
+    with SharedBatcher(ce.submit, window_us=10_000) as batcher:
+        with pytest.raises(Exception):
+            batcher([["unhashable", "list-item"]])  # lists cannot hash
+        np.testing.assert_allclose(batcher(good), want, rtol=1e-4, atol=1e-4)
+
+
+def test_queue_metrics_reach_the_scrape_surface(stack):
+    pipe = _pipeline(stack)
+    with ServeScheduler(pipe, window_us=10_000, name="metrics-test") as sched:
+        sched.serve([QUERIES[0]])
+        stats = observe.snapshot()
+        names = list(stats["counters"]) + list(stats["gauges"])
+        joined = "\n".join(names)
+        assert 'pathway_serve_queue_batches_total{scheduler="metrics-test"}' in names
+        assert "pathway_serve_queue_depth" in joined
+        assert "pathway_serve_queue_requests_total" in joined
+        assert "pathway_serve_queue_queries_total" in joined
+        # time-in-queue histogram populated by the coalesced serve
+        hist_names = "\n".join(stats["histograms"])
+        assert "pathway_serve_queue_wait_seconds" in hist_names
+    lines = "\n".join(observe.render_prometheus())
+    assert "pathway_serve_queue_depth" in lines
