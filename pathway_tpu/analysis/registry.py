@@ -47,8 +47,13 @@ __all__ = [
 ]
 
 # cache getters that hand back per-shape jitted callables (the repo-wide
-# naming convention for compiled-fn caches)
-_CACHE_GETTER_RE = re.compile(r"^_(compiled\w*|forward_fn|packed_fn|search_fn)$")
+# naming convention for compiled-fn caches).  _token_fn/_pool_fn/
+# _maxsim_fn/_audit_fn are the forward-index family (models/encoder.py
+# token-state export + pathway_tpu/index/forward.py ingest and gather).
+_CACHE_GETTER_RE = re.compile(
+    r"^_(compiled\w*|forward_fn|packed_fn|search_fn"
+    r"|token_fn|pool_fn|maxsim_fn|audit_fn)$"
+)
 _LOCK_NAME_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
 _JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
 # the robust retry wrapper (pathway_tpu/robust/retry.py): a call like

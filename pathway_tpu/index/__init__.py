@@ -1,0 +1,25 @@
+"""Device-resident forward index for late-interaction reranking.
+
+``ForwardIndex`` (``forward.py``) stores compressed per-document token
+representations in HBM at ingest time — fixed-budget token pooling to a
+small row count plus per-channel int8 quantization with stored scales —
+so the serve-time rerank stage is a single fused gather + dequantize +
+MaxSim + top-k dispatch (ops/maxsim.py) instead of a cross-encoder
+forward over every candidate pair.  The ingest path mirrors
+``ops/ivf.py``'s absorb/commit discipline: plan off-lock, commit locked,
+generation/staleness guards.
+"""
+
+from .forward import (
+    ForwardIndex,
+    ForwardUnavailable,
+    forward_quant_mode,
+    forward_tokens_per_doc,
+)
+
+__all__ = [
+    "ForwardIndex",
+    "ForwardUnavailable",
+    "forward_quant_mode",
+    "forward_tokens_per_doc",
+]

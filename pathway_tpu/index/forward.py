@@ -1,0 +1,702 @@
+"""Device-resident forward index: compressed per-document token
+representations, computed once at ingest, gathered at serve time.
+
+The stage-2 cross-encoder re-encodes every candidate document on every
+request even though documents never change between requests — rerank
+FLOPs scale with document length x over-fetch (ROADMAP item 2).  The
+forward-index architecture ("Efficient Neural Ranking using Forward
+Indexes and Lightweight Encoders", arxiv 2311.01263; KaLM-Reranker-V1's
+compressed-document reranking, arxiv 2606.22807) moves the doc-side
+encode to ingest:
+
+- **ingest (absorb)**: the doc-side encoder exports per-token hidden
+  states (``SentenceEncoder.encode_token_states``); they are pooled to a
+  FIXED row budget ``T'`` per document (contiguous chunk means, so the
+  pad mask is a simple ``t < nvalid`` test) and int8-quantized with
+  per-channel scales — HBM stays bounded and measurable
+  (``pathway_forward_hbm_bytes`` / ``_compression_ratio`` gauges);
+- **storage**: padded row buckets ``[capacity, T', d]`` int8 +
+  ``[capacity, d]`` f32 scales + ``[capacity]`` valid-row counts, all
+  HBM-resident alongside the IVF shards, capacity grown in doubling
+  steps so the gather kernel holds a handful of compile shapes;
+- **serve (gather)**: candidates' rows are gathered by slot, dequantized
+  and MaxSim-scored against the stage-1 query token states in ONE fused
+  dispatch (ops/maxsim.py) — the cross-encoder becomes an optional
+  high-precision stage over only the top few.
+
+Concurrency mirrors ``ops/ivf.py``'s absorb/commit discipline exactly:
+the expensive plan (encoder dispatch + pool/quantize) runs OFF the index
+lock so serving continues throughout; only the donated scatter + host
+bookkeeping take the lock, with staleness guards for keys that mutated
+while the plan ran.  The donated buffers force the serve-path gather to
+launch before unlocking, the same launch-before-unlock rule the IVF
+dispatch follows.
+
+Failure policy (the ``robust`` ladder): a failed ingest pass is logged
+once, counted on ``pathway_forward_absorb_failures_total{site=...}``,
+and drops its documents from the FORWARD index only — retrieval and the
+cross-encoder fallback still see them, and a serve whose gather finds
+nothing degrades to the previous stage's scores flagged
+``late_interaction_skipped`` (never an exception out of serve).  Chaos
+sites: ``forward.absorb`` (plan), ``forward.upload`` (commit scatter),
+``forward.gather`` (serve gather dispatch).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observe
+from ..ops.dispatch_counter import record_dispatch, record_fetch
+from ..ops.maxsim import build_maxsim_kernel
+from ..ops.recompile_guard import RecompileTripwire
+from ..robust import RetryPolicy, inject, log_once, retry_call
+
+__all__ = [
+    "ForwardIndex",
+    "ForwardUnavailable",
+    "forward_quant_mode",
+    "forward_tokens_per_doc",
+]
+
+# serve-path gather retries fast and briefly: the dispatch launches
+# while HOLDING the index lock (donated absorb buffers force
+# launch-before-unlock, like the IVF dispatch), so the whole retry
+# budget must stay in the low milliseconds
+_GATHER_RETRY = RetryPolicy(attempts=3, base_delay_s=0.002, max_delay_s=0.02)
+
+# maintenance-duration histograms (flight recorder): absorb wall time is
+# the whole plan+commit pass, upload is the locked device-scatter part
+_H_ABSORB = observe.histogram("pathway_forward_absorb_seconds")
+_H_UPLOAD = observe.histogram("pathway_forward_upload_seconds")
+
+# every Nth successful absorb re-measures quantization error on a
+# sampled audit batch (the pathway_forward_quant_abs_err gauge)
+_AUDIT_EVERY = 8
+
+
+def forward_tokens_per_doc(default: int = 16) -> int:
+    """Pooled doc-row budget ``T'`` from ``PATHWAY_FORWARD_TOKENS``.
+    Every stored document occupies exactly ``T'`` rows (fewer real
+    tokens leave trailing rows invalid), so HBM per doc is a constant
+    ``T' * d`` int8 + ``d`` f32 scales."""
+    try:
+        n = int(os.environ.get("PATHWAY_FORWARD_TOKENS", str(default)) or default)
+    except ValueError:
+        n = default
+    return max(1, n)
+
+
+def forward_quant_mode(default: str = "int8") -> str:
+    """``PATHWAY_FORWARD_QUANT``: ``int8`` (per-channel scales, 4x
+    smaller than f32) or ``none`` (float32 rows, the parity oracle)."""
+    mode = (os.environ.get("PATHWAY_FORWARD_QUANT", default) or default).lower()
+    return mode if mode in ("int8", "none") else default
+
+
+class ForwardUnavailable(RuntimeError):
+    """The forward index cannot serve this gather (empty, or no
+    candidate is resident) — the rerank stage converts this into the
+    ``late_interaction_skipped`` rung."""
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _forward_scatter(tok, scales, nvalid, slots, q, s, nv):
+    """Scatter one absorb plan into the row buckets; donated buffers so
+    XLA updates the (possibly GB-scale) token store in place.  Pad plan
+    rows carry an out-of-range slot and drop."""
+    tok = tok.at[slots].set(q, mode="drop")
+    scales = scales.at[slots].set(s, mode="drop")
+    nvalid = nvalid.at[slots].set(nv, mode="drop")
+    return tok, scales, nvalid
+
+
+class ForwardIndex:
+    """HBM-resident compressed forward index over a ``SentenceEncoder``.
+
+    ``add(keys, texts)`` ingests (plan off-lock, commit locked);
+    ``gather_submit(...)`` is the serve-path entry the late-interaction
+    rerank stage drives (ops/retrieve_rerank.py).  ``tokens_per_doc``
+    and ``quant`` default to the ``PATHWAY_FORWARD_TOKENS`` /
+    ``PATHWAY_FORWARD_QUANT`` env knobs."""
+
+    def __init__(
+        self,
+        encoder,
+        tokens_per_doc: Optional[int] = None,
+        quant: Optional[str] = None,
+        initial_capacity: int = 1024,
+    ):
+        self.encoder = encoder
+        self.tokens_per_doc = tokens_per_doc or forward_tokens_per_doc()
+        self.quant = quant if quant in ("int8", "none") else forward_quant_mode()
+        self.dimension = int(encoder.config.d_model)
+        self._lock = threading.RLock()
+        self._capacity = 0
+        self._initial_capacity = max(64, int(initial_capacity))
+        # device row buckets (allocated on first absorb): tok [cap, T', d]
+        # int8 (or f32 with quant="none"), scales [cap, d] f32, nvalid
+        # [cap] int32 (0 = empty/removed slot)
+        self._tok: Any = None
+        self._scales: Any = None
+        self._nvalid: Any = None
+        # host bookkeeping: key <-> slot, freed slots for reuse, per-slot
+        # REAL ingest token counts (for the compression-ratio gauge)
+        self._slot_of_key: Dict[int, int] = {}
+        self._free: List[int] = []
+        self._next_slot = 0
+        # staleness guard (the IVF object-identity trick, adapted for
+        # text-keyed rows): every commit/remove of a key bumps its
+        # version; an off-lock plan snapshots versions at add() entry and
+        # the commit drops keys that mutated while the plan ran — a
+        # remove() must not be resurrected and a newer upsert must not be
+        # overwritten by an older plan that committed later
+        self._key_version: Dict[int, int] = {}
+        self._ntok_by_slot: Optional[np.ndarray] = None
+        self._nvalid_host: Optional[np.ndarray] = None
+        self._tokens_stored = 0  # sum of live nvalid (pooled rows)
+        self._raw_tokens_live = 0  # sum of live REAL ingest token counts
+        # bumped whenever the device buffers are REPLACED (growth or
+        # donated scatter): an off-lock consumer holding old refs must
+        # not mix them with new bookkeeping
+        self.generation = 0
+        self._fns: Dict[Tuple, Any] = {}
+        self._tripwire = RecompileTripwire("ForwardIndex")
+        self._quant_abs_err: Optional[float] = None
+        self.stats = {
+            "absorbs": 0,
+            "docs_absorbed": 0,
+            "absorb_failures": 0,
+            "upload_failures": 0,
+            "gathers": 0,
+            "gather_candidates": 0,
+            "gather_missing": 0,
+        }
+        self._observe_id = observe.next_id()
+        observe.register_provider(self)
+
+    # -- introspection ------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._slot_of_key)
+
+    def __contains__(self, key: int) -> bool:
+        return int(key) in self._slot_of_key
+
+    def hbm_bytes(self) -> int:
+        """Bytes resident on device for the row buckets (allocated
+        capacity, the number HBM planning cares about)."""
+        total = 0
+        for buf in (self._tok, self._scales, self._nvalid):
+            if buf is not None:
+                total += int(np.prod(buf.shape)) * buf.dtype.itemsize
+        return total
+
+    def compression_ratio(self) -> float:
+        """Raw float32 token-state bytes of the LIVE documents divided
+        by their stored bytes — the measurable compression the pooling +
+        quantization buys (>= ~8x at T'=16/int8 on typical corpora)."""
+        n = len(self._slot_of_key)
+        if n == 0:
+            return 1.0
+        raw = self._raw_tokens_live * self.dimension * 4
+        itemsize = 1 if self.quant == "int8" else 4
+        stored = n * (
+            self.tokens_per_doc * self.dimension * itemsize
+            + self.dimension * 4
+            + 4
+        )
+        return raw / max(stored, 1)
+
+    # -- compiled fns -------------------------------------------------------
+    def _pool_fn(self, B: int, L: int):
+        """Compiled ingest compressor: ``(tokens [B, L, d] f32, mask
+        [B, L]) -> (q rows, scales, nvalid, pooled_f32)``.  Fixed-budget
+        pooling: the real tokens of each doc are split into ``T'``
+        CONTIGUOUS chunks and mean-pooled (so valid rows are exactly
+        ``0..min(T', len)-1`` and the serve kernel's ``t < nvalid`` mask
+        is correct), each pooled row L2-normalized; quantization is
+        per-channel symmetric int8 with the absmax scale stored."""
+        T = self.tokens_per_doc
+        quant = self.quant == "int8"
+        key = ("pool", B, L, T, quant)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+
+        @jax.jit
+        def fn(tokens, mask):
+            m = mask.astype(jnp.float32)
+            lens = jnp.sum(m, axis=1)  # [B]
+            pos = jnp.cumsum(m, axis=1) - 1.0
+            # chunk id: contiguous 0..min(T, len)-1 over the real tokens
+            denom = jnp.maximum(lens, float(T))[:, None]
+            seg = jnp.floor(pos * T / denom)
+            seg = jnp.where(m > 0, seg, float(T))  # pad -> out of range
+            onehot = (
+                seg[:, :, None] == jnp.arange(T)[None, None, :]
+            ).astype(jnp.float32)  # [B, L, T]
+            summed = jnp.einsum("blt,bld->btd", onehot, tokens)
+            counts = jnp.sum(onehot, axis=1)  # [B, T]
+            pooled = summed / jnp.maximum(counts, 1.0)[:, :, None]
+            pooled = pooled / jnp.maximum(
+                jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+            )
+            valid = counts > 0
+            pooled = pooled * valid[:, :, None]
+            nvalid = jnp.minimum(lens, float(T)).astype(jnp.int32)
+            if quant:
+                absmax = jnp.max(jnp.abs(pooled), axis=1)  # [B, d]
+                scales = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+                q = jnp.clip(
+                    jnp.round(pooled / scales[:, None, :]), -127, 127
+                ).astype(jnp.int8)
+            else:
+                scales = jnp.ones(
+                    (pooled.shape[0], pooled.shape[2]), jnp.float32
+                )
+                q = pooled
+            return q, scales, nvalid, pooled
+
+        self._fns[key] = fn
+        return fn
+
+    def _audit_fn(self, B: int):
+        """Compiled quantization audit: mean |MaxSim(float) -
+        MaxSim(dequantized)| with the first few docs' own pooled rows as
+        probe queries — the ``pathway_forward_quant_abs_err`` gauge."""
+        T = self.tokens_per_doc
+        quant = self.quant == "int8"
+        nq = min(4, B)
+        key = ("audit", B, T, quant)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+
+        @jax.jit
+        def fn(pooled, q, scales, nvalid):
+            deq = q.astype(jnp.float32)
+            if quant:
+                deq = deq * scales[:, None, :]
+            probe = pooled[:nq]  # [nq, T, d] — probe queries
+            pmask = (
+                jnp.arange(T)[None, :] < nvalid[:nq, None]
+            ).astype(jnp.float32)  # [nq, T] valid probe tokens
+            # doc-row validity broadcast over [nq, K, Lq, T]
+            tmask = (
+                jnp.arange(T)[None, :] < nvalid[:, None]
+            )[None, :, None, :]
+
+            def maxsim(docs):
+                sim = jnp.einsum("qld,ktd->qklt", probe, docs)
+                sim = jnp.where(tmask, sim, -jnp.inf)
+                best = jnp.max(sim, axis=3)  # [nq, K, Lq]
+                best = jnp.where(pmask[:, None, :] > 0, best, 0.0)
+                return jnp.sum(best, axis=2)
+
+            sf = maxsim(pooled)
+            sq = maxsim(deq)
+            both = jnp.isfinite(sf) & jnp.isfinite(sq)
+            diff = jnp.where(both, jnp.abs(sf - sq), 0.0)
+            return jnp.sum(diff) / jnp.maximum(jnp.sum(both), 1)
+
+        self._fns[key] = fn
+        return fn
+
+    def _maxsim_fn(self, B: int, Lq: int, Kc: int, k_out: int):
+        """Compiled serve gather (ops/maxsim.py), cached per shape —
+        capacity and the row budget are compile dimensions, so the key
+        includes them and the tripwire counts every signature."""
+        key = ("maxsim", B, Lq, Kc, k_out, self._capacity, self.tokens_per_doc)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+        fn = build_maxsim_kernel(
+            B, Lq, Kc, self.tokens_per_doc, k_out, self.quant == "int8"
+        )
+        self._fns[key] = fn
+        return fn
+
+    # -- ingest (absorb) ----------------------------------------------------
+    def add(self, keys: Sequence[int], texts: Sequence[str]) -> int:
+        """Ingest documents: encode + pool + quantize OFF the lock (the
+        plan — serving continues throughout), then commit the donated
+        scatter + bookkeeping under the lock, IVF-style.  Upserts
+        overwrite in place; returns the number of documents committed.
+
+        Degrade-not-die: a failed pass is logged once and counted on
+        ``pathway_forward_absorb_failures_total`` — the documents simply
+        stay out of the forward index (retrieval and the cross-encoder
+        fallback still see them) until a later ``add`` retries."""
+        keys = [int(k) for k in keys]
+        if not keys:
+            return 0
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            versions = {k: self._key_version.get(k, 0) for k in keys}
+        try:
+            plan = self._plan_absorb(keys, texts)
+            plan["versions"] = versions
+        except Exception as exc:
+            with self._lock:
+                self.stats["absorb_failures"] += 1
+            log_once(
+                f"forward.absorb:{type(exc).__name__}",
+                "forward-index absorb plan failed (%r); documents stay "
+                "out of the forward index (late-interaction degrades, "
+                "serving continues) — counted on "
+                "pathway_forward_absorb_failures_total",
+                exc,
+            )
+            return 0
+        try:
+            with self._lock:
+                n = self._commit_absorb(plan)
+        except Exception as exc:
+            with self._lock:
+                self.stats["upload_failures"] += 1
+                self.stats["absorb_failures"] += 1
+            log_once(
+                f"forward.upload:{type(exc).__name__}",
+                "forward-index commit upload failed (%r); documents stay "
+                "out of the forward index — counted on "
+                "pathway_forward_absorb_failures_total",
+                exc,
+            )
+            return 0
+        _H_ABSORB.observe_ns(time.perf_counter_ns() - t0)
+        if plan["audit"] is not None:
+            # audit fetch OFF the lock (maintenance path): one scalar
+            self._quant_abs_err = float(np.asarray(plan["audit"]))
+        return n
+
+    def _plan_absorb(self, keys: List[int], texts: Sequence[str]) -> Dict[str, Any]:
+        """Encode + pool + quantize for one ingest batch.  Lock-free:
+        touches only its arguments (the expensive encoder dispatch and
+        the pooled/quantized device arrays live here)."""
+        inject.fire("forward.absorb")  # chaos site: the off-lock plan
+        tokens, mask, n = self.encoder.encode_token_states(texts)
+        fn = self._pool_fn(tokens.shape[0], tokens.shape[1])
+        # pathway: allow(recompile-hazard): shapes bucketed upstream — encode_token_states pads the batch with _bucket and pins L to max_len, so the pool fn compiles once per batch bucket
+        q, scales, nvalid, pooled = fn(tokens, jnp.asarray(mask))
+        audit = None
+        if self.stats["absorbs"] % _AUDIT_EVERY == 0:
+            audit = self._audit_fn(tokens.shape[0])(pooled, q, scales, nvalid)
+        # real ingest token counts per doc (compression-ratio accounting)
+        ntok = np.asarray(mask).sum(axis=1).astype(np.int64)[:n]
+        return {
+            "keys": keys,
+            "n": n,
+            "q": q,
+            "scales": scales,
+            "nvalid": nvalid,
+            "ntok": ntok,
+            "audit": audit,
+        }
+
+    def _commit_absorb(self, plan: Dict[str, Any]) -> int:
+        """Install one absorb plan (caller holds the lock): slot
+        assignment, capacity growth in doubling steps, ONE donated
+        device scatter, host bookkeeping.  ``forward.upload`` is the
+        chaos site for the device part."""
+        keys = plan["keys"]
+        n = plan["n"]
+        versions = plan["versions"]
+        b = int(plan["q"].shape[0])  # bucketed plan rows
+        # slot per real row: upsert reuses, else free list, else fresh.
+        # STALENESS GUARD: a key whose version moved while the plan ran
+        # off-lock (a remove(), or a newer add() that committed first)
+        # keeps slot -1 and its rows DROP below — the plan's data is
+        # older than the index's current truth for that key.
+        slots = np.full(b, -1, np.int64)
+        fresh_needed = 0
+        popped: List[int] = []  # free-list pops, rolled back on failure
+        for i, key in enumerate(keys[:n]):
+            if self._key_version.get(key, 0) != versions.get(key, 0):
+                continue  # stale: dropped
+            slot = self._slot_of_key.get(key)
+            if slot is None:
+                if self._free:
+                    slot = self._free.pop()
+                    popped.append(slot)
+                else:
+                    slot = self._next_slot + fresh_needed
+                    fresh_needed += 1
+            slots[i] = slot
+        live_rows = np.flatnonzero(slots[:n] >= 0)
+        if live_rows.size == 0:
+            self._free.extend(popped)
+            return 0  # everything went stale while the plan ran
+        high = self._next_slot + fresh_needed
+        try:
+            self._grow_to(high)
+            inject.fire("forward.upload")  # chaos site: the locked scatter
+            # stale rows AND pad plan rows scatter out-of-range and drop
+            slots[slots < 0] = self._capacity
+            t0 = time.perf_counter_ns()
+            # pathway: allow(recompile-hazard): slots share the plan's bucketed row count (stale/pad rows scatter out-of-range and drop) and capacity doubles — a handful of shapes over any ingest history
+            self._tok, self._scales, self._nvalid = _forward_scatter(
+                self._tok,
+                self._scales,
+                self._nvalid,
+                jnp.asarray(slots, jnp.int32),
+                plan["q"],
+                plan["scales"],
+                plan["nvalid"],
+            )
+        except BaseException:
+            # a failed upload must not leak the popped free slots —
+            # repeated failures would otherwise force spurious capacity
+            # doublings of the GB-scale token store
+            self._free.extend(popped)
+            raise
+        _H_UPLOAD.observe_ns(time.perf_counter_ns() - t0)
+        # bookkeeping AFTER the device update succeeded: a failed scatter
+        # must not leave keys mapped to slots holding stale rows
+        nvalid_host = np.asarray(plan["nvalid"])[:n]
+        for i in live_rows.tolist():
+            key = keys[i]
+            slot = int(slots[i])
+            old = self._slot_of_key.get(key)
+            if old is not None:
+                if old == slot:
+                    # in-place upsert: retire the old row's accounting
+                    self._tokens_stored -= int(self._nvalid_host[slot])
+                    self._raw_tokens_live -= int(self._ntok_by_slot[slot])
+                else:
+                    # duplicate key within one batch took a second slot:
+                    # the earlier one is released for reuse
+                    self._release_slot(old)
+            self._slot_of_key[key] = slot
+            self._key_version[key] = self._key_version.get(key, 0) + 1
+            self._ntok_by_slot[slot] = plan["ntok"][i]
+            self._raw_tokens_live += int(plan["ntok"][i])
+            self._tokens_stored += int(nvalid_host[i])
+            self._nvalid_host[slot] = int(nvalid_host[i])
+        self._next_slot = max(self._next_slot, high)
+        self.generation += 1
+        self.stats["absorbs"] += 1
+        self.stats["docs_absorbed"] += int(live_rows.size)
+        return int(live_rows.size)
+
+    def _release_slot(self, slot: int) -> None:
+        """Retire one live slot's accounting and free it for reuse
+        (caller holds the lock)."""
+        self._tokens_stored -= int(self._nvalid_host[slot])
+        self._raw_tokens_live -= int(self._ntok_by_slot[slot])
+        self._ntok_by_slot[slot] = 0
+        self._nvalid_host[slot] = 0
+        self._free.append(slot)
+
+    def _grow_to(self, needed_slots: int) -> None:
+        """Ensure device capacity for ``needed_slots`` rows (caller
+        holds the lock): capacities double from ``initial_capacity`` so
+        the gather kernel sees a handful of compile shapes over any
+        ingest history.  Growth is functional (concatenate) — old
+        buffer refs snapshotted by an in-flight gather stay valid."""
+        if needed_slots <= self._capacity:
+            return
+        new_cap = max(self._initial_capacity, 1)
+        while new_cap < needed_slots:
+            new_cap *= 2
+        T, d = self.tokens_per_doc, self.dimension
+        tok_dtype = jnp.int8 if self.quant == "int8" else jnp.float32
+        if self._tok is None:
+            self._tok = jnp.zeros((new_cap, T, d), tok_dtype)
+            self._scales = jnp.ones((new_cap, d), jnp.float32)
+            self._nvalid = jnp.zeros((new_cap,), jnp.int32)
+            self._ntok_by_slot = np.zeros(new_cap, np.int64)
+            self._nvalid_host = np.zeros(new_cap, np.int32)
+        else:
+            extra = new_cap - self._capacity
+            self._tok = jnp.concatenate(
+                [self._tok, jnp.zeros((extra, T, d), tok_dtype)]
+            )
+            self._scales = jnp.concatenate(
+                [self._scales, jnp.ones((extra, d), jnp.float32)]
+            )
+            self._nvalid = jnp.concatenate(
+                [self._nvalid, jnp.zeros((extra,), jnp.int32)]
+            )
+            self._ntok_by_slot = np.concatenate(
+                [self._ntok_by_slot, np.zeros(extra, np.int64)]
+            )
+            self._nvalid_host = np.concatenate(
+                [self._nvalid_host, np.zeros(extra, np.int32)]
+            )
+        self._capacity = new_cap
+        self.generation += 1
+
+    def remove(self, keys: Sequence[int]) -> None:
+        """Drop documents from the forward index (host bookkeeping only:
+        an unmapped slot is unreachable by any future gather, and its
+        rows are overwritten when the slot is reused)."""
+        with self._lock:
+            for k in keys:
+                k = int(k)
+                # version bump regardless of residency: an in-flight
+                # off-lock absorb plan for this key must not resurrect it
+                self._key_version[k] = self._key_version.get(k, 0) + 1
+                slot = self._slot_of_key.pop(k, None)
+                if slot is not None:
+                    self._release_slot(slot)
+
+    # -- serve-path gather --------------------------------------------------
+    def gather_submit(
+        self,
+        query_tokens,
+        query_mask: np.ndarray,
+        cand_keys: List[List[int]],
+        k_out: int,
+        deadline=None,
+        width: Optional[int] = None,
+    ):
+        """Dispatch the fused gather+MaxSim+top-k for one serve batch;
+        returns ``(complete, missing)`` where ``complete() -> (scores
+        [nq, k_out], perm [nq, k_out])`` (perm indexes each row of
+        ``cand_keys``) and ``missing[qi]`` lists candidate POSITIONS not
+        resident in the forward index (the caller backfills them from
+        the previous stage's ordering).  Raises ``ForwardUnavailable``
+        when nothing useful is resident — the rerank stage converts that
+        into the ``late_interaction_skipped`` rung.
+
+        The dispatch launches while HOLDING the index lock: the donated
+        absorb scatter may replace the row buckets at any commit, so the
+        gather must snapshot refs and launch before unlocking — the same
+        launch-before-unlock rule as the IVF dispatch (ops/serving.py).
+        """
+        if query_tokens is None:
+            raise ForwardUnavailable("no query token states from stage 1")
+        B, Lq = int(query_tokens.shape[0]), int(query_tokens.shape[1])
+        nq = len(cand_keys)
+        longest = max((len(row) for row in cand_keys), default=0)
+        # the candidate grid is pinned to the STAGE's fixed width (not
+        # the longest row): a growing corpus widening stage-1 rows must
+        # not walk the gather kernel through new compile shapes
+        Kc = max(int(width) if width else longest, longest, 1)
+        k_out = min(int(k_out), Kc)  # top-k cannot exceed the pool width
+        if deadline is not None:
+            deadline.check("forward.gather")
+        with self._lock:
+            if self._tok is None or not self._slot_of_key:
+                raise ForwardUnavailable("forward index is empty")
+            slots = np.full((B, Kc), -1, np.int32)
+            missing: List[List[int]] = []
+            n_missing = 0
+            for qi, row in enumerate(cand_keys):
+                miss: List[int] = []
+                for j, key in enumerate(row[:Kc]):
+                    slot = self._slot_of_key.get(int(key))
+                    if slot is None:
+                        miss.append(j)
+                        n_missing += 1
+                    else:
+                        slots[qi, j] = slot
+                missing.append(miss)
+            n_cand = sum(len(row) for row in cand_keys)
+            if n_missing >= n_cand:
+                raise ForwardUnavailable("no candidate is resident")
+            fn = self._maxsim_fn(B, Lq, Kc, k_out)
+            # transient gather failures retry briefly (the lock is held,
+            # so the budget is milliseconds); "forward.gather" is the
+            # chaos-suite fault site
+            out = retry_call(  # pathway: allow(lock-discipline, recompile-hazard): dispatch-only — the donated absorb buffers force launch-before-unlock, exactly like the IVF serve dispatch (fetch happens off-lock in the completion); shapes are pinned: B/Lq ride the bucketed stage-1 batch, Kc is the stage's fixed candidate width, capacity doubles
+                "forward.gather",
+                fn,
+                query_tokens,
+                jnp.asarray(np.asarray(query_mask, np.float32)),
+                self._tok,
+                self._scales,
+                self._nvalid,
+                jnp.asarray(slots),
+                deadline=deadline,
+                policy=_GATHER_RETRY,
+            )
+            self.stats["gathers"] += 1
+            self.stats["gather_candidates"] += n_cand
+            self.stats["gather_missing"] += n_missing
+        record_dispatch("rerank_maxsim")
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        # gather-batch occupancy: real candidates inside the padded
+        # [B, Kc] slot grid (flight recorder)
+        observe.record_occupancy("forward_gather", n_cand, B * Kc)
+
+        def complete() -> Tuple[np.ndarray, np.ndarray]:
+            inject.fire("forward.gather.fetch", deadline=deadline)
+            if deadline is not None:
+                deadline.check("forward.gather.fetch")
+            arr = np.asarray(out)[:nq]
+            record_fetch("rerank_maxsim")
+            scores = np.ascontiguousarray(arr[:, :k_out]).view(np.float32)
+            perm = arr[:, k_out:]
+            return scores, perm
+
+        return complete, missing
+
+    # -- flight-recorder provider ------------------------------------------
+    def observe_metrics(self):
+        """Scrape-time ``pathway_forward_*`` samples: residency gauges
+        from live state, ingest/gather counters from ``stats``.
+        Lock-free reads of GIL-consistent attributes."""
+        labels = {"index": str(self._observe_id)}
+        n = len(self._slot_of_key)
+        yield ("gauge", "pathway_forward_docs", labels, n)
+        yield (
+            "gauge",
+            "pathway_forward_rows_resident",
+            labels,
+            n * self.tokens_per_doc,
+        )
+        yield ("gauge", "pathway_forward_tokens_stored", labels, self._tokens_stored)
+        yield ("gauge", "pathway_forward_hbm_bytes", labels, self.hbm_bytes())
+        yield (
+            "gauge",
+            "pathway_forward_compression_ratio",
+            labels,
+            self.compression_ratio(),
+        )
+        if self._quant_abs_err is not None:
+            yield (
+                "gauge",
+                "pathway_forward_quant_abs_err",
+                labels,
+                self._quant_abs_err,
+            )
+        for kind in ("absorbs", "docs_absorbed", "gathers"):
+            yield (
+                "counter",
+                f"pathway_forward_{kind}_total",
+                labels,
+                self.stats[kind],
+            )
+        for site, key in (
+            ("absorb", "absorb_failures"),
+            ("upload", "upload_failures"),
+        ):
+            yield (
+                "counter",
+                "pathway_forward_absorb_failures_total",
+                {**labels, "site": site},
+                self.stats[key],
+            )
+        for kind, key in (
+            ("candidates", "gather_candidates"),
+            ("missing", "gather_missing"),
+        ):
+            yield (
+                "counter",
+                "pathway_forward_gather_rows_total",
+                {**labels, "kind": kind},
+                self.stats[key],
+            )

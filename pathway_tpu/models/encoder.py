@@ -273,6 +273,66 @@ class SentenceEncoder:
         )
         return out[:n]
 
+    # -- token-state export (forward-index ingest) --------------------------
+    def _token_fn(self, batch: int, length: int):
+        """Compiled doc-side TOKEN-STATE forward: ``(params, ids, mask) ->
+        [B, L, d]`` per-token hidden states (post final layer norm,
+        L2-normalized per token) — the doc-side export the late-interaction
+        forward index stores at ingest (pathway_tpu/index).  Runs the SAME
+        trunk params through a pool-free twin of the module, so stored doc
+        tokens live in exactly the space the serve-time query tokens come
+        from."""
+        key = ("tokens", batch, length)
+        fn = self._fns.get(key)
+        if fn is None:
+            self._tripwire.observe(key)
+            if not isinstance(self.module, TransformerEncoder):
+                raise NotImplementedError(
+                    "token-state export needs the in-framework "
+                    "TransformerEncoder trunk (HF-imported modules pool "
+                    "internally)"
+                )
+            from .transformer import normalized_token_states, token_state_trunk
+
+            trunk = token_state_trunk(self.config)
+
+            @jax.jit
+            def fn(params, ids, mask):
+                hidden = trunk.apply({"params": params}, ids, mask)
+                return normalized_token_states(hidden, mask)
+
+            self._fns[key] = fn
+        return self._fns[key]
+
+    def encode_token_states(self, texts: Sequence[str]):
+        """Batch encode to PER-TOKEN states, device-resident: returns
+        ``(tokens [B, L, d] f32 jax array, mask [B, L] np, n_real)`` with
+        pad rows/tokens zeroed.  ``L`` is pinned to ``max_len`` so the
+        ingest path compiles one shape per batch bucket (ingest batches
+        are maintenance-path work; one wide shape beats a /16 shape
+        ladder).  Feeds ``index.forward.ForwardIndex`` ingest — the token
+        states never cross the host link."""
+        texts = ["" if t is None else str(t) for t in texts]
+        n = len(texts)
+        L = self.config.max_len
+        if n == 0:
+            return jnp.zeros((0, L, self.config.d_model), jnp.float32), (
+                np.zeros((0, L), np.int32)
+            ), 0
+        b = _bucket(n)
+        padded = list(texts) + [""] * (b - n)
+        ids, mask = self.tokenizer.encode_batch(padded, pad_to=L)
+        ids = np.asarray(ids)
+        mask = np.asarray(mask)
+        with self._lock:
+            fn = self._token_fn(ids.shape[0], ids.shape[1])
+        # dispatch OFF the lock, like encode_to_device (same retry/fault
+        # site: a doc-side token encode is still an encoder dispatch)
+        out = retry_call(
+            "encoder.dispatch", fn, self.params, jnp.asarray(ids), jnp.asarray(mask)
+        )
+        return out, mask, n
+
     def _packed_fn(self, R: int, L: int, S: int):
         key = ("packed", R, L, S)
         fn = self._fns.get(key)

@@ -15,7 +15,37 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["TransformerConfig", "TransformerEncoder", "resolve_heads"]
+__all__ = [
+    "TransformerConfig",
+    "TransformerEncoder",
+    "normalized_token_states",
+    "resolve_heads",
+    "token_state_trunk",
+]
+
+
+def token_state_trunk(config: "TransformerConfig") -> "TransformerEncoder":
+    """A pool-free twin of a trunk config — applies the SAME params (no
+    pooling layer carries weights) and returns raw [B, L, d] hidden
+    states.  The one constructor for every token-state export site."""
+    from dataclasses import replace
+
+    return TransformerEncoder(replace(config, pool="none"))
+
+
+def normalized_token_states(hidden, mask):
+    """Canonical token-state post-processing for late interaction
+    (traced fragment): f32 cast, per-token L2 normalization (1e-9
+    floor), pad tokens zeroed.  Doc-side ingest export
+    (models/encoder.py) and query-side serve export (ops/serving.py)
+    BOTH go through this one function — MaxSim is only meaningful if
+    stored doc tokens and serve-time query tokens live in the identical
+    vector space, so the math must not be able to drift between them."""
+    hidden = hidden.astype(jnp.float32)
+    hidden = hidden / jnp.maximum(
+        jnp.linalg.norm(hidden, axis=-1, keepdims=True), 1e-9
+    )
+    return hidden * mask[:, :, None].astype(jnp.float32)
 
 
 def resolve_heads(d_model: int, requested: int) -> int:

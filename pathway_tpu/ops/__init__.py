@@ -9,16 +9,24 @@ from .recompile_guard import (
     RecompileWarning,
     guarded_jit,
 )
-from .retrieve_rerank import RetrieveRerankPipeline
+from .retrieve_rerank import (
+    CrossEncoderStage,
+    LateInteractionStage,
+    RerankStage,
+    RetrieveRerankPipeline,
+)
 from .serving import FusedEncodeSearch
 from .topk import merge_topk, sharded_topk
 
 __all__ = [
+    "CrossEncoderStage",
     "DeviceKnnIndex",
     "FusedEncodeSearch",
+    "LateInteractionStage",
     "RecompileBudgetExceeded",
     "RecompileTripwire",
     "RecompileWarning",
+    "RerankStage",
     "RetrieveRerankPipeline",
     "guarded_jit",
     "sharded_topk",

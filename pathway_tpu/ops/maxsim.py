@@ -1,0 +1,117 @@
+"""Fused gather + dequantize + MaxSim + top-k — the late-interaction
+rerank kernel over the device-resident forward index.
+
+Stage-2 cross-encoding re-runs a transformer over every (query, doc)
+pair at serve time, so rerank FLOPs scale with document length times the
+over-fetch even though the documents never change between requests.
+Late interaction ("Efficient Neural Ranking using Forward Indexes and
+Lightweight Encoders", arxiv 2311.01263; KaLM-Reranker-V1's
+compressed-document reranking, arxiv 2606.22807) moves the doc-side
+encode to INGEST: per-document token embeddings are pooled to a fixed
+row budget, int8-quantized, and stored HBM-resident
+(pathway_tpu/index/forward.py); a serve only pays
+
+    gather candidate rows by slot  ->  dequantize  ->
+    MaxSim against the query token states  ->  per-query top-k
+
+all inside ONE jitted dispatch with one packed int32 output — the same
+shape discipline as the stage-1 fused kernel (ops/serving.py) and the
+packed cross-encoder (ops/retrieve_rerank.py).  The query token states
+arrive DEVICE-RESIDENT from the stage-1 dispatch (``FusedEncodeSearch``
+exports them alongside the pooled embedding), so the whole happy-path
+serve stays at 2 dispatches + 2 fetches.
+
+FLOPs per pair: ``Lq x T' x d`` MACs (T' pooled doc rows), versus a full
+transformer forward over the concatenated pair for the cross-encoder —
+two to three orders of magnitude less device work at matched over-fetch
+(the ``late_interaction`` bench phase prices both).
+
+Shapes are compile dimensions and every one of them is bucketed by the
+caller (query batch/length from stage 1, candidate width fixed per
+stage, doc-row budget fixed per index, capacity grown in doubling
+steps), so the kernel holds a handful of compile signatures in steady
+state — the forward index's recompile tripwire counts them.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["build_maxsim_kernel", "maxsim_scores_host"]
+
+
+def build_maxsim_kernel(
+    B: int, Lq: int, Kc: int, T: int, k_out: int, quantized: bool
+):
+    """One dispatch: ``(qtok [B, Lq, d], qmask [B, Lq], tok [N, T, d],
+    scales [N, d], nvalid [N], slots [B, Kc]) -> [B, 2*k_out] int32``
+    (``k_out`` score bit-patterns, then the winning candidate indices —
+    per-query permutations of the stage-1 candidate order, exactly the
+    packed layout the cross-encoder stage-2 kernel uses).
+
+    ``slots`` holds forward-index row-bucket slots, ``-1`` for a
+    candidate that is not resident (scores ``-inf`` and can never
+    outrank a real one; the host appends such candidates back from the
+    previous stage's ordering).  Pad doc rows (``t >= nvalid[slot]``)
+    are masked ``-inf`` before the per-query-token max; pad query tokens
+    (``qmask == 0``) contribute nothing to the MaxSim sum.  Scores ride
+    int32 lanes bit-exactly for the same NaN-canonicalization reason as
+    every other packed serve output (ops/serving.py)."""
+
+    @jax.jit
+    def fused(qtok, qmask, tok, scales, nvalid, slots):
+        flat = jnp.maximum(slots, 0).reshape(B * Kc)
+        docs = jnp.take(tok, flat, axis=0).astype(jnp.float32)  # [B*Kc, T, d]
+        if quantized:
+            s = jnp.take(scales, flat, axis=0)  # [B*Kc, d]
+            docs = docs * s[:, None, :]
+        nv = jnp.take(nvalid, flat)  # [B*Kc]
+        d = docs.shape[-1]
+        docs = docs.reshape(B, Kc, T, d)
+        # sim[b, k, l, t] = qtok[b, l] . docs[b, k, t] — one einsum, MXU
+        sim = jnp.einsum(
+            "bld,bktd->bklt", qtok, docs, preferred_element_type=jnp.float32
+        )
+        tvalid = (
+            jnp.arange(T)[None, :] < nv[:, None]
+        ).reshape(B, Kc, 1, T)
+        sim = jnp.where(tvalid, sim, -jnp.inf)
+        best = jnp.max(sim, axis=3)  # [B, Kc, Lq] per-query-token best row
+        # pad query tokens contribute 0; real tokens of a candidate with
+        # no valid rows stay -inf, so the whole sum is -inf and the
+        # candidate drops out of the top-k below
+        best = jnp.where(qmask[:, None, :] > 0, best, 0.0)
+        scores = jnp.sum(best, axis=2)  # [B, Kc]
+        scores = jnp.where(slots >= 0, scores, -jnp.inf)
+        s, perm = jax.lax.top_k(scores, k_out)
+        s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
+        return jnp.concatenate([s_bits, perm.astype(jnp.int32)], axis=1)
+
+    return fused
+
+
+def maxsim_scores_host(
+    qtok: np.ndarray,
+    qmask: np.ndarray,
+    docs: np.ndarray,
+    nvalid: np.ndarray,
+) -> np.ndarray:
+    """NumPy reference for the kernel's scoring math (tests + the
+    forward index's quantization-error audit): ``qtok [Lq, d]``,
+    ``qmask [Lq]``, ``docs [K, T, d]``, ``nvalid [K]`` -> ``[K]``
+    MaxSim scores.  A candidate with zero valid rows scores ``-inf``."""
+    Lq = qtok.shape[0]
+    K, T, _ = docs.shape
+    out = np.full(K, -np.inf, np.float32)
+    for ki in range(K):
+        nv = int(nvalid[ki])
+        if nv <= 0:
+            continue
+        sim = qtok @ docs[ki, :nv].T  # [Lq, nv]
+        best = sim.max(axis=1)
+        out[ki] = float(best[np.asarray(qmask[:Lq]) > 0].sum())
+    return out

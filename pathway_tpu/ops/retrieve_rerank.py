@@ -22,12 +22,36 @@ Short pairs share rows under block-diagonal segment attention
 ``submit``/``complete`` follow the stage-1 async pattern, so consecutive
 serve calls pipeline: stage 2 of call N runs on device while stage 1 of
 call N+1 is already queued behind it.
+
+Rerank stages are PLUGGABLE (the refactor behind ROADMAP item 3's
+configurable cascade): the pipeline runs a list of ``RerankStage``
+objects, each carrying its score fn (``submit``), over-fetch factor,
+deadline sub-budget, and degradation-ladder rung.  Two stages ship:
+
+- ``CrossEncoderStage`` — the packed cross-encoder rescore above
+  (rung ``rerank_skipped``);
+- ``LateInteractionStage`` — MaxSim over a device-resident forward
+  index (``pathway_tpu/index``): candidates' precomputed compressed
+  token rows are gathered, dequantized, scored against the stage-1
+  query token states and top-k'd in ONE fused dispatch (rung
+  ``late_interaction_skipped``).  The query token states ride the
+  stage-1 handle device-resident, so the happy-path serve stays at
+  2 dispatches + 2 fetches — and the rerank device FLOPs drop by the
+  document length (the cross-encoder re-encoded every pair; MaxSim is
+  one ``Lq x T' x d`` score per pair).
+
+A stage that fails (dispatch, fetch, deadline, circuit open, forward
+index unavailable) flags its rung and the serve continues with the best
+ranking so far — stage-by-stage degradation instead of all-or-nothing.
+The default MaxSim->cross-encoder cascade runs the cross-encoder as an
+optional high-precision pass over only the top few.
 """
 
 from __future__ import annotations
 
 # pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
 
+import math
 import threading
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
@@ -39,8 +63,10 @@ import numpy as np
 from .. import observe
 from ..robust import (
     CircuitBreaker,
+    CircuitOpen,
     Deadline,
     DeadlineExceeded,
+    LATE_INTERACTION_SKIPPED,
     RERANK_SKIPPED,
     RETRIEVAL_FAILED,
     RetryPolicy,
@@ -56,7 +82,12 @@ from .dispatch_counter import record_dispatch, record_fetch
 from .recompile_guard import RecompileTripwire
 from .serving import FusedEncodeSearch
 
-__all__ = ["RetrieveRerankPipeline"]
+__all__ = [
+    "CrossEncoderStage",
+    "LateInteractionStage",
+    "RerankStage",
+    "RetrieveRerankPipeline",
+]
 
 # the packed stage-2 dispatch launches under the pipeline lock (the
 # compile cache + stats it snapshots live there), so its retry backoff
@@ -77,6 +108,175 @@ _OUTER_RETRY = RetryPolicy(attempts=1)
 _H_S2PACK = observe.histogram("pathway_serve_stage_seconds", stage="stage2_pack")
 _H_S2RTT = observe.histogram("pathway_serve_stage_seconds", stage="stage2_rtt")
 _H_POST = observe.histogram("pathway_serve_stage_seconds", stage="postprocess")
+
+
+# -- pluggable rerank stages -------------------------------------------------
+class RerankStage:
+    """One rung of the ranking cascade.  A stage declares
+
+    - ``name`` — its dispatch/diagnostic label;
+    - ``rung`` — the degradation-ladder flag recorded when the stage is
+      skipped (failure, deadline, circuit open);
+    - ``over_fetch`` — candidate-pool factor: the stage rescores the
+      previous stage's top ``width(k)`` rows (an explicit ``candidates``
+      count overrides the factor);
+    - ``budget_fraction`` — optional share of the REMAINING deadline
+      this stage may spend (``None`` = whatever remains);
+
+    and implements ``submit(pipeline, queries, cand_rows, keep,
+    deadline, query_tokens, query_mask) -> completion`` where the
+    completion returns ``(rows, meta)``: per-query ``[(key, score)]``
+    rankings (descending, at most ``keep`` long) plus response metadata
+    to merge.  A stage failure — at submit OR completion — must raise;
+    the pipeline converts it into the stage's rung and serves the best
+    ranking so far (degrade, never die)."""
+
+    name = "rerank"
+    rung = RERANK_SKIPPED
+    over_fetch: float = 4.0
+    budget_fraction: Optional[float] = None
+    needs_query_tokens = False
+
+    def __init__(
+        self,
+        candidates: Optional[int] = None,
+        over_fetch: Optional[float] = None,
+        budget_fraction: Optional[float] = None,
+    ):
+        self.candidates = candidates
+        if over_fetch is not None:
+            self.over_fetch = float(over_fetch)
+        if budget_fraction is not None:
+            self.budget_fraction = float(budget_fraction)
+
+    def width(self, k: int) -> int:
+        """Input candidate-pool width for final top-``k`` serving."""
+        if self.candidates is not None:
+            return max(int(self.candidates), 1)
+        return max(int(math.ceil(self.over_fetch * k)), k, 1)
+
+    def sub_deadline(self, deadline: Optional[Deadline]) -> Optional[Deadline]:
+        if deadline is not None and self.budget_fraction is not None:
+            return deadline.sub_budget(self.budget_fraction)
+        return deadline
+
+    def submit(
+        self, pipeline, queries, cand_rows, keep, deadline,
+        query_tokens=None, query_mask=None, pool_width=None,
+    ):
+        """``cand_rows`` arrive truncated to this stage's resolved pool
+        width, which the chain also passes explicitly as ``pool_width``
+        so the stage can pin device shapes to it (rows may be shorter
+        when the corpus is small)."""
+        raise NotImplementedError
+
+    def note_failure(self, pipeline, exc: BaseException) -> None:
+        """Hook for failure bookkeeping beyond the ladder (e.g. feeding
+        a model's circuit breaker).  Policy outcomes (deadline, circuit
+        open) are not model failures and never reach here."""
+
+
+class CrossEncoderStage(RerankStage):
+    """The packed cross-encoder rescore — now also the optional
+    high-precision tail of a MaxSim cascade.  Scoring runs through the
+    pipeline's ``_submit_stage2`` (one packed dispatch, one fetch) sized
+    to THIS stage's pool width (a cascade tail over the top 10 must not
+    pay the stage-1 over-fetch's [Q, 32] score table); failures feed the
+    shared per-model circuit breaker."""
+
+    name = "cross_encoder"
+    rung = RERANK_SKIPPED
+
+    def submit(
+        self, pipeline, queries, cand_rows, keep, deadline,
+        query_tokens=None, query_mask=None, pool_width=None,
+    ):
+        cand_keys = [[key for key, _ in row] for row in cand_rows]
+        return pipeline._submit_stage2(
+            queries, cand_keys, keep, deadline=deadline, pool=pool_width
+        )
+
+    def note_failure(self, pipeline, exc: BaseException) -> None:
+        pipeline._breaker.record_failure()
+
+
+class LateInteractionStage(RerankStage):
+    """MaxSim late interaction over a device-resident ``ForwardIndex``
+    (pathway_tpu/index): gather candidate rows by doc id, dequantize,
+    score against the stage-1 query token states, top-k — ONE fused
+    dispatch, no document re-encoding, no extra query encode (the token
+    states ride the stage-1 handle device-resident).
+
+    Candidates missing from the forward index (not yet absorbed, or
+    evicted) are backfilled AFTER the MaxSim-ranked rows in their
+    previous-stage order and reported in ``meta["forward_missing"]``; a
+    gather with nothing resident (or no token states, or a spent
+    deadline) raises and serves the previous stage's scores flagged
+    ``late_interaction_skipped``."""
+
+    name = "late_interaction"
+    rung = LATE_INTERACTION_SKIPPED
+    needs_query_tokens = True
+
+    def __init__(
+        self,
+        forward_index,
+        candidates: Optional[int] = None,
+        over_fetch: Optional[float] = None,
+        budget_fraction: Optional[float] = None,
+    ):
+        super().__init__(
+            candidates=candidates, over_fetch=over_fetch,
+            budget_fraction=budget_fraction,
+        )
+        self.forward = forward_index
+
+    def submit(
+        self, pipeline, queries, cand_rows, keep, deadline,
+        query_tokens=None, query_mask=None, pool_width=None,
+    ):
+        done, missing = self.forward.gather_submit(
+            query_tokens,
+            query_mask,
+            [[key for key, _ in row] for row in cand_rows],
+            keep,
+            deadline=deadline,
+            # pin the gather grid to the stage's resolved pool width so a
+            # growing corpus (wider stage-1 rows) never changes shape
+            width=pool_width,
+        )
+
+        def complete():
+            scores, perm = done()
+            results: List[List[Tuple[int, float]]] = []
+            missing_keys: List[int] = []
+            for qi, row in enumerate(cand_rows):
+                ranked: List[Tuple[int, float]] = []
+                for j in range(perm.shape[1]):
+                    s = float(scores[qi, j])
+                    ci = int(perm[qi, j])
+                    if not np.isfinite(s) or ci >= len(row):
+                        continue
+                    ranked.append((row[ci][0], s))
+                # candidates the forward index has no rows for could not
+                # be rescored: they backfill AFTER the MaxSim-ranked rows
+                # in previous-stage order with previous-stage scores (an
+                # honest partial rerank beats dropping them), and every
+                # one is reported in the response metadata
+                for j in missing[qi]:
+                    if j < len(row):
+                        missing_keys.append(row[j][0])
+                        if len(ranked) < keep:
+                            ranked.append(row[j])
+                results.append(ranked[:keep])
+            meta = (
+                {"forward_missing": tuple(missing_keys)}
+                if missing_keys
+                else None
+            )
+            return results, meta
+
+        return complete
 
 
 class _PendingServe:
@@ -148,7 +348,6 @@ class _PendingServe:
             self._stage2 = lambda: empty
             return
         self._stage1_rows = hits
-        cand_keys = [[key for key, _ in row] for row in hits]
         try:
             if deadline is not None:
                 # deadline-tight rung: no budget left for the rescore
@@ -156,11 +355,16 @@ class _PendingServe:
                 deadline.check("stage2_submit")
             # NO pipeline lock here: stage-2 pack is pure host prep and
             # must overlap other batches' device time (the compiled-fn
-            # cache + stats take the lock internally, briefly)
-            self._stage2 = self._pipeline._submit_stage2(
-                self._queries, cand_keys, self._k,
+            # cache + stats take the lock internally, briefly).  The
+            # stage chain handles per-stage failures internally (each
+            # stage's rung, cascade falls through); only the spent
+            # deadline above lands in the except below.
+            self._stage2 = self._pipeline._submit_chain(
+                self._queries, hits, self._k,
                 deadline=deadline,
-                stage1_flags=getattr(hits, "degraded", ()),
+                query_tokens=getattr(self._stage1, "query_tokens", None),
+                query_mask=getattr(self._stage1, "query_mask", None),
+                n_requests=self._n_requests,
             )
         except Exception as exc:
             # CircuitOpen / DeadlineExceeded are policy outcomes (the
@@ -170,23 +374,27 @@ class _PendingServe:
                 log_once(
                     f"stage2:{type(exc).__name__}",
                     "stage-2 rerank dispatch failed (%r); serving stage-1 "
-                    "scores flagged rerank_skipped",
+                    "scores flagged %s",
                     exc,
+                    self._pipeline.stages[0].rung,
                 )
             self._stage2 = self._stage1_fallback_fn()
 
     def _stage1_fallback_fn(self):
         """A completion serving the stage-1 ranking truncated to ``k``,
-        flagged ``rerank_skipped`` (stage-1's own flags carried over)."""
+        flagged with the FIRST rerank stage's rung (stage-1's own flags
+        carried over).  Later stages never ran, so only the first rung
+        is recorded — the serve degraded at that point of the cascade."""
         hits = self._stage1_rows
         if hits is None:
             hits = [[] for _ in self._queries]
         k = self._k
+        rung = self._pipeline.stages[0].rung
         result = ServeResult(
             [list(row[:k]) for row in hits],
-            degraded=tuple(getattr(hits, "degraded", ())) + (RERANK_SKIPPED,),
+            degraded=tuple(getattr(hits, "degraded", ())) + (rung,),
         )
-        record_degraded(RERANK_SKIPPED, self._n_requests)
+        record_degraded(rung, self._n_requests)
         return lambda: result
 
     def __call__(self) -> List[List[Tuple[int, float]]]:
@@ -200,14 +408,15 @@ class _PendingServe:
                     # results already on host are the serve
                     self._result = self._stage1_fallback_fn()()
                 except Exception as exc:
-                    # a stage-2 fetch failure is a cross-encoder failure:
-                    # feed the breaker so a persistent one opens it
-                    self._pipeline._breaker.record_failure()
+                    # last-resort safety net (the stage chain handles its
+                    # own failures): serve the stage-1 ranking flagged
+                    # with the first stage's rung
                     log_once(
                         f"stage2_fetch:{type(exc).__name__}",
-                        "stage-2 rerank fetch failed (%r); serving stage-1 "
-                        "scores flagged rerank_skipped",
+                        "stage-2 rerank completion failed (%r); serving "
+                        "stage-1 scores flagged %s",
                         exc,
+                        self._pipeline.stages[0].rung,
                     )
                     self._result = self._stage1_fallback_fn()()
                 self._done = True
@@ -232,18 +441,20 @@ class RetrieveRerankPipeline:
     def __init__(
         self,
         retriever: FusedEncodeSearch,
-        cross_encoder,
-        doc_text: Union[Mapping[int, str], Callable[[int], str]],
+        cross_encoder=None,
+        doc_text: Union[Mapping[int, str], Callable[[int], str], None] = None,
         k: int = 10,
         candidates: Optional[int] = None,
         deadline_ms: Optional[float] = None,
         rerank_breaker: Optional[CircuitBreaker] = None,
+        forward_index=None,
+        cascade: Optional[int] = None,
+        stages: Optional[Sequence[RerankStage]] = None,
     ):
         self.retriever = retriever
         self.cross_encoder = cross_encoder
         self.doc_text = doc_text
         self.k = k
-        self.candidates = candidates or max(4 * k, 16)
         # per-serve wall-clock budget: explicit arg beats the
         # PATHWAY_SERVE_DEADLINE_MS env default; <= 0 disables
         self.deadline_ms = deadline_ms
@@ -252,6 +463,54 @@ class RetrieveRerankPipeline:
         # open it and every serve fast-paths to the rerank_skipped rung
         # until the half-open probe succeeds (robust/retry.py)
         self._breaker = rerank_breaker or robust_breaker("cross_encoder")
+        # -- the ranking cascade (pluggable stages) -------------------------
+        # explicit ``stages`` wins; else a ``forward_index`` builds the
+        # MaxSim stage, with the cross-encoder as an optional
+        # high-precision pass over the top ``cascade`` rows; else the
+        # classic single cross-encoder stage
+        width = candidates or max(4 * k, 16)
+        if stages is not None:
+            self.stages: List[RerankStage] = list(stages)
+        elif forward_index is not None:
+            self.stages = [LateInteractionStage(forward_index, candidates=width)]
+            if cascade:
+                self.stages.append(
+                    CrossEncoderStage(candidates=max(int(cascade), k))
+                )
+        else:
+            self.stages = [CrossEncoderStage(candidates=width)]
+        if not self.stages:
+            raise ValueError("RetrieveRerankPipeline needs at least one stage")
+        if any(isinstance(s, CrossEncoderStage) for s in self.stages) and (
+            cross_encoder is None or doc_text is None
+        ):
+            raise ValueError(
+                "a CrossEncoderStage needs cross_encoder= and doc_text="
+            )
+        # stage-1 over-fetch = the first rerank stage's candidate pool
+        self.candidates = self.stages[0].width(k)
+        # the MaxSim stage scores against the stage-1 query token states:
+        # flip the retriever's device-resident export on (no extra query
+        # encode; the fused stage-1 kernel returns them alongside).  A
+        # retriever that CANNOT export (HF-imported trunk, non-mean
+        # pooling) must fail HERE — otherwise every serve would silently
+        # degrade late_interaction_skipped forever
+        if any(s.needs_query_tokens for s in self.stages):
+            retriever.export_query_tokens = True
+            # POSITIVE capability proof: a retriever that cannot show a
+            # truthy ``_exporting()`` (HF trunk, non-mean pooling, or a
+            # duck-typed retriever with no export support at all) would
+            # serve every request late_interaction_skipped forever —
+            # that is a construction error, not a runtime degradation
+            exporting = getattr(retriever, "_exporting", None)
+            if exporting is None or not exporting():
+                raise ValueError(
+                    "a late-interaction stage needs query token states, "
+                    "but this retriever cannot export them (requires "
+                    "FusedEncodeSearch over the in-framework "
+                    "TransformerEncoder trunk with pool='mean'; "
+                    "HF-imported encoders pool internally)"
+                )
         self._lock = threading.Lock()
         self._fns: Dict[Tuple, Any] = {}
         # recompile tripwire (ops/recompile_guard.py): stage-2 shapes are
@@ -267,6 +526,123 @@ class RetrieveRerankPipeline:
                 else None
             )
         return Deadline.from_env()
+
+    # -- the stage chain ----------------------------------------------------
+    def _submit_chain(
+        self,
+        queries: Sequence[str],
+        hits,
+        k: int,
+        deadline: Optional[Deadline] = None,
+        query_tokens=None,
+        query_mask=None,
+        n_requests: int = 1,
+    ):
+        """Dispatch the FIRST rerank stage now (so stage 2 of this serve
+        overlaps stage 1 of the next — the pipelining contract) and
+        return a completion that walks the remaining cascade.  Each
+        stage rescores the best ranking so far, truncated to its own
+        candidate width; a stage that fails — submit, fetch, deadline,
+        circuit open — flags its rung, counts the affected requests, and
+        the chain continues from the previous ranking (stage-by-stage
+        degradation, never an exception out of the serve).
+
+        The final ``ServeResult`` carries the union of stage-1 flags,
+        every skipped stage's rung (each exactly once) and the merged
+        stage metadata; ``ServeResult`` itself mirrors the flags into
+        ``meta["degraded_reasons"]``."""
+        stages = self.stages
+        flags: List[str] = list(getattr(hits, "degraded", ()))
+        meta: Dict[str, Any] = dict(getattr(hits, "meta", {}) or {})
+        meta.pop("degraded_reasons", None)  # regenerated from final flags
+        rows: List[List[Tuple[int, float]]] = [list(r) for r in hits]
+        # keep_i: how many rows stage i must emit — the next stage's
+        # candidate pool, or the final k for the last stage
+        keeps = [
+            stages[i + 1].width(k) if i + 1 < len(stages) else k
+            for i in range(len(stages))
+        ]
+
+        def skip(stage: RerankStage, exc: BaseException) -> None:
+            if not isinstance(exc, (DeadlineExceeded, CircuitOpen)):
+                stage.note_failure(self, exc)
+                log_once(
+                    f"stage:{stage.name}:{type(exc).__name__}",
+                    "rerank stage %s failed (%r); serving the previous "
+                    "ranking flagged %s",
+                    stage.name,
+                    exc,
+                    stage.rung,
+                )
+            if stage.rung not in flags:
+                flags.append(stage.rung)
+                record_degraded(stage.rung, n_requests)
+
+        def try_submit(i: int, cur_rows):
+            stage = stages[i]
+            if not any(cur_rows):
+                return None  # nothing to rerank (empty retrieval): no rung
+            if deadline is not None:
+                deadline.check(f"{stage.name}_submit")
+            width = stage.width(k)
+            return stage.submit(
+                self,
+                queries,
+                [r[:width] for r in cur_rows],
+                keeps[i],
+                stage.sub_deadline(deadline),
+                query_tokens=query_tokens,
+                query_mask=query_mask,
+                pool_width=width,
+            )
+
+        # stage 0 dispatches NOW (pipelining); its submit failure is
+        # handled HERE like any other stage's, so the cascade falls
+        # through — a cold forward index (gather unavailable) must not
+        # rob a healthy cross-encoder tail of its rescore
+        pending = None
+        try:
+            pending = try_submit(0, rows)
+        except Exception as exc:
+            skip(stages[0], exc)
+
+        def complete() -> ServeResult:
+            nonlocal rows
+            i = 0
+            cur = pending
+            while i < len(stages):
+                if cur is not None:
+                    try:
+                        res = cur()
+                        if isinstance(res, tuple):
+                            new_rows, stage_meta = res
+                        else:  # a ServeResult-style completion
+                            new_rows = list(res)
+                            stage_meta = getattr(res, "meta", None)
+                            for f in getattr(res, "degraded", ()):
+                                if f not in flags:
+                                    flags.append(f)
+                        rows = [list(r) for r in new_rows]
+                        if stage_meta:
+                            stage_meta = dict(stage_meta)
+                            stage_meta.pop("degraded_reasons", None)
+                            meta.update(stage_meta)
+                    except Exception as exc:
+                        skip(stages[i], exc)
+                i += 1
+                if i < len(stages):
+                    cur = None
+                    try:
+                        cur = try_submit(i, rows)
+                    except Exception as exc:
+                        skip(stages[i], exc)
+            return ServeResult(
+                [list(r[:k]) for r in rows],
+                degraded=flags,
+                meta=meta or None,
+            )
+
+        return complete
 
     # -- host helpers -------------------------------------------------------
     def _text_of(self, key: int, missing: Optional[List[int]] = None) -> str:
@@ -290,7 +666,10 @@ class RetrieveRerankPipeline:
         return str(text or "")
 
     # -- stage 2 kernel -----------------------------------------------------
-    def _compiled_stage2(self, R: int, L: int, S: int, Q: int, k_out: int):
+    def _compiled_stage2(
+        self, R: int, L: int, S: int, Q: int, k_out: int,
+        Kc: Optional[int] = None,
+    ):
         """One dispatch: packed cross-encoder forward -> scatter the pair
         scores into the [Q, Kc] candidate table -> per-query top-k -> ONE
         packed int32 output [Q, 2*k_out] (score bit-patterns, then the
@@ -300,9 +679,12 @@ class RetrieveRerankPipeline:
 
         Takes the pipeline lock internally (cache dict + tripwire only):
         callers pack and dispatch OFF the lock so concurrent batches'
-        host prep overlaps."""
-        Kc = self.candidates
-        key = (R, L, S, Q, k_out)
+        host prep overlaps.  ``Kc`` is the calling stage's candidate-pool
+        width (the [Q, Kc] score-table dimension) — a cascade's
+        cross-encoder tail over the top few must not pay the stage-1
+        over-fetch's table and top-k."""
+        Kc = Kc or self.candidates
+        key = (R, L, S, Q, k_out, Kc)
         with self._lock:
             fn = self._fns.get(key)
             if fn is not None:
@@ -340,16 +722,19 @@ class RetrieveRerankPipeline:
         k: int,
         deadline: Optional[Deadline] = None,
         stage1_flags: Sequence[str] = (),
+        pool: Optional[int] = None,
     ):
         """Pack the (query, candidate) pairs and dispatch the stage-2
         kernel; returns a completion -> ``ServeResult`` of
         [[(key, rerank_score)]] carrying the stage-1 degradation flags
-        and any ``missing_docs`` metadata."""
+        and any ``missing_docs`` metadata.  ``pool`` is the calling
+        stage's candidate width (defaults to the pipeline's stage-1
+        over-fetch — the classic single-stage configuration)."""
         from ..models.encoder import _bucket
 
         t_pack = time.perf_counter_ns()
         ce = self.cross_encoder
-        Kc = self.candidates
+        Kc = pool or self.candidates
         k_out = min(k, Kc)
         nq = len(queries)
         pairs: List[Tuple[str, str]] = []
@@ -368,6 +753,7 @@ class RetrieveRerankPipeline:
             return self._submit_stage2_host(
                 queries, cand_keys, pairs, k_out,
                 deadline=deadline, stage1_flags=stage1_flags, meta=meta,
+                pool=Kc,
             )
         from ..models.packing import pad_packed_rows, seg_bucket
 
@@ -384,7 +770,7 @@ class RetrieveRerankPipeline:
         pair_slot = np.full(Rb * Sb, Qb * Kc, np.int32)  # default: dropped
         for i, (r, s) in enumerate(doc_slots):
             pair_slot[r * Sb + s] = slot_ids[i]
-        fn = self._compiled_stage2(Rb, L, Sb, Qb, k_out)
+        fn = self._compiled_stage2(Rb, L, Sb, Qb, k_out, Kc=Kc)
         # retry transient dispatch failures; the per-model breaker both
         # gates the attempts (CircuitOpen fast-fails to the ladder) and
         # learns from their outcomes ("rerank.dispatch" is the chaos site)
@@ -465,6 +851,7 @@ class RetrieveRerankPipeline:
         deadline: Optional[Deadline] = None,
         stage1_flags: Sequence[str] = (),
         meta=None,
+        pool: Optional[int] = None,
     ):
         """HF fallback: unpacked async scoring + host-side per-query sort
         (HF modules take no segment inputs; still one dispatch + one fetch,
@@ -504,8 +891,9 @@ class RetrieveRerankPipeline:
             _H_S2RTT.observe_ns(t_fetch - t_dispatch)
             results: List[List[Tuple[int, float]]] = []
             pos = 0
+            width = pool or self.candidates
             for qi in range(len(queries)):
-                n_c = min(len(cand_keys[qi]), self.candidates)
+                n_c = min(len(cand_keys[qi]), width)
                 scored = list(
                     zip(cand_keys[qi][:n_c], flat[pos : pos + n_c].tolist())
                 )

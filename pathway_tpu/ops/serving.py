@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..models.transformer import TransformerEncoder
 from ..robust import (
     Deadline,
     RetryPolicy,
@@ -66,7 +67,8 @@ class FusedEncodeSearch:
     a handful of shapes in steady state; index *content* changes (add/
     remove) never recompile."""
 
-    def __init__(self, encoder, index, k: int = 10):
+    def __init__(self, encoder, index, k: int = 10,
+                 export_query_tokens: bool = False):
         self.encoder = encoder
         self.index = index
         self.k = k
@@ -77,21 +79,73 @@ class FusedEncodeSearch:
         self._tripwire = RecompileTripwire("FusedEncodeSearch")
         # IVF indexes lack device key planes; winners map slot->key on host
         self._ivf = hasattr(index, "_centroids")
+        # query TOKEN-STATE export for a downstream late-interaction
+        # rerank stage (pathway_tpu/index): the fused kernel additionally
+        # returns the per-token hidden states, DEVICE-RESIDENT (never
+        # fetched here) — the MaxSim stage consumes them in its own single
+        # dispatch, so the query is encoded exactly once per serve.  The
+        # retrieve→rerank pipeline flips this on when it is built with a
+        # forward index; HF-imported trunks (internal pooling) ignore it.
+        self.export_query_tokens = bool(export_query_tokens)
+
+    def _exporting(self) -> bool:
+        module = self.encoder.module
+        return (
+            self.export_query_tokens
+            and isinstance(module, TransformerEncoder)
+            and module.config.pool == "mean"
+        )
+
+    def _query_forward(self, export: bool):
+        """The query-encode fragment of the fused kernels: returns a
+        traced ``(params, ids, mask) -> (z [B, d] f32, qtok | None)``
+        helper.  With ``export`` the trunk runs through a pool-free twin
+        (same params) so the SAME single dispatch yields both the pooled
+        embedding (bit-identical math to the module's own mean pool) and
+        the L2-normalized per-token states for a MaxSim stage."""
+        module = self.encoder.module
+        if not export:
+            def forward(params, ids, mask):
+                z = module.apply({"params": params}, ids, mask)
+                return z.astype(jnp.float32), None
+
+            return forward
+        from ..models.transformer import (
+            normalized_token_states,
+            token_state_trunk,
+        )
+
+        trunk = token_state_trunk(module.config)
+
+        def forward(params, ids, mask):
+            hidden = trunk.apply({"params": params}, ids, mask)
+            # replicate the module's masked mean pool (same ops, same
+            # order, same dtypes — TransformerEncoder.__call__)
+            m = mask[:, :, None].astype(hidden.dtype)
+            summed = jnp.sum(hidden * m, axis=1)
+            counts = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            z = (summed / counts).astype(jnp.float32)
+            # the SAME canonical post-processing the doc-side ingest
+            # export uses — one vector space for MaxSim by construction
+            qtok = normalized_token_states(hidden, mask)
+            return z, qtok
+
+        return forward
 
     def _compiled(self, B: int, L: int, k: int, capacity: int):
-        key = (B, L, k, capacity)
+        export = self._exporting()
+        key = (B, L, k, capacity, export)
         fn = self._fns.get(key)
         if fn is not None:
             return fn
         self._tripwire.observe(key)
-        module = self.encoder.module
         metric = self.index.metric
         normalize = metric == "cos"
+        forward = self._query_forward(export)
 
         @jax.jit
         def fused(params, ids, mask, matrix, valid, keys_hi, keys_lo):
-            z = module.apply({"params": params}, ids, mask)
-            z = z.astype(jnp.float32)
+            z, qtok = forward(params, ids, mask)
             if normalize:
                 z = z / jnp.maximum(
                     jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
@@ -120,7 +174,10 @@ class FusedEncodeSearch:
             # key whose 32-bit half happens to be a NaN bit pattern (~0.8%
             # of uniform xxh3 keys); integer lanes always survive bit-exact.
             s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
-            return jnp.concatenate([s_bits, hi, lo], axis=1)
+            packed = jnp.concatenate([s_bits, hi, lo], axis=1)
+            if qtok is not None:
+                return packed, qtok
+            return packed
 
         self._fns[key] = fused
         return fused
@@ -133,7 +190,6 @@ class FusedEncodeSearch:
         fresh rows not yet absorbed into the slabs are brute-force scored
         INSIDE the same dispatch, so serving never triggers a rebuild."""
         index = self.index
-        module = self.encoder.module
         normalize = index.metric == "cos"
         M = index._M_pad
         C = index._centroids.shape[0]
@@ -142,22 +198,24 @@ class FusedEncodeSearch:
         p = min(p, C)
         k_main = min(k, p * M)
         k_tail = min(k, t_pad) if t_pad else 0
+        export = self._exporting()
         shape_key = (
             "ivf", B, L, k, p, t_pad,
             index._slabs.shape[0],
             C,
             M,
+            export,
         )
         fn = self._fns.get(shape_key)
         if fn is not None:
             return fn, k_main, k_tail
         self._tripwire.observe(shape_key)
         use_pallas = jax.default_backend() == "tpu"
+        forward = self._query_forward(export)
 
         @jax.jit
         def fused(params, ids, mask, slabs, bias, centroids, tail_mat, tail_valid):
-            z = module.apply({"params": params}, ids, mask)
-            z = z.astype(jnp.float32)
+            z, qtok = forward(params, ids, mask)
             if normalize:
                 z = z / jnp.maximum(
                     jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
@@ -198,7 +256,10 @@ class FusedEncodeSearch:
                     jax.lax.bitcast_convert_type(t_s, jnp.int32),
                     t_i.astype(jnp.int32),
                 ]
-            return jnp.concatenate(parts, axis=1)
+            packed = jnp.concatenate(parts, axis=1)
+            if qtok is not None:
+                return packed, qtok
+            return packed
 
         self._fns[shape_key] = fused
         return fused, k_main, k_tail
@@ -261,10 +322,17 @@ class FusedEncodeSearch:
         # transient dispatch failures retry with backoff under the site's
         # budget ("ivf.dispatch" is also the chaos-suite fault site); the
         # deadline bounds both the attempts and the backoff sleeps
-        out = retry_call(
-            "ivf.dispatch", fn, *args,
-            deadline=deadline, policy=_LOCKED_DISPATCH_RETRY,
-        )
+        if self._exporting():
+            out, qtok = retry_call(
+                "ivf.dispatch", fn, *args,
+                deadline=deadline, policy=_LOCKED_DISPATCH_RETRY,
+            )
+        else:
+            out = retry_call(
+                "ivf.dispatch", fn, *args,
+                deadline=deadline, policy=_LOCKED_DISPATCH_RETRY,
+            )
+            qtok = None
         record_dispatch("serve_ivf")
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
@@ -319,6 +387,12 @@ class FusedEncodeSearch:
                 results, degraded=(TAIL_SKIPPED,) if tail_skipped else ()
             )
 
+        # DEVICE-RESIDENT query token states for a late-interaction rerank
+        # stage: rides the handle, never fetched on this path (the MaxSim
+        # stage consumes it inside its own single dispatch)
+        complete.query_tokens = qtok
+        complete.query_mask = mask
+        complete.n_queries = n_real
         return complete
 
     def submit(
@@ -390,7 +464,13 @@ class FusedEncodeSearch:
             )
         # transient dispatch failures retry with backoff ("serve.dispatch"
         # doubles as the chaos-suite fault site); deadline bounds attempts
-        out = retry_call("serve.dispatch", fn, *args, deadline=deadline)
+        if self._exporting():
+            out, qtok = retry_call(
+                "serve.dispatch", fn, *args, deadline=deadline
+            )
+        else:
+            out = retry_call("serve.dispatch", fn, *args, deadline=deadline)
+            qtok = None
         record_dispatch("serve_exact")
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
@@ -421,6 +501,11 @@ class FusedEncodeSearch:
             _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
             return ServeResult(results)
 
+        # device-resident query token states for a late-interaction stage
+        # (see _submit_ivf): attached, never fetched here
+        complete.query_tokens = qtok
+        complete.query_mask = mask
+        complete.n_queries = n_real
         return complete
 
     def __call__(

@@ -16,8 +16,8 @@ surface must degrade instead of dying:
   down;
 - the degradation ladder (``degrade.py``): ``ServeResult`` response
   flags + ``pathway_serve_degraded_total{reason=...}`` counters for
-  every rung — rerank_skipped / tail_skipped / extractive_answer /
-  retrieval_failed;
+  every rung — rerank_skipped / late_interaction_skipped /
+  tail_skipped / extractive_answer / retrieval_failed;
 - deterministic fault injection (``inject.py``): named sites
   (``ivf.dispatch``, ``cross_encoder.fetch``, ``exchange.send``,
   ``ivf.absorb``, …) armable to raise / delay / hang via
@@ -33,6 +33,7 @@ launches keep their lock-discipline and budget accounting.
 from .deadline import Deadline, DeadlineExceeded, stage1_fraction
 from .degrade import (
     EXTRACTIVE_ANSWER,
+    LATE_INTERACTION_SKIPPED,
     RERANK_SKIPPED,
     RETRIEVAL_FAILED,
     TAIL_SKIPPED,
@@ -58,6 +59,7 @@ __all__ = [
     "DeadlineExceeded",
     "EXTRACTIVE_ANSWER",
     "FaultInjected",
+    "LATE_INTERACTION_SKIPPED",
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",
     "RetryPolicy",

@@ -5,7 +5,8 @@ degradation ladder — ``robust/retry.py``, ``robust/degrade.py``) must be
 *provable* by a test, and real device/socket failures are neither
 deterministic nor portable to CPU CI.  This registry gives each
 instrumented failure point a NAME — ``ivf.dispatch``,
-``cross_encoder.fetch``, ``exchange.send``, ``ivf.absorb``, … — and
+``cross_encoder.fetch``, ``exchange.send``, ``ivf.absorb``,
+``forward.upload``, ``forward.gather``, ``forward.absorb``, … — and
 lets a test (or an operator running a game-day) arm any site to
 
 - ``raise`` a ``FaultInjected`` (a transient dispatch/socket error),

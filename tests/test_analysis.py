@@ -350,6 +350,75 @@ def test_pragma_without_reason_is_itself_flagged():
 _SERVE_HDR = "# pathway: serve-path\n"
 
 
+def test_lock_discipline_knows_forward_index_cache_getters():
+    """ISSUE 6: the forward-index compiled-fn getters (``_maxsim_fn``,
+    ``_pool_fn``, ``_audit_fn``; ``_token_fn`` on the encoder) are
+    registered cache-getter conventions — a dispatch through one of them
+    under a lock is a lock-discipline violation, exactly like the
+    ``_compiled*``/``_forward_fn`` families."""
+    bad = """
+        import threading
+
+        class ForwardIndex:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def gather(self, qtok, slots):
+                with self._lock:
+                    fn = self._maxsim_fn(4, 32, 16, 8)
+                    return fn(qtok, slots)
+
+        class Encoder:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def tokens(self, ids, mask):
+                with self._lock:
+                    fn = self._token_fn(4, 32)
+                    out = fn(ids, mask)
+                return out
+    """
+    live = _live(_run(bad), "lock-discipline")
+    assert len(live) == 2, "\n".join(f.message for f in live)
+    assert all("jitted dispatch" in f.message for f in live)
+    good = """
+        import threading
+
+        class ForwardIndex:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def gather(self, qtok, slots):
+                with self._lock:
+                    fn = self._maxsim_fn(4, 32, 16, 8)
+                return fn(qtok, slots)
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
+def test_retry_wrapped_forward_gather_is_a_dispatch():
+    """``retry_call("forward.gather", fn, ...)`` with ``fn`` from a
+    ``_maxsim_fn`` getter dispatches — wrapping the gather launch in the
+    robust retry helper must not launder it out of lock-discipline."""
+    bad = """
+        import threading
+
+        from pathway_tpu.robust import retry_call
+
+        class ForwardIndex:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def gather(self, qtok, slots):
+                with self._lock:
+                    fn = self._maxsim_fn(4, 32, 16, 8)
+                    out = retry_call("forward.gather", fn, qtok, slots)
+                return out
+    """
+    live = _live(_run(bad), "lock-discipline")
+    assert len(live) == 1 and "jitted dispatch" in live[0].message
+
+
 def test_hidden_sync_flags_sync_in_dispatch_scope():
     bad = _SERVE_HDR + textwrap.dedent("""
         import jax

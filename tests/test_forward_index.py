@@ -1,0 +1,565 @@
+"""Device-resident forward index + late-interaction rerank tier
+(pathway_tpu/index, ops/maxsim.py, the pluggable stage protocol in
+ops/retrieve_rerank.py).
+
+Correctness bar (CPU fallback backend): the fused gather+MaxSim+top-k
+kernel matches a NumPy reference over the SAME compressed rows, and the
+whole pipeline's ranking matches an independent host re-implementation
+of pooling -> quantization -> MaxSim.  Budget bar: a late-interaction
+serve is 2 dispatches + 2 fetches (gather+MaxSim+top-k fused into the
+single stage-2 dispatch), per BATCH under the coalescing scheduler.
+Maintenance bar: absorb plans off-lock and commits locked with
+generation guards — a concurrent absorb-under-serve storm never breaks
+a serve.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe
+from pathway_tpu.index import ForwardIndex, ForwardUnavailable
+from pathway_tpu.index.forward import forward_quant_mode, forward_tokens_per_doc
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.ops import dispatch_counter
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.maxsim import maxsim_scores_host
+from pathway_tpu.ops.retrieve_rerank import (
+    CrossEncoderStage,
+    LateInteractionStage,
+    RetrieveRerankPipeline,
+)
+from pathway_tpu.ops.serving import FusedEncodeSearch
+from pathway_tpu.serve import ServeScheduler
+
+DOCS = {
+    i: f"document number {i} about {topic} case {i % 7} with live updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+            "mesh collectives", "tokenizer ingest", "serving latency",
+            "cross encoders", "top k selection", "packing rows",
+        ]
+        * 2
+    )
+}
+QUERIES = ["rag retrieval serving", "exactly once stream", "packing rows"]
+T_DOC = 8
+
+
+@pytest.fixture(scope="module")
+def stack():
+    enc = SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    index.add(sorted(DOCS), enc.encode([DOCS[i] for i in sorted(DOCS)]))
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC, initial_capacity=64)
+    assert fwd.add(sorted(DOCS), [DOCS[i] for i in sorted(DOCS)]) == len(DOCS)
+    return enc, index, fwd
+
+
+def _li_pipeline(stack, **kwargs):
+    enc, index, fwd = stack
+    kwargs.setdefault("candidates", 16)
+    return RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), doc_text=DOCS, k=5,
+        forward_index=fwd, **kwargs,
+    )
+
+
+# -- host reference for the whole compression + scoring chain ----------------
+
+def _pool_host(tokens: np.ndarray, mask: np.ndarray, T: int):
+    """NumPy twin of ForwardIndex._pool_fn: contiguous chunk-mean pooling
+    to T rows, L2 normalization, per-channel symmetric int8 scales."""
+    L, d = tokens.shape
+    lens = int(mask.sum())
+    pooled = np.zeros((T, d), np.float32)
+    real = tokens[mask > 0]
+    denom = max(lens, T)
+    seg = np.floor(np.arange(lens) * T / denom).astype(np.int64)
+    for t in range(T):
+        sel = real[seg == t]
+        if len(sel):
+            row = sel.mean(axis=0)
+            pooled[t] = row / max(np.linalg.norm(row), 1e-9)
+    nvalid = min(lens, T)
+    absmax = np.abs(pooled).max(axis=0)
+    scales = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(pooled / scales[None, :]), -127, 127).astype(np.int8)
+    return pooled, q, scales, nvalid
+
+
+def _host_rerank(enc, fwd, query: str, cand_keys):
+    """Independent host re-implementation of the late-interaction stage:
+    encoder token states -> pooling -> int8 quant -> dequant -> MaxSim."""
+    qtok_dev, qmask, _ = enc.encode_token_states([query])
+    qtok = np.asarray(qtok_dev)[0]
+    docs, nvalid = [], []
+    for key in cand_keys:
+        dtok_dev, dmask, _ = enc.encode_token_states([DOCS[key]])
+        _, q, scales, nv = _pool_host(
+            np.asarray(dtok_dev)[0], np.asarray(dmask)[0], fwd.tokens_per_doc
+        )
+        docs.append(q.astype(np.float32) * scales[None, :])
+        nvalid.append(nv)
+    return maxsim_scores_host(
+        qtok, np.asarray(qmask)[0], np.stack(docs), np.asarray(nvalid)
+    )
+
+
+# -- compression ------------------------------------------------------------
+
+def test_pooling_quantization_roundtrip(stack):
+    enc, _, fwd = stack
+    key = 9
+    slot = fwd._slot_of_key[key]
+    stored = np.asarray(fwd._tok[slot]).astype(np.float32) * np.asarray(
+        fwd._scales[slot]
+    )[None, :]
+    tok_dev, mask, _ = enc.encode_token_states([DOCS[key]])
+    want, _, _, nv = _pool_host(
+        np.asarray(tok_dev)[0], np.asarray(mask)[0], T_DOC
+    )
+    assert int(np.asarray(fwd._nvalid[slot])) == nv
+    np.testing.assert_allclose(stored[:nv], want[:nv], atol=2e-2)
+    # valid rows are ~unit-norm after dequantization; invalid rows zero
+    norms = np.linalg.norm(stored[:nv], axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=3e-2)
+    assert np.all(stored[nv:] == 0)
+
+
+def test_hbm_accounting_and_compression(stack):
+    _, _, fwd = stack
+    assert len(fwd) == len(DOCS)
+    assert fwd.hbm_bytes() > 0
+    # int8 rows at a fixed budget compress well below raw f32 states
+    assert fwd.compression_ratio() > 2.0
+    assert fwd._quant_abs_err is not None and fwd._quant_abs_err < 0.2
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("PATHWAY_FORWARD_TOKENS", "32")
+    monkeypatch.setenv("PATHWAY_FORWARD_QUANT", "none")
+    assert forward_tokens_per_doc() == 32
+    assert forward_quant_mode() == "none"
+    monkeypatch.setenv("PATHWAY_FORWARD_QUANT", "bogus")
+    assert forward_quant_mode() == "int8"
+
+
+# -- kernel correctness ------------------------------------------------------
+
+def test_gather_maxsim_matches_host_reference(stack):
+    enc, _, fwd = stack
+    cand = sorted(DOCS)[:12]
+    qtok, qmask, _ = enc.encode_token_states(QUERIES)
+    done, missing = fwd.gather_submit(qtok, qmask, [cand] * 3, k_out=12)
+    scores, perm = done()
+    assert missing == [[], [], []]
+    for qi, query in enumerate(QUERIES):
+        want = _host_rerank(enc, fwd, query, cand)
+        got = np.full(len(cand), -np.inf, np.float32)
+        for j in range(perm.shape[1]):
+            got[int(perm[qi, j])] = scores[qi, j]
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_quant_none_is_the_float_oracle(stack):
+    enc, _, _ = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC, quant="none",
+                       initial_capacity=64)
+    keys = sorted(DOCS)[:16]
+    fwd.add(keys, [DOCS[i] for i in keys])
+    qtok, qmask, _ = enc.encode_token_states(QUERIES[:1])
+    done, _ = fwd.gather_submit(qtok, qmask, [keys], k_out=16)
+    scores, perm = done()
+    # float rows: matches the float half of the host reference tightly
+    dtoks = []
+    nvalid = []
+    for key in keys:
+        tok_dev, mask, _ = enc.encode_token_states([DOCS[key]])
+        pooled, _, _, nv = _pool_host(
+            np.asarray(tok_dev)[0], np.asarray(mask)[0], T_DOC
+        )
+        dtoks.append(pooled)
+        nvalid.append(nv)
+    want = maxsim_scores_host(
+        np.asarray(qtok)[0], np.asarray(qmask)[0],
+        np.stack(dtoks), np.asarray(nvalid),
+    )
+    got = np.full(len(keys), -np.inf, np.float32)
+    for j in range(perm.shape[1]):
+        got[int(perm[0, j])] = scores[0, j]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+# -- pipeline ----------------------------------------------------------------
+
+def test_late_interaction_pipeline_matches_reference(stack):
+    enc, index, fwd = stack
+    pipe = _li_pipeline(stack)
+    got = pipe(QUERIES)
+    assert got.ok, got.degraded
+    # reference: stage-1 candidates reranked by the host MaxSim chain
+    retriever = FusedEncodeSearch(enc, index, k=8)
+    hits = retriever(QUERIES, pipe.candidates)
+    for qi, (query, row) in enumerate(zip(QUERIES, got)):
+        cand = [key for key, _ in hits[qi]]
+        want = _host_rerank(enc, fwd, query, cand)
+        order = np.argsort(-want, kind="stable")[: len(row)]
+        # rank-for-rank with near-tie tolerance (int8 rounding)
+        got_scores = [s for _, s in row]
+        np.testing.assert_allclose(
+            got_scores, want[order], rtol=3e-2, atol=3e-2
+        )
+        assert got_scores == sorted(got_scores, reverse=True)
+
+
+def test_happy_path_budget_two_dispatches_two_fetches(stack):
+    pipe = _li_pipeline(stack)
+    pipe(QUERIES)  # warmup: compiles stage 1 (with token export) + gather
+    with dispatch_counter.DispatchCounter() as counter:
+        got = pipe(QUERIES)
+    assert got and all(got)
+    assert counter.dispatches <= 2, counter.events
+    assert counter.fetches <= 2, counter.events
+    tags = [tag for _, tag in counter.events]
+    assert "rerank_maxsim" in tags
+
+
+def test_cascade_maxsim_then_cross_encoder(stack):
+    enc, index, fwd = stack
+    ce = CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=64,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=4, candidates=16,
+        forward_index=fwd, cascade=8,
+    )
+    assert [s.name for s in pipe.stages] == ["late_interaction", "cross_encoder"]
+    got = pipe(QUERIES)
+    assert got.ok, got.degraded
+    # reference: the cross-encoder's own ordering of the MaxSim top-8
+    li_only = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), doc_text=DOCS, k=8,
+        candidates=16, forward_index=fwd,
+    )
+    li_rows = li_only(QUERIES)
+    for qi, row in enumerate(got):
+        cand = [key for key, _ in li_rows[qi]]
+        scores = ce.predict([(QUERIES[qi], DOCS[k]) for k in cand], packed=False)
+        order = np.argsort(-scores, kind="stable")[:4]
+        want = [cand[j] for j in order]
+        got_keys = [key for key, _ in row]
+        # allow near-tie swaps between packed and unpacked accumulation
+        for a, b in zip(got_keys, want):
+            if a != b:
+                sa = float(scores[cand.index(a)])
+                sb = float(scores[cand.index(b)])
+                assert abs(sa - sb) < 1e-3, (got_keys, want)
+    # cascade = one extra dispatch+fetch on top of the 2+2 happy path
+    pipe(QUERIES)  # warm
+    with dispatch_counter.DispatchCounter() as counter:
+        pipe(QUERIES)
+    assert counter.dispatches <= 3, counter.events
+    assert counter.fetches <= 3, counter.events
+
+
+def test_missing_docs_backfilled_with_stage1_order(stack):
+    enc, index, _ = stack
+    half = ForwardIndex(enc, tokens_per_doc=T_DOC, initial_capacity=64)
+    keys = sorted(DOCS)
+    resident = keys[::2]
+    half.add(resident, [DOCS[i] for i in resident])
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), doc_text=DOCS, k=8,
+        candidates=16, forward_index=half,
+    )
+    got = pipe(QUERIES[:1])
+    assert got.ok, got.degraded  # partial residency is NOT a rung
+    assert len(got[0]) == 8
+    missing = set(got.meta.get("forward_missing", ()))
+    assert missing, "some candidates must have been non-resident"
+    assert all(key not in half for key in missing)
+    # resident candidates lead (MaxSim-scored); any missing ones that
+    # made the cut are backfilled at the tail in stage-1 order
+    keys_out = [key for key, _ in got[0]]
+    in_out = [i for i, k in enumerate(keys_out) if k in missing]
+    if in_out:
+        assert all(k in missing for k in keys_out[min(in_out):])
+    # with a keep wider than the resident pool, backfill MUST appear
+    wide = pipe([QUERIES[0]], k=14)
+    keys_wide = [key for key, _ in wide[0]]
+    assert any(k in set(wide.meta["forward_missing"]) for k in keys_wide)
+
+
+def test_empty_forward_index_serves_stage1_flagged(stack):
+    enc, index, _ = stack
+    empty = ForwardIndex(enc, tokens_per_doc=T_DOC)
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), doc_text=DOCS, k=5,
+        candidates=16, forward_index=empty,
+    )
+    before = observe.counter(
+        "pathway_serve_degraded_total", reason="late_interaction_skipped"
+    ).value
+    got = pipe(QUERIES)
+    assert "late_interaction_skipped" in got.degraded
+    assert got.meta["degraded_reasons"] == ["late_interaction_skipped"]
+    # serves the stage-1 ranking
+    want = pipe.retriever(QUERIES, pipe.candidates)
+    assert got == [list(row[:5]) for row in want]
+    after = observe.counter(
+        "pathway_serve_degraded_total", reason="late_interaction_skipped"
+    ).value
+    assert after == before + 1
+
+
+def test_cold_forward_index_cascade_falls_through_to_cross_encoder(stack):
+    """A stage-0 submit failure (cold forward index) must not rob a
+    healthy cross-encoder tail of its rescore: the cascade falls
+    through, flagged only with the failed stage's rung."""
+    enc, index, _ = stack
+    ce = CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=64,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    cold = ForwardIndex(enc, tokens_per_doc=T_DOC)
+    cascade = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=4, candidates=16,
+        forward_index=cold, cascade=8,
+    )
+    got = cascade(QUERIES)
+    assert got.degraded == ("late_interaction_skipped",), got.degraded
+    # ...and the rows are exactly what a CE-only pipeline over the same
+    # top-8 stage-1 candidates serves (same shapes, bit-identical)
+    ce_only = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=4, candidates=8,
+    )
+    want = ce_only(QUERIES)
+    assert [list(r) for r in got] == [list(r) for r in want]
+
+
+def test_incapable_retriever_fails_at_construction(stack):
+    """A retriever that cannot prove query-token export (duck-typed, HF
+    trunk, non-mean pooling) + a late-interaction stage is a
+    construction error — not a forever-degraded serving mode."""
+    enc, _, fwd = stack
+
+    class DuckRetriever:
+        k = 8
+
+        def submit(self, texts, k):  # pragma: no cover - never dispatched
+            raise AssertionError
+
+    with pytest.raises(ValueError, match="query token states"):
+        RetrieveRerankPipeline(
+            DuckRetriever(), doc_text=DOCS, k=5, candidates=16,
+            forward_index=fwd,
+        )
+
+
+def test_remove_upsert_and_slot_reuse(stack):
+    enc, _, _ = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC, initial_capacity=64)
+    keys = sorted(DOCS)[:8]
+    fwd.add(keys, [DOCS[i] for i in keys])
+    gen0 = fwd.generation
+    slot3 = fwd._slot_of_key[keys[3]]
+    fwd.remove([keys[3]])
+    assert keys[3] not in fwd and len(fwd) == 7
+    # the freed slot is reused by the next add
+    fwd.add([999], ["a fresh replacement document about slot reuse"])
+    assert fwd._slot_of_key[999] == slot3
+    assert fwd.generation > gen0
+    # upsert: same key, new text, stays on one slot
+    n_before = len(fwd)
+    fwd.add([999], ["completely different text for the same key"])
+    assert len(fwd) == n_before
+    assert fwd._slot_of_key[999] == slot3
+
+
+def test_gather_raises_unavailable_when_nothing_resident(stack):
+    enc, _, _ = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC)
+    qtok, qmask, _ = enc.encode_token_states(["q"])
+    with pytest.raises(ForwardUnavailable):
+        fwd.gather_submit(qtok, qmask, [[1, 2]], k_out=2)
+    with pytest.raises(ForwardUnavailable):
+        # no query token states (stage-1 export off / HF trunk)
+        fwd.gather_submit(None, qmask, [[1, 2]], k_out=2)
+
+
+# -- absorb/commit discipline ------------------------------------------------
+
+def test_concurrent_absorb_under_serve(stack):
+    """The acceptance bar: forward-index absorb (plan off-lock, commit
+    locked, donated scatter, capacity growth) runs UNDER live serving —
+    every serve returns a valid ranking, none raises, and the index ends
+    complete."""
+    enc, index, _ = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC, initial_capacity=64)
+    keys = sorted(DOCS)
+    fwd.add(keys[:12], [DOCS[i] for i in keys[:12]])  # warm shapes
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), doc_text=DOCS, k=5,
+        candidates=16, forward_index=fwd,
+    )
+    pipe(QUERIES)  # warm serve shapes
+    stop = threading.Event()
+    errors = []
+
+    def ingest():
+        try:
+            for start in range(12, len(keys), 6):
+                batch = keys[start : start + 6]
+                fwd.add(batch, [DOCS[i] for i in batch])
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+        finally:
+            stop.set()
+
+    t = threading.Thread(target=ingest)
+    t.start()
+    serves = 0
+    while not stop.is_set() or serves < 4:
+        got = pipe(QUERIES)
+        assert len(got) == len(QUERIES)
+        assert all(len(row) == 5 for row in got), got
+        serves += 1
+        if serves > 500:  # pragma: no cover
+            break
+    t.join(timeout=60)
+    assert not errors, errors
+    assert len(fwd) == len(DOCS)
+    # steady state after the churn: clean, fully-resident serves
+    got = pipe(QUERIES)
+    assert got.ok, got.degraded
+    assert "forward_missing" not in got.meta
+
+
+def test_commit_staleness_guard_drops_removed_keys(stack):
+    """A key removed (or re-upserted) while an absorb plan ran off-lock
+    must NOT be resurrected/overwritten by that plan's commit — the
+    version snapshot taken at add() entry gates every committed row."""
+    enc, _, _ = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC, initial_capacity=64)
+    keys = sorted(DOCS)[:4]
+    fwd.add(keys, [DOCS[i] for i in keys])
+    # simulate the race deterministically: snapshot + plan, then mutate
+    # the key before the commit lands
+    with fwd._lock:
+        versions = {keys[0]: fwd._key_version.get(keys[0], 0)}
+    plan = fwd._plan_absorb([keys[0]], ["stale text planned pre-remove"])
+    plan["versions"] = versions
+    fwd.remove([keys[0]])
+    with fwd._lock:
+        committed = fwd._commit_absorb(plan)
+    assert committed == 0
+    assert keys[0] not in fwd, "a removed key must not be resurrected"
+    assert len(fwd) == 3
+
+
+def test_failed_upload_rolls_back_free_slots(stack):
+    """A commit that fails at the device scatter must return its popped
+    free-list slots — leaking them would force spurious capacity
+    doublings of the token store under repeated failures."""
+    from pathway_tpu.robust import inject
+
+    enc, _, _ = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC, initial_capacity=64)
+    keys = sorted(DOCS)[:4]
+    fwd.add(keys, [DOCS[i] for i in keys])
+    fwd.remove(keys[:2])
+    free_before = sorted(fwd._free)
+    assert len(free_before) == 2
+    with inject.armed("forward.upload", "raise"):
+        assert fwd.add([900, 901], ["fresh a", "fresh b"]) == 0
+    assert sorted(fwd._free) == free_before, "popped slots must roll back"
+    assert fwd.add([900, 901], ["fresh a", "fresh b"]) == 2
+
+
+def test_generation_guard_counts_growth_and_commits(stack):
+    enc, _, _ = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=T_DOC, initial_capacity=64)
+    keys = sorted(DOCS)
+    fwd.add(keys[:4], [DOCS[i] for i in keys[:4]])
+    gen1 = fwd.generation  # growth + commit
+    fwd.add(keys[4:8], [DOCS[i] for i in keys[4:8]])
+    assert fwd.generation > gen1  # every commit bumps
+    assert fwd._capacity == 64
+    # pushing past capacity doubles it (and bumps the generation again)
+    fwd.add(keys[8:], [DOCS[i] for i in keys[8:]])
+    assert fwd._capacity >= len(DOCS)
+
+
+# -- scheduler + metrics -----------------------------------------------------
+
+def test_scheduler_rides_late_interaction_budget_at_c16(stack):
+    """The coalescing scheduler fronts the late-interaction pipeline
+    UNCHANGED: 16 concurrent riders (hot duplicates included) coalesce
+    into one shared batch that costs 2 dispatches + 2 fetches TOTAL —
+    the happy-path budget is per batch, not per request."""
+    pipe = _li_pipeline(stack)
+    pipe(QUERIES)  # warm shared shapes
+    riders = [QUERIES[i % len(QUERIES)] for i in range(16)]
+    results, errors = {}, []
+    with ServeScheduler(pipe, window_us=200_000) as sched:
+        with dispatch_counter.DispatchCounter() as counter:
+            barrier = threading.Barrier(len(riders))
+
+            def worker(i, q):
+                try:
+                    barrier.wait(timeout=10)
+                    results[i] = sched.serve([q])
+                except Exception as exc:
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=worker, args=(i, q))
+                for i, q in enumerate(riders)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        assert not errors, errors
+        assert sched.stats["batches"] == 1, sched.stats
+        assert sched.stats["dedup_hits"] >= 13, sched.stats
+    assert counter.dispatches <= 2, counter.events
+    assert counter.fetches <= 2, counter.events
+    # every rider got its own demuxed rows
+    solo = {q: pipe([q]) for q in QUERIES}
+    for i, q in enumerate(riders):
+        assert [k for k, _ in results[i][0]] == [k for k, _ in solo[q][0]]
+
+
+def test_forward_metrics_on_scrape_surface(stack):
+    _, _, fwd = stack
+    text = "\n".join(observe.render_prometheus())
+    for name in (
+        "pathway_forward_docs",
+        "pathway_forward_rows_resident",
+        "pathway_forward_tokens_stored",
+        "pathway_forward_hbm_bytes",
+        "pathway_forward_compression_ratio",
+        "pathway_forward_quant_abs_err",
+        "pathway_forward_absorbs_total",
+        "pathway_forward_gathers_total",
+        "pathway_forward_absorb_failures_total",
+        "pathway_forward_gather_rows_total",
+        "pathway_forward_absorb_seconds",
+        "pathway_forward_upload_seconds",
+    ):
+        assert name in text, f"{name} missing from the scrape surface"

@@ -10,7 +10,7 @@ Sites covered: serve.dispatch, serve.fetch, ivf.dispatch,
 ivf.tail_upload, ivf.absorb, ivf.retrain, rerank.dispatch,
 cross_encoder.dispatch, cross_encoder.fetch, encoder.dispatch,
 generator.dispatch, generator.chat, clip.dispatch, exchange.send,
-qa.rerank.
+qa.rerank, forward.absorb, forward.upload, forward.gather.
 
 Plus: Deadline / RetryPolicy / CircuitBreaker / ServeResult units,
 ``PATHWAY_FAULTS`` parsing, the missing-doc response-metadata
@@ -780,6 +780,130 @@ def test_missing_doc_visible_in_response_metadata(stack):
     )
     got2 = pipe2(QUERIES)
     assert got2.meta.get("missing_docs", ()) == missing
+
+
+# -- chaos: forward index / late interaction (pathway_tpu/index) -------------
+
+
+def _forward_stack(stack, ingest: bool = True):
+    """A late-interaction pipeline over the module's exact index plus a
+    freshly ingested ForwardIndex."""
+    from pathway_tpu.index import ForwardIndex
+
+    enc, _, index = stack
+    fwd = ForwardIndex(enc, tokens_per_doc=8, initial_capacity=64)
+    if ingest:
+        keys = sorted(DOCS)
+        assert fwd.add(keys, [DOCS[i] for i in keys]) == len(keys)
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), doc_text=DOCS, k=5,
+        candidates=16, forward_index=fwd,
+    )
+    return fwd, pipe
+
+
+def test_forward_gather_transient_failure_retries(stack):
+    fwd, pipe = _forward_stack(stack)
+    clean = pipe(QUERIES)
+    assert clean.ok
+    with inject.armed("forward.gather", "raise", times=1):
+        got = pipe(QUERIES)
+    assert got == clean and got.ok, got.degraded
+
+
+def test_forward_gather_failure_serves_previous_stage(stack):
+    fwd, pipe = _forward_stack(stack)
+    pipe(QUERIES)  # warm
+    want = _stage1_reference(pipe, QUERIES)
+    before = _degraded("late_interaction_skipped")
+    with inject.armed("forward.gather", "raise"):
+        got = pipe(QUERIES)
+    assert "late_interaction_skipped" in got.degraded
+    assert got == want, "degraded serve must be the stage-1 ranking"
+    assert _degraded("late_interaction_skipped") == before + 1
+    # recovery is automatic once the fault clears
+    assert pipe(QUERIES).ok
+
+
+def test_forward_gather_deadline_tight_degrades(stack):
+    fwd, pipe = _forward_stack(stack)
+    pipe(QUERIES)  # warm
+    handle = pipe.submit(QUERIES, deadline=Deadline.after_ms(250))
+    time.sleep(0.3)  # budget gone between submit and completion
+    got = handle()
+    assert "late_interaction_skipped" in got.degraded
+    assert got == _stage1_reference(pipe, QUERIES)
+
+
+def test_forward_absorb_failure_is_counted_not_raised(stack):
+    fwd, pipe = _forward_stack(stack, ingest=False)
+    keys = sorted(DOCS)
+    with inject.armed("forward.absorb", "raise"):
+        assert fwd.add(keys[:8], [DOCS[i] for i in keys[:8]]) == 0
+    assert fwd.stats["absorb_failures"] == 1
+    assert len(fwd) == 0
+    # serving still works — the empty forward index is a flagged rung
+    got = pipe(QUERIES)
+    assert "late_interaction_skipped" in got.degraded
+    # the next (clean) add recovers
+    assert fwd.add(keys[:8], [DOCS[i] for i in keys[:8]]) == 8
+    assert len(fwd) == 8
+
+
+def test_forward_upload_failure_is_counted_not_raised(stack):
+    fwd, _ = _forward_stack(stack, ingest=False)
+    keys = sorted(DOCS)[:8]
+    with inject.armed("forward.upload", "raise"):
+        assert fwd.add(keys, [DOCS[i] for i in keys]) == 0
+    assert fwd.stats["upload_failures"] == 1
+    assert len(fwd) == 0, "a failed commit must not map keys to slots"
+    assert fwd.add(keys, [DOCS[i] for i in keys]) == 8
+
+
+def test_stacked_degradation_reports_every_rung_once(stack):
+    """ISSUE 6 satellite regression: two ladder rungs firing in ONE
+    serve (tail_skipped from stage 1 + late_interaction_skipped from
+    stage 2) must BOTH appear on ``ServeResult.degraded`` (each once),
+    both be mirrored into ``meta["degraded_reasons"]``, and each bump
+    ``pathway_serve_degraded_total`` exactly once."""
+    from pathway_tpu.index import ForwardIndex
+
+    enc, _, _ = stack
+    ivf = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=8, n_probe=8,
+        absorb_threshold=4096,
+    )
+    keys = sorted(DOCS)
+    vecs = enc.encode([DOCS[i] for i in keys])
+    ivf.add(keys[:24], vecs[:24])
+    ivf.build()
+    ivf.add(keys[24:], vecs[24:])  # rides the exact tail
+    fwd = ForwardIndex(enc, tokens_per_doc=8, initial_capacity=64)
+    fwd.add(keys, [DOCS[i] for i in keys])
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, ivf, k=8), doc_text=DOCS, k=5,
+        candidates=16, forward_index=fwd,
+    )
+    clean = pipe(QUERIES)
+    assert clean.ok, clean.degraded
+    before_tail = _degraded("tail_skipped")
+    before_li = _degraded("late_interaction_skipped")
+    with ivf._lock:
+        ivf._tail_cache = None  # force a tail re-upload on the next serve
+    with inject.armed("ivf.tail_upload", "raise"):
+        with inject.armed("forward.gather", "raise"):
+            got = pipe(QUERIES)
+    assert got.degraded == ("tail_skipped", "late_interaction_skipped"), (
+        got.degraded
+    )
+    assert got.meta["degraded_reasons"] == [
+        "tail_skipped", "late_interaction_skipped",
+    ]
+    assert _degraded("tail_skipped") == before_tail + 1
+    assert _degraded("late_interaction_skipped") == before_li + 1
+    # both rungs clear on the next clean serve
+    got2 = pipe(QUERIES)
+    assert got2.ok, got2.degraded
 
 
 # -- happy path: budget + surface -------------------------------------------
