@@ -924,6 +924,155 @@ def phase_concurrent_serve(backend: str, extras: dict) -> float:
     return round(speedup_c16, 3)
 
 
+def phase_sharded_serve(backend: str, extras: dict) -> float:
+    """Sharded serving (ISSUE 7 / ROADMAP item 1): the SAME coalescing
+    serve stack over a 1-shard vs an N-shard ``ShardedIvfIndex`` (N = 8
+    forced host devices on CPU, the physical chip count on TPU), driven
+    by 16 concurrent single-query callers.  Reports QPS + p50/p99 per
+    shard count, the on-device hierarchical merge's share of serve
+    latency (A/B against the host-merge probe, the MULTICHIP_r05
+    methodology: ``merge_share = (global_topk - per_shard_only) /
+    global_topk``, clamped at 0), and the dead-shard ladder (one shard
+    down ⇒ every serve flagged ``shard_skipped``, zero errors).  Phase
+    value: merge share as a percentage of serve latency (acceptance bar
+    < 5%)."""
+    if backend == "cpu" and "xla_force_host_platform_device_count" not in (
+        os.environ.get("XLA_FLAGS", "")
+    ):
+        # the shard axis must be real before the first backend init
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax = _init_jax(backend)
+
+    from pathway_tpu import observe
+    from pathway_tpu.models.encoder import SentenceEncoder
+    from pathway_tpu.ops.ivf import ShardedIvfIndex
+    from pathway_tpu.ops.serving import FusedEncodeSearch
+    from pathway_tpu.robust import SHARD_SKIPPED, inject
+    from pathway_tpu.serve import ServeScheduler
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_devices = len(jax.devices())
+    n_shards = min(8, n_devices)
+    extras["n_devices"] = n_devices
+    n_docs = int(os.environ.get("BENCH_SS_DOCS", "40000" if on_tpu else "2000"))
+    docs = _corpus_texts(n_docs)
+    dims = dict(dimension=128, n_layers=2, n_heads=4, max_length=64,
+                vocab_size=2048)
+    if on_tpu:
+        dims = dict(dimension=384, n_layers=4, n_heads=8, max_length=64,
+                    vocab_size=8192)
+    enc = SentenceEncoder(**dims)
+    keys = list(range(n_docs))
+    vecs = enc.encode(docs)
+    pool = [" ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(64)]
+    k = 10
+    conc = int(os.environ.get("BENCH_SS_CONC", "16"))
+    n_req = int(os.environ.get("BENCH_SS_REQUESTS", str(conc * 12)))
+
+    def build(shards: int) -> FusedEncodeSearch:
+        idx = ShardedIvfIndex(
+            int(enc.config.d_model), metric="cos", n_shards=shards,
+            absorb_threshold=100_000,
+        )
+        idx.add(keys, vecs)
+        idx.build()
+        return FusedEncodeSearch(enc, idx, k=k)
+
+    def drive(serve: FusedEncodeSearch, tag: str):
+        sched = ServeScheduler(serve, window_us=5000, max_batch=16)
+        lats: list = [None] * n_req
+        errors: list = []
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    t0 = time.perf_counter()
+                    rows = sched.serve([pool[(i * 7) % len(pool)]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    assert rows and rows[0]
+            except Exception as exc:
+                errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        t_all = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_all
+        sched.stop()
+        if errors:
+            raise RuntimeError(f"sharded_serve {tag} failed: {errors[:3]}")
+        done = np.asarray([l for l in lats if l is not None])
+        extras[f"qps_{tag}_c{conc}"] = round(n_req / elapsed, 2)
+        extras[f"p50_{tag}_c{conc}_ms"] = round(float(np.percentile(done, 50)), 3)
+        extras[f"p99_{tag}_c{conc}_ms"] = round(float(np.percentile(done, 99)), 3)
+        return n_req / elapsed
+
+    serve1 = build(1)
+    serveN = build(n_shards)
+    for q in pool:  # warm both arms' compile shapes
+        serve1([q], k)
+        serveN([q], k)
+    for b in (2, 4, 8, 16):
+        batch = sorted(set(pool))[:b]
+        serve1(batch, k)
+        serveN(batch, k)
+    drive(serve1, "shards1")  # unmeasured pre-pass per arm, then measured
+    qps1 = drive(serve1, "shards1")
+    drive(serveN, f"shards{n_shards}")
+    qpsN = drive(serveN, f"shards{n_shards}")
+    extras["sharded_qps_ratio"] = round(qpsN / max(qps1, 1e-9), 3)
+
+    # merge share: global-topk (device tree merge, one fetch) vs
+    # per-shard-only (skip the merge kernel, fetch every shard's list,
+    # merge on host) — the MULTICHIP_r05 dryrun methodology
+    probe = pool[:16]
+    reps = int(os.environ.get("BENCH_SS_MERGE_REPS", "30"))
+    serveN(probe, k)
+    times = {}
+    for mode in ("device", "host"):
+        serveN.shard_host_merge = mode == "host"
+        serveN(probe, k)  # warm this arm
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            serveN(probe, k)
+            samples.append((time.perf_counter() - t0) * 1e3)
+        times[mode] = float(np.percentile(samples, 50))
+    serveN.shard_host_merge = False
+    merge_share = max(0.0, (times["device"] - times["host"]) / times["device"])
+    extras["global_topk_p50_ms"] = round(times["device"], 3)
+    extras["per_shard_only_p50_ms"] = round(times["host"], 3)
+    extras["merge_share_pct"] = round(merge_share * 100.0, 2)
+    observe.gauge("pathway_serve_shard_merge_share").set(merge_share)
+
+    # dead-shard ladder: one shard down for a whole serve burst — every
+    # serve flagged shard_skipped, zero exceptions
+    dead = n_shards - 1
+    degraded = 0
+    with inject.armed(f"shard.dispatch.{dead}", "raise"):
+        for i in range(16):
+            rows = serveN([pool[i % len(pool)]], k)
+            assert rows and rows[0]
+            degraded += SHARD_SKIPPED in rows.degraded
+    extras["dead_shard_degraded_serves"] = degraded
+    extras["dead_shard_errors"] = 0
+    clean = serveN([pool[0]], k)
+    assert clean.degraded == ()
+    extras["n_shards"] = n_shards
+    return extras["merge_share_pct"]
+
+
 _PEAK_BF16_FLOPS = {
     # per-chip peak dense bf16 FLOP/s by device_kind substring
     "v6": 918e12,
@@ -1595,6 +1744,7 @@ _PHASES = {
     "observe_overhead": (phase_observe_overhead, 450),
     "fault_tolerance": (phase_fault_tolerance, 450),
     "concurrent_serve": (phase_concurrent_serve, 600),
+    "sharded_serve": (phase_sharded_serve, 600),
     "ingest": (phase_ingest, 900),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
@@ -1750,6 +1900,7 @@ def main() -> None:
         ("observe_overhead", lambda: device_phase("observe_overhead")),
         ("fault_tolerance", lambda: device_phase("fault_tolerance")),
         ("concurrent_serve", lambda: device_phase("concurrent_serve")),
+        ("sharded_serve", lambda: device_phase("sharded_serve")),
         ("ingest", lambda: device_phase("ingest")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)
@@ -1775,6 +1926,8 @@ def main() -> None:
             extras["fault_overhead_pct"] = round(value, 3)
         elif name == "concurrent_serve" and value is not None:
             extras["serve_coalesce_speedup_c16"] = round(value, 3)
+        elif name == "sharded_serve" and value is not None:
+            extras["sharded_merge_share_pct"] = round(value, 2)
         elif name == "ingest" and value is not None:
             extras["ingest_docs_per_sec"] = round(value, 1)
         elif name == "wordcount" and value is not None:

@@ -49,10 +49,16 @@ __all__ = [
 # cache getters that hand back per-shape jitted callables (the repo-wide
 # naming convention for compiled-fn caches).  _token_fn/_pool_fn/
 # _maxsim_fn/_audit_fn are the forward-index family (models/encoder.py
-# token-state export + pathway_tpu/index/forward.py ingest and gather).
+# token-state export + pathway_tpu/index/forward.py ingest and gather);
+# _encode_fn/_shard_search_fn/_merge_fn/_table_fn/_scatter_fn are the
+# sharded-serve family (ops/serving.py scatter-dispatch fan-out + tree
+# merge, index/forward.py per-shard tables + max-merge, ops/knn.py
+# sharded scatters).  Tuple-returning getters (e.g. _shard_search_fn ->
+# (fn, n_slotspace)) bind only their FIRST unpack target as the callee.
 _CACHE_GETTER_RE = re.compile(
     r"^_(compiled\w*|forward_fn|packed_fn|search_fn"
-    r"|token_fn|pool_fn|maxsim_fn|audit_fn)$"
+    r"|token_fn|pool_fn|maxsim_fn|audit_fn"
+    r"|encode_fn|shard_search_fn|merge_fn|table_fn|scatter_fn)$"
 )
 _LOCK_NAME_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
 _JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}

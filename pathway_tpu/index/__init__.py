@@ -13,6 +13,7 @@ generation/staleness guards.
 from .forward import (
     ForwardIndex,
     ForwardUnavailable,
+    ShardedForwardIndex,
     forward_quant_mode,
     forward_tokens_per_doc,
 )
@@ -20,6 +21,7 @@ from .forward import (
 __all__ = [
     "ForwardIndex",
     "ForwardUnavailable",
+    "ShardedForwardIndex",
     "forward_quant_mode",
     "forward_tokens_per_doc",
 ]

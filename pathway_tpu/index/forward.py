@@ -56,13 +56,18 @@ import numpy as np
 
 from .. import observe
 from ..ops.dispatch_counter import record_dispatch, record_fetch
-from ..ops.maxsim import build_maxsim_kernel
+from ..ops.maxsim import (
+    build_maxsim_kernel,
+    build_maxsim_table_kernel,
+    build_table_merge_kernel,
+)
 from ..ops.recompile_guard import RecompileTripwire
 from ..robust import RetryPolicy, inject, log_once, retry_call
 
 __all__ = [
     "ForwardIndex",
     "ForwardUnavailable",
+    "ShardedForwardIndex",
     "forward_quant_mode",
     "forward_tokens_per_doc",
 ]
@@ -699,4 +704,264 @@ class ForwardIndex:
                 "pathway_forward_gather_rows_total",
                 {**labels, "kind": kind},
                 self.stats[key],
+            )
+
+
+class _ShardForward(ForwardIndex):
+    """One shard-resident forward partition: commits pin the row buckets
+    to the shard's device.  The absorb PLAN (encoder dispatch + pool +
+    quantize) stays on the model's device — only the ~10x-compressed
+    rows ship to the owning shard at commit time, exactly the traffic
+    shape the compression exists for."""
+
+    def __init__(self, *args, device=None, **kwargs):
+        self._device = device
+        super().__init__(*args, **kwargs)
+
+    def _commit_absorb(self, plan):
+        if self._device is None:
+            return super()._commit_absorb(plan)
+        plan = dict(plan)
+        for field in ("q", "scales", "nvalid"):
+            plan[field] = jax.device_put(plan[field], self._device)
+        with jax.default_device(self._device):
+            return super()._commit_absorb(plan)
+
+
+class ShardedForwardIndex:
+    """Document-sharded forward index over the SAME serve device group
+    (``parallel.ShardGroup``) as the sharded IVF tier: a document's
+    compressed token rows live on the shard that owns its IVF postings,
+    so the late-interaction rerank gathers ONLY from each candidate's
+    owning shard — no shard ever touches (or stores) rows for documents
+    it doesn't own.
+
+    Serve path: per shard, gather+dequantize+MaxSim produce the raw
+    ``[B, Kc]`` candidate score table (``-inf`` for candidates the shard
+    doesn't own — ownership is disjoint by routing, so every cell has at
+    most one finite contributor); the tables hop to the merge device and
+    one elementwise-max + top-k kernel emits the same packed output the
+    single-index kernel produces.  The merged table is bit-identical to
+    an unsharded ``ForwardIndex`` holding every row, one logical
+    dispatch + one fetch either way (per-shard-group accounting carries
+    the physical fan-out).
+
+    ``gather_submit`` keeps the single-index contract, so
+    ``LateInteractionStage`` drops it in unchanged."""
+
+    def __init__(
+        self,
+        encoder,
+        group=None,
+        n_shards: Optional[int] = None,
+        devices: Optional[Sequence] = None,
+        tokens_per_doc: Optional[int] = None,
+        quant: Optional[str] = None,
+        initial_capacity: int = 1024,
+    ):
+        from ..parallel.shards import ShardGroup
+
+        self.group = group or ShardGroup(n_shards=n_shards, devices=devices)
+        self.encoder = encoder
+        self.tokens_per_doc = tokens_per_doc or forward_tokens_per_doc()
+        self.quant = quant if quant in ("int8", "none") else forward_quant_mode()
+        self.dimension = int(encoder.config.d_model)
+        self._lock = threading.Lock()
+        self._gen_base = 0
+        self.shards: List[_ShardForward] = [
+            _ShardForward(
+                encoder,
+                device=self.group.device(s),
+                tokens_per_doc=self.tokens_per_doc,
+                quant=self.quant,
+                initial_capacity=initial_capacity,
+            )
+            for s in range(self.group.n_shards)
+        ]
+        self._fns: Dict[Tuple, Any] = {}
+        self._tripwire = RecompileTripwire("ShardedForwardIndex")
+        self.stats = {"route_drops": 0, "route_drop_docs": 0}
+        self._observe_id = observe.next_id()
+        observe.register_provider(self)
+
+    # -- introspection ------------------------------------------------------
+    def __len__(self) -> int:
+        return sum(len(c) for c in self.shards)
+
+    def __contains__(self, key: int) -> bool:
+        key = int(key)
+        return key in self.shards[self.group.owner_of(key)]
+
+    @property
+    def generation(self) -> int:
+        return self._gen_base + sum(c.generation for c in self.shards)
+
+    def hbm_bytes(self) -> int:
+        return sum(c.hbm_bytes() for c in self.shards)
+
+    # -- ingest (routed to the owning shard) --------------------------------
+    def add(self, keys: Sequence[int], texts: Sequence[str]) -> int:
+        keys = [int(k) for k in keys]
+        if not keys:
+            return 0
+        committed = 0
+        for s, rows in sorted(self.group.route(keys).items()):
+            try:
+                inject.fire(f"shard.absorb.{s}")
+                inject.fire("shard.absorb")
+                committed += self.shards[s].add(
+                    [keys[i] for i in rows], [texts[i] for i in rows]
+                )
+            except Exception as exc:
+                with self._lock:
+                    self.stats["route_drops"] += 1
+                    self.stats["route_drop_docs"] += len(rows)
+                    self._gen_base += 1
+                log_once(
+                    f"shard.absorb.forward:{type(exc).__name__}",
+                    "sharded forward ingest to shard %d failed (%r); its "
+                    "documents stay out of the forward index only "
+                    "(late-interaction degrades, serving continues)",
+                    s,
+                    exc,
+                )
+        return committed
+
+    def remove(self, keys: Sequence[int]) -> None:
+        keys = [int(k) for k in keys]
+        for s, rows in sorted(self.group.route(keys).items()):
+            self.shards[s].remove([keys[i] for i in rows])
+
+    # -- compiled fns -------------------------------------------------------
+    def _table_fn(self, B: int, Lq: int, Kc: int, capacity: int):
+        key = ("table", B, Lq, Kc, capacity, self.tokens_per_doc)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                self._tripwire.observe(key)
+                fn = self._fns[key] = build_maxsim_table_kernel(
+                    B, Lq, Kc, self.tokens_per_doc, self.quant == "int8"
+                )
+            return fn
+
+    def _merge_fn(self, S: int, B: int, Kc: int, k_out: int):
+        key = ("merge", S, B, Kc, k_out)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                self._tripwire.observe(key)
+                fn = self._fns[key] = build_table_merge_kernel(S, B, Kc, k_out)
+            return fn
+
+    # -- serve-path gather --------------------------------------------------
+    def gather_submit(
+        self,
+        query_tokens,
+        query_mask: np.ndarray,
+        cand_keys: List[List[int]],
+        k_out: int,
+        deadline=None,
+        width: Optional[int] = None,
+    ):
+        """Sharded flavor of ``ForwardIndex.gather_submit`` — same
+        contract, so the late-interaction stage is unchanged.  Fan-out:
+        the stage-1 query token states hop to each owning shard, every
+        shard scores ONLY the candidates it owns, and the merge device
+        max-combines the disjoint tables into one packed top-k.  A
+        candidate resident on NO shard is ``missing`` and backfilled by
+        the caller from the previous stage's ordering."""
+        if query_tokens is None:
+            raise ForwardUnavailable("no query token states from stage 1")
+        B, Lq = int(query_tokens.shape[0]), int(query_tokens.shape[1])
+        nq = len(cand_keys)
+        longest = max((len(row) for row in cand_keys), default=0)
+        Kc = max(int(width) if width else longest, longest, 1)
+        k_out = min(int(k_out), Kc)
+        if deadline is not None:
+            deadline.check("forward.gather")
+        qmask_np = np.asarray(query_mask, np.float32)
+        tables: List[Any] = []
+        physical = 0
+        owned = np.zeros((B, Kc), bool)
+        n_cand = sum(len(row) for row in cand_keys)
+        for s, child in enumerate(self.shards):
+            dev = self.group.device(s)
+            with child._lock:
+                if child._tok is None or not child._slot_of_key:
+                    continue
+                slots = np.full((B, Kc), -1, np.int32)
+                any_owned = False
+                for qi, row in enumerate(cand_keys):
+                    for j, key in enumerate(row[:Kc]):
+                        slot = child._slot_of_key.get(int(key))
+                        if slot is not None:
+                            slots[qi, j] = slot
+                            owned[qi, j] = True
+                            any_owned = True
+                if not any_owned:
+                    continue
+                fn = self._table_fn(B, Lq, Kc, child._capacity)
+                with jax.default_device(dev):
+                    qtok_s = jax.device_put(query_tokens, dev)  # pathway: allow(lock-discipline): device→device scatter of the UNFETCHED stage-1 query token states — an async ICI hop, not a host transfer; it must precede the gather launch that consumes it under this lock
+                    out = retry_call(  # pathway: allow(lock-discipline, recompile-hazard): dispatch-only — the shard's donated absorb buffers force launch-before-unlock (fetch happens after the merge, off-lock); shapes pinned like the single-index gather
+                        "forward.gather",
+                        fn,
+                        qtok_s,
+                        jnp.asarray(qmask_np),
+                        child._tok,
+                        child._scales,
+                        child._nvalid,
+                        jnp.asarray(slots),
+                        deadline=deadline,
+                        policy=_GATHER_RETRY,
+                    )
+                child.stats["gathers"] += 1
+            tables.append(out)
+            physical += 1
+        missing: List[List[int]] = []
+        n_missing = 0
+        for qi, row in enumerate(cand_keys):
+            miss = [j for j in range(len(row[:Kc])) if not owned[qi, j]]
+            n_missing += len(miss)
+            missing.append(miss)
+        if not tables or n_missing >= n_cand:
+            raise ForwardUnavailable("no candidate is resident on any shard")
+        merge_dev = getattr(query_tokens, "device", None) or self.group.device(0)
+        moved = [jax.device_put(t, merge_dev) for t in tables]
+        mfn = self._merge_fn(len(moved), B, Kc, k_out)
+        out = retry_call(
+            "shard.merge", mfn, *moved, deadline=deadline, policy=_GATHER_RETRY
+        )
+        record_dispatch("rerank_maxsim", shards=physical + 1)
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        observe.record_occupancy("forward_gather", n_cand, B * Kc)
+
+        def complete() -> Tuple[np.ndarray, np.ndarray]:
+            inject.fire("forward.gather.fetch", deadline=deadline)
+            if deadline is not None:
+                deadline.check("forward.gather.fetch")
+            arr = np.asarray(out)[:nq]
+            record_fetch("rerank_maxsim")
+            scores = np.ascontiguousarray(arr[:, :k_out]).view(np.float32)
+            perm = arr[:, k_out:]
+            return scores, perm
+
+        return complete, missing
+
+    # -- flight-recorder provider ------------------------------------------
+    def observe_metrics(self):
+        labels = {"index": str(self._observe_id)}
+        yield (
+            "counter",
+            "pathway_serve_shard_ingest_drops_total",
+            {**labels, "tier": "forward"},
+            self.stats["route_drops"],
+        )
+        for s, child in enumerate(self.shards):
+            yield (
+                "gauge",
+                "pathway_serve_shard_forward_docs",
+                {**labels, "shard": str(s)},
+                len(child),
             )

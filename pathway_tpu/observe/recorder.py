@@ -371,10 +371,19 @@ def render_prometheus() -> List[str]:
     return lines
 
 
+def _shard_sort_key(shard: str):
+    try:
+        return (0, int(shard))
+    except ValueError:
+        return (1, shard)
+
+
 def snapshot() -> Dict[str, Any]:
     """JSON-able view for ``GET /serve_stats``: per-series histogram
     summaries (count/sum/p50/p95/p99 bucket-bound estimates), counters,
-    gauges (provider-sampled), and the recent event ring."""
+    gauges (provider-sampled), a per-shard column (every provider
+    sample labeled ``shard=...`` grouped by shard id), and the recent
+    event ring."""
     with _registry_lock:
         hist_items = {name: dict(series) for name, series in _hists.items()}
         counter_items = {
@@ -406,15 +415,33 @@ def snapshot() -> Dict[str, Any]:
         for name, series in gauge_items.items()
         for key, g in series.items()
     }
+    # the shard column: any provider sample carrying a "shard" label is
+    # ALSO grouped per shard id, so /serve_stats shows one row per shard
+    # (resident vectors, tail size, skips, breaker state, forward docs)
+    # without the reader having to parse Prometheus label strings.  The
+    # remaining labels stay ON the per-shard key — several sharded
+    # structures (two replicas' groups, a 1-shard vs 8-shard bench pair)
+    # legitimately report the same metric for the same shard id, and
+    # keying by bare metric name would let whichever provider iterates
+    # last silently overwrite the others
+    shards: Dict[str, Dict[str, float]] = {}
     for kind, name, key, value in _provider_samples():
         target = counters if kind == "counter" else gauges
         target[series_name(name, key)] = value
+        labels = dict(key)
+        shard = labels.get("shard")
+        if shard is not None:
+            rest = tuple(
+                (lk, lv) for lk, lv in key if lk != "shard"
+            )
+            shards.setdefault(shard, {})[series_name(name, rest)] = value
     events, total = _ring.snapshot()
     return {
         "enabled": _state.enabled,
         "histograms": hists,
         "counters": counters,
         "gauges": gauges,
+        "shards": {k: shards[k] for k in sorted(shards, key=_shard_sort_key)},
         "events": [
             {
                 "ts": e[0],

@@ -16,6 +16,18 @@ Two consumers share each report:
 - an **installed ``DispatchCounter``** — the test/bench assertion hook,
   still a no-op dict read when none is installed.
 
+Per-shard-group accounting (the sharded serve path): a scatter-dispatch
+fan-out launches one kernel per index shard plus a merge, but the batch
+still pays ONE wire round trip — the per-shard launches overlap on their
+own devices and only the merged output is fetched.  Reporting sites pass
+``shards=N`` for such a group; the counter books it as ONE **logical**
+dispatch (what the 2+2 budget is stated in) while ``physical_dispatches``
+accumulates the real launch count (``N``), and the recorder exports the
+physical count on ``pathway_serve_shard_dispatches_total`` so fan-out
+width stays visible in production.  ``mode="physical"`` flips the
+headline ``dispatches``/``fetches`` attributes to the physical counts
+for tests that want to pin the fan-out width itself.
+
 Thread-safety: each ``DispatchCounter`` carries its OWN lock (the old
 module-global lock serialized unrelated counters and the ``_active`` read
 happened outside it), and ``events`` is bounded — a long soak under an
@@ -51,23 +63,52 @@ def _obs_counter(kind: str, tag: str) -> observe.Counter:
     return c
 
 
-class DispatchCounter:
-    """Counts device dispatches and host fetches on the serving paths."""
+def _obs_shard_counter(kind: str, tag: str) -> observe.Counter:
+    key = (f"shard_{kind}", tag)
+    c = _obs_counters.get(key)
+    if c is None:
+        c = _obs_counters[key] = observe.counter(
+            f"pathway_serve_shard_{kind}es_total", tag=tag
+        )
+    return c
 
-    def __init__(self, max_events: int = 4096) -> None:
+
+class DispatchCounter:
+    """Counts device dispatches and host fetches on the serving paths.
+
+    ``mode="logical"`` (default): a shard-group fan-out reported with
+    ``shards=N`` counts as ONE dispatch/fetch — the number the 2+2
+    per-batch budget is asserted against.  ``mode="physical"``: the
+    headline counts are the real per-device launch counts.  Both modes
+    always keep both views (``dispatches``/``fetches`` honor the mode;
+    ``physical_dispatches``/``physical_fetches`` are always physical).
+    """
+
+    def __init__(self, max_events: int = 4096, mode: str = "logical") -> None:
+        if mode not in ("logical", "physical"):
+            raise ValueError(f"unknown accounting mode {mode!r}")
         self.max_events = int(max_events)
+        self.mode = mode
         self.dispatches = 0
         self.fetches = 0
+        self.physical_dispatches = 0
+        self.physical_fetches = 0
         self.events: List[Tuple[str, str]] = []  # ("dispatch"|"fetch", tag)
         self.events_dropped = 0
         self._lock = threading.Lock()
 
-    def _record(self, kind: str, tag: str) -> None:
+    def _record(self, kind: str, tag: str, shards: int) -> None:
+        physical = max(1, int(shards))
+        logical = 1
         with self._lock:
             if kind == "dispatch":
-                self.dispatches += 1
+                self.physical_dispatches += physical
+                self.dispatches += (
+                    physical if self.mode == "physical" else logical
+                )
             else:
-                self.fetches += 1
+                self.physical_fetches += physical
+                self.fetches += physical if self.mode == "physical" else logical
             if len(self.events) < self.max_events:
                 self.events.append((kind, tag))
             else:
@@ -77,6 +118,8 @@ class DispatchCounter:
         with self._lock:
             self.dispatches = 0
             self.fetches = 0
+            self.physical_dispatches = 0
+            self.physical_fetches = 0
             self.events = []
             self.events_dropped = 0
 
@@ -105,15 +148,22 @@ def uninstall() -> None:
         _active = None
 
 
-def record_dispatch(tag: str) -> None:
+def record_dispatch(tag: str, shards: int = 1) -> None:
+    """Report one LOGICAL dispatch.  ``shards > 1`` marks a shard-group
+    fan-out: ``shards`` physical kernel launches that together cost the
+    batch one round trip (scatter + per-shard search + merge)."""
     _obs_counter("dispatch", tag).inc()
+    if shards > 1:
+        _obs_shard_counter("dispatch", tag).inc(shards)
     c = _active
     if c is not None:
-        c._record("dispatch", tag)
+        c._record("dispatch", tag, shards)
 
 
-def record_fetch(tag: str) -> None:
+def record_fetch(tag: str, shards: int = 1) -> None:
     _obs_counter("fetch", tag).inc()
+    if shards > 1:
+        _obs_shard_counter("fetch", tag).inc(shards)
     c = _active
     if c is not None:
-        c._record("fetch", tag)
+        c._record("fetch", tag, shards)

@@ -50,7 +50,7 @@ from ..robust import (
 from .knn import _bucket, normalize_metric
 from .recompile_guard import RecompileTripwire
 
-__all__ = ["IvfKnnIndex"]
+__all__ = ["IvfKnnIndex", "ShardedIvfIndex"]
 
 # backoff schedule for failed background maintenance passes (absorb /
 # retrain): a transient device error must not leave the tail growing
@@ -235,6 +235,13 @@ class IvfKnnIndex:
         # off-lock absorb whose snapshot predates the install must abort
         # (its slot plan refers to the replaced slabs)
         self._layout_gen = 0
+        # PUBLIC result-visibility generation: bumped on every mutation
+        # that can change what a serve returns (add/remove/absorb
+        # commit/retrain install/bulk build).  The coalescing scheduler
+        # keys its in-window dedup on (text, generation) so an absorb or
+        # retrain landing mid-window can't hand a later rider results
+        # from a slot dispatched against the pre-mutation index.
+        self.generation = 0
         # device-resident exact-tail upload, cached between serves and
         # invalidated only when the tail mutates (ADVICE r5 #1): steady-
         # state serving with an unchanged tail pays no per-call transfer
@@ -342,6 +349,7 @@ class IvfKnnIndex:
                 self._rows[key] = vec
                 self._tail[key] = None
             self._tail_cache = None
+            self.generation += 1
             if (
                 self._slabs is not None
                 and not self._absorbing
@@ -378,6 +386,8 @@ class IvfKnnIndex:
                 if in_rows or k in self._slot_of_key:
                     dropped.append(k)
             self._forget_built(dropped)
+            if dropped:
+                self.generation += 1
 
     def _forget_built(self, keys: Sequence[int]) -> None:
         """Invalidate built slots (upsert/remove path) in ONE device scatter;
@@ -417,6 +427,7 @@ class IvfKnnIndex:
                 self._tail = {}
                 self._tail_cache = None
                 self._layout_gen += 1
+                self.generation += 1
                 return
             snapshot = dict(self._rows)
             self.stats["sync_builds"] += 1
@@ -632,6 +643,7 @@ class IvfKnnIndex:
         self._absorb_stuck_at = None  # fresh layout: re-arm absorb
         self._tail_cache = None
         self._layout_gen += 1  # in-flight off-lock absorb plans must abort
+        self.generation += 1
         self._search_fns.clear()
 
     def _absorb_bg(self) -> None:
@@ -828,6 +840,7 @@ class IvfKnnIndex:
             del self._tail[key]
         self._keys_by_slot = keys_by_slot
         self._tail_cache = None
+        self.generation += 1
         self.stats["absorbs"] += 1
 
     def _tail_snapshot(self) -> Tuple[List[int], np.ndarray, np.ndarray, int]:
@@ -1038,6 +1051,7 @@ class IvfKnnIndex:
             self._absorb_stuck_at = None
             self._tail_cache = None
             self._layout_gen += 1
+            self.generation += 1
             self._search_fns.clear()
             self.stats["sync_builds"] += 1
 
@@ -1232,3 +1246,212 @@ class IvfKnnIndex:
         p = self.n_probe or self._default_probe()
         n = max(self._built_n, 1)
         return (C + min(p, C) * M + len(self._tail)) / n
+
+
+class _ShardIvf(IvfKnnIndex):
+    """One shard-resident IVF partition: an ``IvfKnnIndex`` whose device
+    structures live on a pinned device.  The synchronous entry points are
+    wrapped by ``ShardedIvfIndex`` under ``jax.default_device``; the
+    background maintenance threads (absorb/retrain) re-enter the pin here
+    because ``jax.default_device`` is thread-local and a thread started
+    inside ``add()`` would otherwise plan and scatter on device 0,
+    migrating the shard's slabs off its home chip one absorb at a time."""
+
+    def __init__(self, *args, device=None, **kwargs):
+        self._device = device
+        super().__init__(*args, **kwargs)
+
+    def _absorb_bg(self) -> None:
+        if self._device is None:
+            return super()._absorb_bg()
+        with jax.default_device(self._device):
+            return super()._absorb_bg()
+
+    def _retrain_bg(self) -> None:
+        if self._device is None:
+            return super()._retrain_bg()
+        with jax.default_device(self._device):
+            return super()._retrain_bg()
+
+
+class ShardedIvfIndex:
+    """Document-sharded IVF over a serve device group: ``n_shards``
+    shard-resident ``IvfKnnIndex`` partitions (centroids, postings slabs,
+    and exact tail all living on the owning shard's device), routed by
+    the group's single placement rule ``owner_of(key)``.
+
+    Same host API as the single-device indexes (add / remove / search /
+    __len__ / build), so it drops into ``FusedEncodeSearch`` — which
+    detects the ``shards`` attribute and switches to the scatter-dispatch
+    serve path (ops/serving.py): encode once, fan the embedded batch out
+    to every shard's resident search kernel, and tree-merge the per-shard
+    candidates on device, all inside ONE logical dispatch (asserted by
+    the dispatch counter's per-shard-group accounting).
+
+    Maintenance stays shard-local: ``add()`` routes each document to its
+    owning shard, whose own off-lock-plan/locked-commit absorb and
+    background retrain discipline is unchanged — an absorb on shard 3
+    never takes any other shard's lock.  The PUBLIC ``generation`` sums
+    the children's mutation generations plus a routing-level counter, and
+    every child bump happens under that child's lock, so the value moves
+    atomically with the result-visible state of the whole group.
+
+    Failure domains are per shard: the group's circuit breakers +
+    ``shard.dispatch`` chaos site let one dead shard degrade recall on
+    its partition (rung ``shard_skipped``) while the request succeeds.
+    """
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: str = "cos",
+        group=None,
+        n_shards: Optional[int] = None,
+        devices: Optional[Sequence] = None,
+        **ivf_kwargs: Any,
+    ):
+        from ..parallel.shards import ShardGroup
+
+        self.group = group or ShardGroup(n_shards=n_shards, devices=devices)
+        self.dimension = dimension
+        self.metric = normalize_metric(metric)
+        self.dtype = ivf_kwargs.get("dtype", jnp.float32)
+        self._lock = threading.Lock()
+        self._gen_base = 0  # routing-level bumps (e.g. dropped ingest)
+        self.shards: List[_ShardIvf] = [
+            _ShardIvf(
+                dimension,
+                metric=metric,
+                device=self.group.device(s),
+                **ivf_kwargs,
+            )
+            for s in range(self.group.n_shards)
+        ]
+        # routing-level failure accounting (a shard.absorb fault drops
+        # that shard's documents from THIS ingest round only)
+        self.stats: Dict[str, int] = {"route_drops": 0, "route_drop_docs": 0}
+        self._observe_id = observe.next_id()
+        observe.register_provider(self)
+
+    # -- introspection ------------------------------------------------------
+    def __len__(self) -> int:
+        return sum(len(c) for c in self.shards)
+
+    @property
+    def generation(self) -> int:
+        """Result-visibility generation of the whole group (see
+        ``IvfKnnIndex.generation``): child bumps happen under the owning
+        shard's lock, so any absorb/retrain/add landing anywhere in the
+        group moves this value."""
+        return self._gen_base + sum(c.generation for c in self.shards)
+
+    @property
+    def tail_degraded(self) -> bool:
+        return any(c.tail_degraded for c in self.shards)
+
+    # -- mutation (routed to the owning shard) ------------------------------
+    def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+        keys = [int(k) for k in keys]
+        if not keys:
+            return
+        vectors = np.asarray(vectors, np.float32).reshape(
+            len(keys), self.dimension
+        )
+        for s, rows in sorted(self.group.route(keys).items()):
+            try:
+                # chaos sites: the per-shard ingest leg.  A raise drops
+                # THIS shard's documents from this round only — the other
+                # shards commit theirs, and the group stays serveable
+                # (degrade-not-die, the forward-index failure policy).
+                inject.fire(f"shard.absorb.{s}")
+                inject.fire("shard.absorb")
+                with jax.default_device(self.group.device(s)):
+                    self.shards[s].add(
+                        [keys[i] for i in rows], vectors[rows]
+                    )
+            except Exception as exc:
+                with self._lock:
+                    self.stats["route_drops"] += 1
+                    self.stats["route_drop_docs"] += len(rows)
+                    self._gen_base += 1
+                log_once(
+                    f"shard.absorb:{type(exc).__name__}",
+                    "sharded ingest to shard %d failed (%r); its documents "
+                    "are dropped from this round only — counted on "
+                    "pathway_serve_shard_ingest_drops_total",
+                    s,
+                    exc,
+                )
+
+    def remove(self, keys: Sequence[int]) -> None:
+        keys = [int(k) for k in keys]
+        for s, rows in sorted(self.group.route(keys).items()):
+            with jax.default_device(self.group.device(s)):
+                self.shards[s].remove([keys[i] for i in rows])
+
+    def build(self) -> None:
+        """Synchronous bulk (re)build of every shard — the explicit bulk
+        path, like ``IvfKnnIndex.build``.  The serve path never calls
+        this; per-shard streaming maintenance handles staleness."""
+        for s, child in enumerate(self.shards):
+            with jax.default_device(self.group.device(s)):
+                child.build()
+
+    # -- host search (parity/reference; the serve path uses the fused
+    # scatter-dispatch in ops/serving.py) -----------------------------------
+    def search(
+        self, queries: np.ndarray, k: int, n_probe: Optional[int] = None
+    ) -> List[List[Tuple[int, float]]]:
+        queries = np.asarray(queries, np.float32).reshape(-1, self.dimension)
+        nq = queries.shape[0]
+        merged: List[List[Tuple[int, float]]] = [[] for _ in range(nq)]
+        for s, child in enumerate(self.shards):
+            if len(child) == 0:
+                continue
+            with jax.default_device(self.group.device(s)):
+                rows = child.search(queries, k, n_probe=n_probe)
+            for qi, row in enumerate(rows):
+                merged[qi].extend(row)
+        out: List[List[Tuple[int, float]]] = []
+        for row in merged:
+            row.sort(key=lambda kv: -kv[1])
+            out.append(row[:k])
+        return out
+
+    def search_oversampled(
+        self, queries, k, accept, oversample: int = 4, max_rounds: int = 3
+    ):
+        from .knn import oversampled_filtered_search
+
+        return oversampled_filtered_search(
+            self, queries, k, accept, oversample=oversample,
+            max_rounds=max_rounds,
+        )
+
+    # -- flight-recorder provider ------------------------------------------
+    def observe_metrics(self):
+        """Per-shard residency on the ``pathway_serve_shard_*`` family
+        (the group's skip/breaker series ride the ``ShardGroup``
+        provider; the children's own ``pathway_ivf_*`` series keep their
+        per-index labels)."""
+        labels = {"index": str(self._observe_id)}
+        yield (
+            "counter",
+            "pathway_serve_shard_ingest_drops_total",
+            labels,
+            self.stats["route_drops"],
+        )
+        for s, child in enumerate(self.shards):
+            shard_labels = {**labels, "shard": str(s)}
+            yield (
+                "gauge",
+                "pathway_serve_shard_resident_vectors",
+                shard_labels,
+                len(child),
+            )
+            yield (
+                "gauge",
+                "pathway_serve_shard_tail_size",
+                shard_labels,
+                len(child._tail),
+            )

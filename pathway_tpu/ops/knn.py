@@ -109,6 +109,11 @@ class DeviceKnnIndex:
         self.slot_to_key = np.zeros(cap, dtype=KEY_DTYPE)
         self._free: List[int] = list(range(cap - 1, -1, -1))
         self._search_fns: Dict[Tuple[int, int, int], object] = {}
+        # result-visibility generation (same contract as
+        # IvfKnnIndex.generation): bumped on every mutation that can
+        # change what a serve returns — the coalescing scheduler keys
+        # its in-window dedup on (text, generation)
+        self.generation = 0
 
     # -- storage helpers ---------------------------------------------------
     def _round_capacity(self, cap: int) -> int:
@@ -227,6 +232,7 @@ class DeviceKnnIndex:
                 self.key_to_slot[int(key)] = int(slot)
                 self.slot_to_key[slot] = int(key)
             self._scatter(slots, vectors, True, keys=keys)
+            self.generation += 1
 
     def add_from_device(self, keys: Sequence[int], vectors) -> None:
         """Ingest vectors that already live on device (e.g. encoder output) —
@@ -273,6 +279,7 @@ class DeviceKnnIndex:
                 self.key_to_slot[int(key)] = int(slot)
                 self.slot_to_key[slot] = int(key)
             self._scatter(slots, vectors, True, keys=keys)
+            self.generation += 1
 
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:
@@ -286,6 +293,7 @@ class DeviceKnnIndex:
                 return
             slots = np.array(slots, dtype=np.int32)
             self._scatter(slots, np.zeros((len(slots), self.dimension), np.float32), False)
+            self.generation += 1
 
     def _scatter(
         self, slots: np.ndarray, vectors, valid: bool, keys=None

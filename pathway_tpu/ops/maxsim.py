@@ -41,7 +41,77 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["build_maxsim_kernel", "maxsim_scores_host"]
+__all__ = [
+    "build_maxsim_kernel",
+    "build_maxsim_table_kernel",
+    "build_table_merge_kernel",
+    "maxsim_scores_host",
+]
+
+
+def _maxsim_table(qtok, qmask, tok, scales, nvalid, slots, B, Kc, T, quantized):
+    """Traced core shared by the fused single-index kernel and the
+    sharded per-shard kernel: gather + dequantize + MaxSim -> the full
+    ``[B, Kc]`` candidate score table (``-inf`` for absent slots)."""
+    flat = jnp.maximum(slots, 0).reshape(B * Kc)
+    docs = jnp.take(tok, flat, axis=0).astype(jnp.float32)  # [B*Kc, T, d]
+    if quantized:
+        s = jnp.take(scales, flat, axis=0)  # [B*Kc, d]
+        docs = docs * s[:, None, :]
+    nv = jnp.take(nvalid, flat)  # [B*Kc]
+    d = docs.shape[-1]
+    docs = docs.reshape(B, Kc, T, d)
+    # sim[b, k, l, t] = qtok[b, l] . docs[b, k, t] — one einsum, MXU
+    sim = jnp.einsum(
+        "bld,bktd->bklt", qtok, docs, preferred_element_type=jnp.float32
+    )
+    tvalid = (jnp.arange(T)[None, :] < nv[:, None]).reshape(B, Kc, 1, T)
+    sim = jnp.where(tvalid, sim, -jnp.inf)
+    best = jnp.max(sim, axis=3)  # [B, Kc, Lq] per-query-token best row
+    # pad query tokens contribute 0; real tokens of a candidate with
+    # no valid rows stay -inf, so the whole sum is -inf and the
+    # candidate drops out of the top-k below
+    best = jnp.where(qmask[:, None, :] > 0, best, 0.0)
+    scores = jnp.sum(best, axis=2)  # [B, Kc]
+    return jnp.where(slots >= 0, scores, -jnp.inf)
+
+
+def build_maxsim_table_kernel(B: int, Lq: int, Kc: int, T: int, quantized: bool):
+    """Per-shard flavor for the SHARDED forward index: same inputs as
+    ``build_maxsim_kernel`` but the output is the raw ``[B, Kc]``
+    float32 score table (``-inf`` where this shard holds no row for the
+    candidate).  Document routing assigns every candidate to exactly one
+    owning shard, so the cross-shard merge is an elementwise max over
+    the per-shard tables — each cell has at most one finite
+    contributor, and the merged table is bit-identical to what one
+    unsharded index holding every row would have produced."""
+
+    @jax.jit
+    def fused(qtok, qmask, tok, scales, nvalid, slots):
+        return _maxsim_table(
+            qtok, qmask, tok, scales, nvalid, slots, B, Kc, T, quantized
+        )
+
+    return fused
+
+
+def build_table_merge_kernel(S: int, B: int, Kc: int, k_out: int):
+    """Merge ``S`` per-shard score tables: elementwise max (ownership is
+    disjoint, so max = the owning shard's score) then one per-query
+    top-k, emitting the same packed ``[B, 2*k_out]`` int32 layout as
+    ``build_maxsim_kernel`` — the sharded and single-index rerank paths
+    are drop-in interchangeable for the completion code."""
+
+    @jax.jit
+    def fused(*tables):
+        table = tables[0]
+        for t in tables[1:]:
+            table = jnp.maximum(table, t)
+        s, perm = jax.lax.top_k(table, k_out)
+        s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
+        return jnp.concatenate([s_bits, perm.astype(jnp.int32)], axis=1)
+
+    return fused
 
 
 def build_maxsim_kernel(
@@ -64,29 +134,9 @@ def build_maxsim_kernel(
 
     @jax.jit
     def fused(qtok, qmask, tok, scales, nvalid, slots):
-        flat = jnp.maximum(slots, 0).reshape(B * Kc)
-        docs = jnp.take(tok, flat, axis=0).astype(jnp.float32)  # [B*Kc, T, d]
-        if quantized:
-            s = jnp.take(scales, flat, axis=0)  # [B*Kc, d]
-            docs = docs * s[:, None, :]
-        nv = jnp.take(nvalid, flat)  # [B*Kc]
-        d = docs.shape[-1]
-        docs = docs.reshape(B, Kc, T, d)
-        # sim[b, k, l, t] = qtok[b, l] . docs[b, k, t] — one einsum, MXU
-        sim = jnp.einsum(
-            "bld,bktd->bklt", qtok, docs, preferred_element_type=jnp.float32
+        scores = _maxsim_table(
+            qtok, qmask, tok, scales, nvalid, slots, B, Kc, T, quantized
         )
-        tvalid = (
-            jnp.arange(T)[None, :] < nv[:, None]
-        ).reshape(B, Kc, 1, T)
-        sim = jnp.where(tvalid, sim, -jnp.inf)
-        best = jnp.max(sim, axis=3)  # [B, Kc, Lq] per-query-token best row
-        # pad query tokens contribute 0; real tokens of a candidate with
-        # no valid rows stay -inf, so the whole sum is -inf and the
-        # candidate drops out of the top-k below
-        best = jnp.where(qmask[:, None, :] > 0, best, 0.0)
-        scores = jnp.sum(best, axis=2)  # [B, Kc]
-        scores = jnp.where(slots >= 0, scores, -jnp.inf)
         s, perm = jax.lax.top_k(scores, k_out)
         s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
         return jnp.concatenate([s_bits, perm.astype(jnp.int32)], axis=1)

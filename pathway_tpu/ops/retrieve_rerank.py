@@ -527,6 +527,18 @@ class RetrieveRerankPipeline:
             )
         return Deadline.from_env()
 
+    def index_generation(self) -> int:
+        """Result-visibility generation of the stage-1 index, for the
+        coalescing scheduler's generation-keyed in-window dedup (an
+        absorb/retrain landing mid-window must not let a later rider
+        share a slot dispatched against the pre-mutation index)."""
+        gen_fn = getattr(self.retriever, "index_generation", None)
+        if callable(gen_fn):
+            return int(gen_fn())
+        return int(
+            getattr(getattr(self.retriever, "index", None), "generation", 0)
+        )
+
     # -- the stage chain ----------------------------------------------------
     def _submit_chain(
         self,

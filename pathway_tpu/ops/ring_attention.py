@@ -49,11 +49,22 @@ def _block_attn(q, k, v, kv_allowed, q_pos, k_pos, causal, scale):
     return m, o, l
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static mapped-axis size across jax versions: ``jax.lax.axis_size``
+    (new) falls back to the classic ``psum(1, axis)`` constant-fold on
+    0.4.x — both yield a Python int at trace time, which the ring needs
+    for its static permutation list and scan length."""
+    size_fn = getattr(jax.lax, "axis_size", None)
+    if size_fn is not None:
+        return int(size_fn(axis_name))
+    return int(jax.lax.psum(1, axis_name))
+
+
 def ring_attention(
     q, k, v, kv_mask, positions, axis_name: str, causal: bool = False
 ):
     """Per-device body (call inside shard_map over ``axis_name``)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     scale = 1.0 / np.sqrt(q.shape[-1])
     q32 = q.astype(jnp.float32)
     q_pos = positions
@@ -112,13 +123,14 @@ def ring_attention_sharded(
     causal: bool = False,
 ):
     """shard_map wrapper: q/k/v sharded on the sequence dim over ``axis``."""
+    from .topk import _shard_map
+
     spec_qkv = P(None, axis, None, None)
     spec_mask = P(None, axis)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(ring_attention, axis_name=axis, causal=causal),
         mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask, spec_mask),
         out_specs=spec_qkv,
-        check_vma=False,
     )
     return fn(q, k, v, kv_mask, positions)

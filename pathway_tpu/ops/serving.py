@@ -25,11 +25,15 @@ import numpy as np
 from .. import observe
 from ..models.transformer import TransformerEncoder
 from ..robust import (
+    CircuitOpen,
     Deadline,
     RetryPolicy,
+    SHARD_SKIPPED,
     ServeResult,
     TAIL_SKIPPED,
     inject,
+    log_once,
+    record_degraded,
     retry_call,
 )
 from .dispatch_counter import record_dispatch, record_fetch
@@ -79,6 +83,18 @@ class FusedEncodeSearch:
         self._tripwire = RecompileTripwire("FusedEncodeSearch")
         # IVF indexes lack device key planes; winners map slot->key on host
         self._ivf = hasattr(index, "_centroids")
+        # sharded index (ops/ivf.ShardedIvfIndex): scatter-dispatch fan-out
+        # + on-device hierarchical merge instead of one fused kernel
+        self._sharded = hasattr(index, "shards") and hasattr(index, "group")
+        # bench/test probe: True makes the sharded completion fetch the
+        # per-shard candidate lists and tree-merge them ON HOST instead
+        # of dispatching the device merge — the A/B that prices the
+        # merge's share of serve latency (and the NumPy reference the
+        # merge-kernel parity test checks against)
+        self.shard_host_merge = False
+        # per-shard dispatch-latency histograms, resolved lazily per
+        # shard id (pathway_serve_shard_stage_seconds{stage=...,shard=...})
+        self._shard_hists: Dict[Tuple[str, int], Any] = {}
         # query TOKEN-STATE export for a downstream late-interaction
         # rerank stage (pathway_tpu/index): the fused kernel additionally
         # returns the per-token hidden states, DEVICE-RESIDENT (never
@@ -95,6 +111,13 @@ class FusedEncodeSearch:
             and isinstance(module, TransformerEncoder)
             and module.config.pool == "mean"
         )
+
+    def index_generation(self) -> int:
+        """Result-visibility generation of the underlying index — the
+        coalescing scheduler folds it into its in-window dedup key so a
+        mutation landing mid-window (absorb, retrain install, add)
+        can't hand a later rider results from a pre-mutation slot."""
+        return int(getattr(self.index, "generation", 0))
 
     def _query_forward(self, export: bool):
         """The query-encode fragment of the fused kernels: returns a
@@ -264,6 +287,407 @@ class FusedEncodeSearch:
         self._fns[shape_key] = fused
         return fused, k_main, k_tail
 
+    # -- sharded scatter-dispatch serve path --------------------------------
+    def _encode_fn(self, B: int, L: int):
+        """Compiled query-encode kernel for the sharded path: ``(params,
+        ids, mask) -> z [B, d] f32`` (metric-normalized), plus the
+        device-resident per-token states when a late-interaction stage
+        asked for the export.  The embedding is computed ONCE and then
+        scattered to every shard — the per-shard search kernels take it
+        as input instead of re-running the trunk S times."""
+        export = self._exporting()
+        key = ("encode", B, L, export, self.index.metric)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                return fn
+            self._tripwire.observe(key)
+            normalize = self.index.metric == "cos"
+            forward = self._query_forward(export)
+
+            @jax.jit
+            def fn(params, ids, mask):
+                z, qtok = forward(params, ids, mask)
+                if normalize:
+                    z = z / jnp.maximum(
+                        jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
+                    )
+                if qtok is not None:
+                    return z, qtok
+                return z
+
+            self._fns[key] = fn
+            return fn
+
+    def _shard_search_fn(self, child, B: int, K: int, t_pad: int):
+        """Compiled per-shard search kernel: ``(z [B, d] f32, slabs,
+        bias, centroids, tail_mat, tail_valid) -> [B, 2K] int32`` — the
+        shard's best ``K`` candidates as score bit-patterns plus packed
+        candidate ids (slab slot, or ``n_slotspace + tail_row`` for
+        exact-tail winners; ``-1`` invalid).  Resident probe/rescore and
+        the exact-tail scan are merged into the one per-shard top-K
+        INSIDE the kernel, so the cross-shard merge reduces one sorted
+        list per shard.  Returns ``(fn, n_slotspace)``.
+
+        Cache key is pure shapes — shards with identical layout shapes
+        (the steady state of balanced routing) share one compiled fn."""
+        M = child._M_pad
+        C = child._centroids.shape[0]
+        C_pad = child._slabs.shape[0]
+        d = child.dimension
+        d_pad = child._d_pad
+        p = child.n_probe or child._default_probe()
+        p = min(p, C)
+        k_main = min(K, p * M)
+        k_tail = min(K, t_pad) if t_pad else 0
+        n_slotspace = C_pad * M
+        key = ("shard", B, K, p, t_pad, C_pad, C, M, d_pad)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                return fn, n_slotspace
+            self._tripwire.observe(key)
+            use_pallas = jax.default_backend() == "tpu"
+
+            @jax.jit
+            def fn(z, slabs, bias, centroids, tail_mat, tail_valid):
+                cscores = jnp.dot(
+                    z.astype(centroids.dtype), centroids.T,
+                    preferred_element_type=jnp.float32,
+                )
+                _, probe = jax.lax.top_k(cscores, p)
+                probe = probe.astype(jnp.int32)
+                zq = z
+                if d_pad > d:
+                    zq = jnp.concatenate(
+                        [z, jnp.zeros((B, d_pad - d), z.dtype)], axis=1
+                    )
+                from .ivf_pallas import rescore_shortlist
+
+                scores3 = rescore_shortlist(
+                    probe, zq, slabs, bias, use_pallas=use_pallas
+                )
+                scores = scores3.reshape(B, p * M)
+                s, i = jax.lax.top_k(scores, k_main)
+                jj = i // M
+                mm = i % M
+                slots = jnp.take_along_axis(probe, jj, axis=1) * M + mm
+                cand_s = [s]
+                cand_i = [jnp.where(jnp.isfinite(s), slots, -1)]
+                if t_pad:
+                    ts = jnp.dot(
+                        z.astype(tail_mat.dtype), tail_mat.T,
+                        preferred_element_type=jnp.float32,
+                    )
+                    ts = jnp.where(tail_valid[None, :], ts, -jnp.inf)
+                    t_s, t_i = jax.lax.top_k(ts, k_tail)
+                    cand_s.append(t_s)
+                    cand_i.append(
+                        jnp.where(
+                            jnp.isfinite(t_s),
+                            n_slotspace + t_i.astype(jnp.int32),
+                            -1,
+                        )
+                    )
+                cs = jnp.concatenate(cand_s, axis=1)
+                ci = jnp.concatenate(cand_i, axis=1)
+                if cs.shape[1] < K:
+                    pad = K - cs.shape[1]
+                    cs = jnp.pad(cs, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+                    ci = jnp.pad(ci, ((0, 0), (0, pad)), constant_values=-1)
+                s_out, pos = jax.lax.top_k(cs, K)
+                i_out = jnp.take_along_axis(ci, pos, axis=1)
+                s_bits = jax.lax.bitcast_convert_type(s_out, jnp.int32)
+                return jnp.concatenate([s_bits, i_out], axis=1)
+
+            self._fns[key] = fn
+            return fn, n_slotspace
+
+    def _merge_fn(self, S: int, B: int, K: int):
+        """Compiled hierarchical merge kernel: ``S`` per-shard packed
+        candidate lists ``[B, 2K]`` -> global top-K ``[B, 3K]`` int32
+        (score bit-patterns, live-shard ordinals, shard-local candidate
+        ids) via a pairwise tree reduce over the shard axis
+        (ops/topk.tree_merge_topk) — ⌈log2 S⌉ 2K-wide top-k levels
+        instead of one S·K selection."""
+        from .topk import tree_merge_topk
+
+        key = ("merge", S, B, K)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                return fn
+            self._tripwire.observe(key)
+
+            @jax.jit
+            def fn(*packed):
+                scores = jnp.stack(
+                    [
+                        jax.lax.bitcast_convert_type(p[:, :K], jnp.float32)
+                        for p in packed
+                    ]
+                )
+                ids = jnp.stack([p[:, K:] for p in packed])
+                shard_ids = jnp.stack(
+                    [jnp.full((B, K), s, jnp.int32) for s in range(S)]
+                )
+                s, h, i = tree_merge_topk(scores, shard_ids, ids, K)
+                s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
+                return jnp.concatenate([s_bits, h, i], axis=1)
+
+            self._fns[key] = fn
+            return fn
+
+    def _shard_hist(self, stage: str, shard: int):
+        key = (stage, shard)
+        h = self._shard_hists.get(key)
+        if h is None:
+            h = self._shard_hists[key] = observe.histogram(
+                "pathway_serve_shard_stage_seconds",
+                stage=stage,
+                shard=str(shard),
+            )
+        return h
+
+    def _submit_sharded(
+        self,
+        texts: Sequence[str],
+        ids: np.ndarray,
+        mask: np.ndarray,
+        n_real: int,
+        k: int,
+        t_start: int,
+        deadline: Optional[Deadline] = None,
+    ):
+        """Scatter-dispatch serve over a ``ShardedIvfIndex``: encode the
+        coalesced batch ONCE, fan the device-resident embedding out to
+        every shard's resident search kernel (one ``device_put`` + one
+        launch per shard, all asynchronous), and tree-merge the
+        per-shard candidate lists on device — ONE logical dispatch, one
+        packed fetch, so the happy-path serve stays at 2 logical
+        dispatches + 2 fetches per batch (the dispatch counter's
+        per-shard-group accounting carries the physical fan-out width).
+
+        Per-shard failure domains: a shard whose dispatch fails (or
+        whose breaker is open) is SKIPPED — the merge runs over the live
+        shards, the response is flagged ``shard_skipped``, and only that
+        shard's partition loses recall.  The whole serve fails only when
+        every nonempty shard is down."""
+        index = self.index
+        group = index.group
+        shards = index.shards
+        if len(index) == 0:
+            empty = ServeResult([[] for _ in texts])
+            handle = lambda: empty  # noqa: E731
+            handle.query_tokens = None
+            handle.query_mask = mask
+            handle.n_queries = n_real
+            return handle
+        k_eff = min(k, len(index))
+        B, L = ids.shape
+        enc = self._encode_fn(B, L)
+        # the encode launch opens the stage-1 logical dispatch group;
+        # its failure (past retries) is a stage-1 outage — the caller's
+        # ladder turns it into retrieval_failed
+        if self._exporting():
+            z, qtok = retry_call(
+                "serve.dispatch", enc, self.encoder.params, ids, mask,
+                deadline=deadline,
+            )
+        else:
+            z = retry_call(
+                "serve.dispatch", enc, self.encoder.params, ids, mask,
+                deadline=deadline,
+            )
+            qtok = None
+        physical = 1  # the encode launch
+        outs: List[Any] = []
+        snaps: List[Any] = []
+        skipped: List[int] = []
+        for s, child in enumerate(shards):
+            t_shard = time.perf_counter_ns()
+            try:
+                if len(child) == 0:
+                    outs.append(None)
+                    snaps.append(None)
+                    continue
+                breaker = group.breaker(s)
+                if not breaker.allow():
+                    raise CircuitOpen(breaker.name)
+                # per-shard chaos site OUTSIDE the retry loop: arming
+                # shard.dispatch.<s> kills exactly this shard
+                # deterministically (the generic shard.dispatch site
+                # fires inside retry_call and models transient faults)
+                inject.fire(f"shard.dispatch.{s}", deadline=deadline)
+                with jax.default_device(group.device(s)), child._lock:
+                    if child._slabs is None:
+                        child.build()  # first build only
+                    else:
+                        child.maybe_retrain_async()
+                    tail, tail_dev, tail_valid_dev, t_pad = (
+                        child._tail_snapshot_device()
+                    )
+                    fn, n_slotspace = self._shard_search_fn(
+                        child, B, k_eff, t_pad
+                    )
+                    # scatter leg: the shared embedding hops to the
+                    # shard's device (async d2d), then the shard kernel
+                    # launches — under the child lock, because a
+                    # concurrent absorb commit DONATES the slab buffers
+                    # (same launch-before-unlock rule as _submit_ivf)
+                    z_s = jax.device_put(z, group.device(s))  # pathway: allow(lock-discipline): device→device scatter of an UNFETCHED [B, d] embedding — an async ICI hop enqueued like a dispatch, not a host link round trip; it must precede the launch that consumes it under this lock
+                    out = retry_call(  # pathway: allow(lock-discipline): dispatch-only — donated absorb buffers force launch-before-unlock; the merged fetch happens off-lock in the completion
+                        "shard.dispatch",
+                        fn,
+                        z_s,
+                        child._slabs,
+                        child._bias,
+                        child._centroids
+                        if isinstance(child._centroids, jax.Array)
+                        else jnp.asarray(child._centroids),
+                        tail_dev,
+                        tail_valid_dev,
+                        deadline=deadline,
+                        policy=_LOCKED_DISPATCH_RETRY,
+                        breaker=breaker,
+                    )
+                    keys_by_slot = child._keys_by_slot  # dispatch-time snap
+            except Exception as exc:
+                # a dead shard costs recall on its partition, never the
+                # request: skip it, flag the serve, keep the rest going
+                group.record_skip(s)
+                if not skipped:
+                    record_degraded(SHARD_SKIPPED)
+                skipped.append(s)
+                log_once(
+                    f"shard.dispatch:{type(exc).__name__}",
+                    "stage-1 dispatch to shard %d failed (%r); serving "
+                    "without its partition (shard_skipped)",
+                    s,
+                    exc,
+                )
+                outs.append(None)
+                snaps.append(None)
+                continue
+            physical += 1
+            outs.append(out)
+            snaps.append((keys_by_slot, tail, n_slotspace, child))
+            self._shard_hist("dispatch", s).observe_ns(
+                time.perf_counter_ns() - t_shard
+            )
+        live = [s for s in range(len(shards)) if outs[s] is not None]
+        if not live:
+            if skipped:
+                raise RuntimeError(
+                    f"every nonempty shard failed stage-1 dispatch "
+                    f"(skipped={skipped})"
+                )
+            empty = ServeResult([[] for _ in texts])
+            handle = lambda: empty  # noqa: E731
+            handle.query_tokens = qtok
+            handle.query_mask = mask
+            handle.n_queries = n_real
+            return handle
+        tail_skipped = any(snaps[s][3].tail_degraded for s in live)
+        host_merge = bool(self.shard_host_merge)
+        merge_dev = getattr(z, "device", None) or group.device(0)
+        out_m = None
+        t_merge = time.perf_counter_ns()
+        if not host_merge:
+            # gather leg: per-shard packed candidate lists hop back to
+            # the merge device (async d2d), then ONE tree-reduce merge
+            # kernel produces the packed global top-K — the only output
+            # the host ever fetches
+            moved = [jax.device_put(outs[s], merge_dev) for s in live]
+            mfn = self._merge_fn(len(live), B, k_eff)
+            out_m = retry_call(
+                "shard.merge", mfn, *moved,
+                deadline=deadline, policy=_LOCKED_DISPATCH_RETRY,
+            )
+            physical += 1
+            if hasattr(out_m, "copy_to_host_async"):
+                out_m.copy_to_host_async()
+        record_dispatch("serve_sharded", shards=physical)
+        t_dispatch = time.perf_counter_ns()
+        self._shard_hist("merge_dispatch", -1).observe_ns(
+            t_dispatch - t_merge
+        )
+        _H_TOKENIZE.observe_ns(t_dispatch - t_start)
+        observe.record_occupancy("stage1", n_real, B)
+
+        def complete() -> List[List[Tuple[int, float]]]:
+            inject.fire("serve.fetch", deadline=deadline)
+            if host_merge:
+                # probe mode (bench A/B + merge parity reference): fetch
+                # every shard's list and tree-merge on host
+                from .topk import tree_merge_topk_host
+
+                per_shard = [np.asarray(outs[s])[:n_real] for s in live]
+                record_fetch("serve_sharded_host", shards=len(live))
+                scores = np.stack(
+                    [
+                        np.ascontiguousarray(a[:, :k_eff]).view(np.float32)
+                        for a in per_shard
+                    ]
+                )
+                cids = np.stack([a[:, k_eff:] for a in per_shard])
+                ords = np.stack(
+                    [np.full((n_real, k_eff), i, np.int32) for i in range(len(live))]
+                )
+                m_s, m_h, m_i = tree_merge_topk_host(
+                    scores, ords, cids, k_eff
+                )
+            else:
+                arr = np.asarray(out_m)[:n_real]
+                record_fetch("serve_sharded")
+                m_s = np.ascontiguousarray(arr[:, :k_eff]).view(np.float32)
+                m_h = arr[:, k_eff : 2 * k_eff]
+                m_i = arr[:, 2 * k_eff :]
+            t_fetch = time.perf_counter_ns()
+            _H_STAGE1.observe_ns(t_fetch - t_dispatch)
+            results: List[List[Tuple[int, float]]] = []
+            for qi in range(len(texts)):
+                row: List[Tuple[int, float]] = []
+                for j in range(m_s.shape[1]):
+                    sc = float(m_s[qi, j])
+                    if not np.isfinite(sc):
+                        continue
+                    ordinal = int(m_h[qi, j])
+                    cid = int(m_i[qi, j])
+                    if ordinal < 0 or cid < 0:
+                        continue
+                    keys_by_slot, tail_keys, n_slotspace, _child = snaps[
+                        live[ordinal]
+                    ]
+                    if cid < n_slotspace:
+                        row.append((int(keys_by_slot[cid]), sc))
+                    elif cid - n_slotspace < len(tail_keys):
+                        row.append((tail_keys[cid - n_slotspace], sc))
+                # merged list arrives score-sorted; dedupe upsert twins
+                # (a key resident in both the slab and the tail)
+                seen = set()
+                dedup = []
+                for key, sc in row:
+                    if key not in seen:
+                        seen.add(key)
+                        dedup.append((key, sc))
+                results.append(dedup[:k])
+            _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
+            flags: List[str] = []
+            if tail_skipped:
+                flags.append(TAIL_SKIPPED)
+            if skipped:
+                flags.append(SHARD_SKIPPED)
+            meta = (
+                {"shards_skipped": tuple(skipped)} if skipped else None
+            )
+            return ServeResult(results, degraded=flags, meta=meta)
+
+        complete.query_tokens = qtok
+        complete.query_mask = mask
+        complete.n_queries = n_real
+        return complete
+
     def _submit_ivf(
         self,
         texts: Sequence[str],
@@ -431,6 +855,12 @@ class FusedEncodeSearch:
             )
             mask = np.concatenate(
                 [mask, np.zeros((b - n_real, mask.shape[1]), mask.dtype)]
+            )
+        if self._sharded:
+            # no global lock: per-shard child locks cover the donated
+            # buffers, and the compile caches lock internally
+            return self._submit_sharded(
+                texts, ids, mask, n_real, k, t_start, deadline
             )
         if self._ivf:
             with index._lock, self._lock:

@@ -15,7 +15,35 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["sharded_topk", "merge_topk", "local_score_topk"]
+__all__ = [
+    "sharded_topk",
+    "merge_topk",
+    "local_score_topk",
+    "tree_merge_topk",
+    "tree_merge_topk_host",
+]
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions: ``jax.shard_map`` (new) falls
+    back to ``jax.experimental.shard_map.shard_map`` (0.4.x), where the
+    replication check rejects the all-gather+merge pattern and is
+    disabled the same way ``check_vma=False`` disables it upstream."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        except TypeError:
+            pass
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 def local_score_topk(
@@ -62,6 +90,70 @@ def merge_topk(
     return top_scores, top_global
 
 
+def tree_merge_topk(
+    scores: jnp.ndarray,  # [S, B, K] per-shard candidate scores (desc)
+    shard_ids: jnp.ndarray,  # [S, B, K] int32 origin shard of each candidate
+    ids: jnp.ndarray,  # [S, B, K] int32 shard-local candidate ids
+    k: int,
+):
+    """Hierarchical top-k over the shard axis: pairwise tree reduce —
+    each level merges two shards' sorted candidate lists with one
+    ``lax.top_k`` over their 2K-wide concat, halving the shard count
+    until one list remains (⌈log2 S⌉ levels instead of one S·K-wide
+    selection; at large S the level-wise merges keep every operand at
+    the 2K width the top-k unit is fastest at).  Traced helper — callers
+    close over it inside their own jitted merge kernel.
+
+    Returns ``(scores [B, k], shard_ids [B, k], ids [B, k])`` sorted by
+    score descending.  Only finite scores are meaningful; callers mask
+    absent candidates to ``-inf`` (their shard/id survive the merge but
+    the host filters non-finite rows)."""
+    level = [
+        (scores[s], shard_ids[s], ids[s]) for s in range(scores.shape[0])
+    ]
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            sa, ha, ia = level[i]
+            sb, hb, ib = level[i + 1]
+            cs = jnp.concatenate([sa, sb], axis=1)
+            ch = jnp.concatenate([ha, hb], axis=1)
+            ci = jnp.concatenate([ia, ib], axis=1)
+            kk = min(k, cs.shape[1])
+            ms, pos = jax.lax.top_k(cs, kk)
+            nxt.append(
+                (
+                    ms,
+                    jnp.take_along_axis(ch, pos, axis=1),
+                    jnp.take_along_axis(ci, pos, axis=1),
+                )
+            )
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    s, h, i = level[0]
+    if s.shape[1] > k:
+        s, pos = jax.lax.top_k(s, k)
+        h = jnp.take_along_axis(h, pos, axis=1)
+        i = jnp.take_along_axis(i, pos, axis=1)
+    return s, h, i
+
+
+def tree_merge_topk_host(scores, shard_ids, ids, k):
+    """NumPy reference for ``tree_merge_topk`` (tests + the host-merge
+    probe the bench uses to price the on-device merge): same candidate
+    set and score ordering, host argsort instead of the device tree."""
+    import numpy as np
+
+    S, B, K = scores.shape
+    flat_s = np.transpose(scores, (1, 0, 2)).reshape(B, S * K)
+    flat_h = np.transpose(shard_ids, (1, 0, 2)).reshape(B, S * K)
+    flat_i = np.transpose(ids, (1, 0, 2)).reshape(B, S * K)
+    order = np.argsort(-flat_s, axis=1, kind="stable")[:, :k]
+    take = lambda a: np.take_along_axis(a, order, axis=1)  # noqa: E731
+    return take(flat_s), take(flat_h), take(flat_i)
+
+
 def sharded_topk(
     mesh: Mesh,
     queries: jnp.ndarray,  # [B, d] replicated
@@ -85,11 +177,10 @@ def sharded_topk(
         offsets = jnp.arange(n_shards) * rows_per_shard
         return merge_topk(gathered_scores, gathered_idx, offsets, k)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(), P("data", None), P("data")),
         out_specs=(P(), P()),
-        check_vma=False,
     )
     return fn(queries, matrix, valid)

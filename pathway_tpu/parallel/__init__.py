@@ -10,6 +10,7 @@ ICI (SURVEY.md §5.8).
 
 from . import distributed
 from .exchange import ExchangePlane, gather_table_rows, get_plane
+from .shards import ShardGroup, serve_shards
 from .mesh import (
     current_mesh,
     data_axis_size,
@@ -26,6 +27,8 @@ from .mesh import (
 
 __all__ = [
     "distributed",
+    "ShardGroup",
+    "serve_shards",
     "ExchangePlane",
     "get_plane",
     "gather_table_rows",

@@ -17,7 +17,7 @@ surface must degrade instead of dying:
 - the degradation ladder (``degrade.py``): ``ServeResult`` response
   flags + ``pathway_serve_degraded_total{reason=...}`` counters for
   every rung — rerank_skipped / late_interaction_skipped /
-  tail_skipped / extractive_answer / retrieval_failed;
+  tail_skipped / shard_skipped / extractive_answer / retrieval_failed;
 - deterministic fault injection (``inject.py``): named sites
   (``ivf.dispatch``, ``cross_encoder.fetch``, ``exchange.send``,
   ``ivf.absorb``, …) armable to raise / delay / hang via
@@ -36,6 +36,7 @@ from .degrade import (
     LATE_INTERACTION_SKIPPED,
     RERANK_SKIPPED,
     RETRIEVAL_FAILED,
+    SHARD_SKIPPED,
     TAIL_SKIPPED,
     ServeResult,
     extractive_answer,
@@ -63,6 +64,7 @@ __all__ = [
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",
     "RetryPolicy",
+    "SHARD_SKIPPED",
     "ServeResult",
     "TAIL_SKIPPED",
     "breaker",

@@ -6,8 +6,12 @@ degradation ladder — ``robust/retry.py``, ``robust/degrade.py``) must be
 deterministic nor portable to CPU CI.  This registry gives each
 instrumented failure point a NAME — ``ivf.dispatch``,
 ``cross_encoder.fetch``, ``exchange.send``, ``ivf.absorb``,
-``forward.upload``, ``forward.gather``, ``forward.absorb``, … — and
-lets a test (or an operator running a game-day) arm any site to
+``forward.upload``, ``forward.gather``, ``forward.absorb``, and the
+sharded-serve family ``shard.dispatch`` / ``shard.merge`` /
+``shard.absorb`` (each also addressable per shard as
+``shard.<site>.<n>``, so a game-day can kill exactly one shard of a
+group), … — and lets a test (or an operator running a game-day) arm
+any site to
 
 - ``raise`` a ``FaultInjected`` (a transient dispatch/socket error),
 - ``delay`` execution by a fixed duration (a slow link or device), or

@@ -515,6 +515,39 @@ class _CoalescerBase:
             )
 
 
+class _ReplicaHandle:
+    """Completion wrapper that releases its replica's in-flight slot
+    exactly once, whether the batch completes, fails, or is advanced
+    first — the placement layer's load signal must drain even when the
+    degradation ladder swallows the failure."""
+
+    __slots__ = ("_handle", "_release", "_released", "_rlock")
+
+    def __init__(self, handle, release):
+        self._handle = handle
+        self._release = release
+        self._released = False
+        self._rlock = threading.Lock()
+
+    def advance(self) -> None:
+        adv = getattr(self._handle, "advance", None)
+        if adv is not None:
+            adv()
+
+    def _release_once(self) -> None:
+        with self._rlock:
+            if self._released:
+                return
+            self._released = True
+        self._release()
+
+    def __call__(self):
+        try:
+            return self._handle()
+        finally:
+            self._release_once()
+
+
 class ServeScheduler(_CoalescerBase):
     """Coalescing front-end for the retrieve(→rerank) serve path.
 
@@ -525,6 +558,20 @@ class ServeScheduler(_CoalescerBase):
     honored by truncating the shared top-``max(k)`` rows, and per-request
     results carry the batch's degradation flags (a stage-1 failure
     degrades exactly the riders of that batch).
+
+    **Generation-keyed dedup**: the in-window dedup key is
+    ``(text, index_generation)``, not the text alone — an absorb/retrain
+    landing inside an open coalescing window bumps the target index's
+    generation, so a later duplicate admits into its OWN slot instead of
+    sharing one dispatched against the pre-mutation index state.
+
+    **Replica placement**: ``replicas`` adds data-parallel serve targets
+    (each a full pipeline over its own device group) behind this ONE
+    shared admission queue.  Each coalesced batch is assigned to the
+    least-loaded replica (in-flight batches, ties rotated), so a slow
+    or recovering replica sheds load automatically; per-replica
+    queue-depth gauges and placement counters export on the scrape
+    surface (``pathway_serve_replica_*``).
     """
 
     _degrade_empty = True
@@ -537,9 +584,18 @@ class ServeScheduler(_CoalescerBase):
         window_us: Optional[float] = None,
         max_batch: Optional[int] = None,
         autostart: bool = True,
+        replicas: Optional[Sequence[Any]] = None,
     ):
         self.target = target
         self.k = k or getattr(target, "k", 10)
+        # data-parallel replica set: the placement layer spreads batches
+        # over [target, *replicas]; a single-target scheduler is the
+        # degenerate one-replica case with zero extra cost
+        self._replicas: List[Any] = [target] + list(replicas or ())
+        self._inflight: List[int] = [0] * len(self._replicas)
+        self._placed: List[int] = [0] * len(self._replicas)
+        gen_fn = getattr(target, "index_generation", None)
+        self._generation = gen_fn if callable(gen_fn) else None
         try:
             params = inspect.signature(target.submit).parameters
         except (TypeError, ValueError):
@@ -565,7 +621,17 @@ class ServeScheduler(_CoalescerBase):
         if deadline is None:
             default = getattr(self.target, "_default_deadline", Deadline.from_env)
             deadline = default()
-        return self._admit([str(t) for t in texts], k or self.k, deadline)
+        gen = 0
+        if self._generation is not None:
+            try:
+                gen = int(self._generation())
+            except Exception:
+                gen = 0
+        # dedup item = (text, generation-at-admission): only duplicates
+        # that observed the SAME index state may share a dispatched slot
+        return self._admit(
+            [(str(t), gen) for t in texts], k or self.k, deadline
+        )
 
     def serve(
         self,
@@ -577,8 +643,26 @@ class ServeScheduler(_CoalescerBase):
 
     __call__ = serve
 
+    # -- replica placement --------------------------------------------------
+    def _pick_replica(self) -> int:
+        """Least-loaded replica (in-flight batches), ties rotated by
+        lifetime placement count so an idle fleet round-robins instead
+        of hammering replica 0."""
+        with self._qlock:
+            r = min(
+                range(len(self._replicas)),
+                key=lambda i: (self._inflight[i], self._placed[i], i),
+            )
+            self._inflight[r] += 1
+            self._placed[r] += 1
+            return r
+
+    def _release_replica(self, r: int) -> None:
+        with self._qlock:
+            self._inflight[r] = max(0, self._inflight[r] - 1)
+
     # -- engine hooks -------------------------------------------------------
-    def _launch(self, items: List[str], reqs: List[_Request]):
+    def _launch(self, items: List[Tuple[str, int]], reqs: List[_Request]):
         k_batch = max((r.k or self.k) for r in reqs)
         deadline = self._batch_deadline(reqs)
         kwargs: Dict[str, Any] = {}
@@ -588,7 +672,18 @@ class ServeScheduler(_CoalescerBase):
             # per-request degradation accounting: a stage-1 failure in
             # this batch flags + counts every rider, not "one batch"
             kwargs["n_requests"] = len(reqs)
-        return self.target.submit(items, k_batch, **kwargs)
+        # composition stays deterministic: items are the sorted-unique
+        # (text, gen) pairs, so the text list the target sees is sorted
+        # (a text straddling a generation bump appears once per gen —
+        # same results, separate slots)
+        texts = [t for t, _gen in items]
+        r = self._pick_replica()
+        try:
+            handle = self._replicas[r].submit(texts, k_batch, **kwargs)
+        except BaseException:
+            self._release_replica(r)
+            raise
+        return _ReplicaHandle(handle, lambda: self._release_replica(r))
 
     def _demux(self, req: _Request, batch_result) -> ServeResult:
         k = req.k or self.k
@@ -605,6 +700,22 @@ class ServeScheduler(_CoalescerBase):
             degraded=tuple(getattr(batch_result, "degraded", ())),
             meta=getattr(batch_result, "meta", None),
         )
+
+    # -- flight-recorder provider ------------------------------------------
+    def observe_metrics(self):
+        yield from super().observe_metrics()
+        labels = {"scheduler": self.name}
+        for r in range(len(self._replicas)):
+            rl = {**labels, "replica": str(r)}
+            yield (
+                "gauge", "pathway_serve_replica_depth", rl, self._inflight[r]
+            )
+            yield (
+                "counter",
+                "pathway_serve_replica_batches_total",
+                rl,
+                self._placed[r],
+            )
 
 
 class SharedBatcher(_CoalescerBase):

@@ -396,6 +396,69 @@ def test_lock_discipline_knows_forward_index_cache_getters():
     assert _live(_run(good), "lock-discipline") == []
 
 
+def test_lock_discipline_knows_sharded_cache_getters():
+    """ISSUE 7: the sharded-serve compiled-fn getters (``_encode_fn``,
+    ``_shard_search_fn`` — tuple-returning, ``_merge_fn``, ``_table_fn``,
+    ``_scatter_fn``) are registered cache-getter conventions, so the
+    shard fan-out dispatch pattern — a per-shard ``retry_call`` launch
+    inside the fan-out loop while holding the shard's lock — is seen as
+    a device dispatch (and needs the launch-before-unlock pragma the
+    real serve path carries)."""
+    bad = """
+        import threading
+
+        from pathway_tpu.robust import retry_call
+
+        class ShardedServe:
+            def __init__(self, shards):
+                self.shards = shards
+
+            def fan_out(self, z, B, K):
+                outs = []
+                for s, child in enumerate(self.shards):
+                    with child._lock:
+                        fn, n_slotspace = self._shard_search_fn(child, B, K, 0)
+                        out = retry_call("shard.dispatch", fn, z)
+                    outs.append(out)
+                mfn = self._merge_fn(len(outs), B, K)
+                return mfn(*outs)
+    """
+    live = _live(_run(bad), "lock-discipline")
+    assert len(live) == 1, "\n".join(f.message for f in live)
+    assert "jitted dispatch" in live[0].message
+    good = """
+        import threading
+
+        class ShardedServe:
+            def encode(self, params, ids, mask):
+                fn = self._encode_fn(4, 32)
+                return fn(params, ids, mask)
+
+            def table(self, qtok, child):
+                fn = self._table_fn(4, 16, 32, 64)
+                return fn(qtok, child._tok)
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
+def test_hidden_sync_sees_sharded_merge_result_as_device_value():
+    """The merge getter's result is a device value: coercing it on the
+    host inside a dispatch scope of a serve-path module is a hidden
+    sync, exactly like the single-index compiled families."""
+    bad = """
+        # pathway: serve-path
+        import numpy as np
+
+        class ShardedServe:
+            def merge(self, outs, B, K):
+                mfn = self._merge_fn(len(outs), B, K)
+                merged = mfn(*outs)
+                return np.asarray(merged)
+    """
+    live = _live(_run(bad), "hidden-sync")
+    assert live, "merge result coercion must flag as a hidden sync"
+
+
 def test_retry_wrapped_forward_gather_is_a_dispatch():
     """``retry_call("forward.gather", fn, ...)`` with ``fn`` from a
     ``_maxsim_fn`` getter dispatches — wrapping the gather launch in the

@@ -513,6 +513,12 @@ _BASELINE_SUPPRESSIONS = sorted(
         ("pathway_tpu/ops/ivf.py", "recompile-hazard"),
         ("pathway_tpu/ops/ivf.py", "recompile-hazard"),
         ("pathway_tpu/ops/ivf.py", "lock-discipline"),
+        # ISSUE 7 sharded serve path: the per-shard fan-out launch and
+        # the async d2d embedding scatter both happen under the shard's
+        # lock by design (donated absorb buffers force
+        # launch-before-unlock, same rule as the IVF dispatch)
+        ("pathway_tpu/ops/serving.py", "lock-discipline"),
+        ("pathway_tpu/ops/serving.py", "lock-discipline"),
     ]
 )
 

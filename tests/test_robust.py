@@ -906,6 +906,52 @@ def test_stacked_degradation_reports_every_rung_once(stack):
     assert got2.ok, got2.degraded
 
 
+def test_shard_skipped_stacks_with_other_rungs_once(stack):
+    """ISSUE 7 satellite: the new ``shard_skipped`` rung rides the same
+    stacked-degradation dedupe — a dead shard (stage 1) plus a forward
+    gather outage (stage 2) in ONE serve flag both rungs exactly once,
+    mirror both into ``meta["degraded_reasons"]``, and bump each
+    counter once."""
+    from pathway_tpu.index import ShardedForwardIndex
+    from pathway_tpu.ops.ivf import ShardedIvfIndex
+
+    enc, _, _ = stack
+    idx = ShardedIvfIndex(
+        32, metric="cos", n_shards=4, n_probe=10 ** 6, absorb_threshold=4096
+    )
+    keys = sorted(DOCS)
+    vecs = enc.encode([DOCS[i] for i in keys])
+    idx.add(keys, vecs)
+    idx.build()
+    fwd = ShardedForwardIndex(
+        enc, group=idx.group, tokens_per_doc=8, initial_capacity=64
+    )
+    fwd.add(keys, [DOCS[i] for i in keys])
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, idx, k=8), forward_index=fwd,
+        k=5, candidates=16,
+    )
+    clean = pipe(QUERIES)
+    assert clean.ok, clean.degraded
+    before_shard = _degraded("shard_skipped")
+    before_li = _degraded("late_interaction_skipped")
+    with inject.armed("shard.dispatch.1", "raise"):
+        with inject.armed("forward.gather", "raise"):
+            got = pipe(QUERIES)
+    assert got.degraded == ("shard_skipped", "late_interaction_skipped"), (
+        got.degraded
+    )
+    assert got.meta["degraded_reasons"] == [
+        "shard_skipped", "late_interaction_skipped",
+    ]
+    assert got.meta["shards_skipped"] == (1,)
+    assert _degraded("shard_skipped") == before_shard + 1
+    assert _degraded("late_interaction_skipped") == before_li + 1
+    # both rungs clear on the next clean serve
+    got2 = pipe(QUERIES)
+    assert got2.ok, got2.degraded
+
+
 # -- happy path: budget + surface -------------------------------------------
 
 

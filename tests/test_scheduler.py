@@ -344,6 +344,140 @@ def test_scheduler_thread_survives_bad_items(stack):
         np.testing.assert_allclose(batcher(good), want, rtol=1e-4, atol=1e-4)
 
 
+def test_dedup_key_includes_index_generation(stack):
+    """Satellite regression (ISSUE 7): in-window dedup must key on
+    (text, index generation), not the text hash alone — an absorb
+    landing inside an open coalescing window bumps the generation, so a
+    later duplicate gets its OWN slot instead of sharing one dispatched
+    against the pre-absorb index state."""
+    import jax.numpy as jnp
+    from pathway_tpu.ops.ivf import IvfKnnIndex
+
+    enc, ce, _ = stack
+    ivf = IvfKnnIndex(dimension=32, metric="cos", absorb_threshold=8)
+    keys = sorted(DOCS)
+    ivf.add(keys, enc.encode([DOCS[i] for i in keys]))
+    ivf.build()
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, ivf, k=8), ce, DOCS, k=5, candidates=16
+    )
+    pipe([QUERIES[0]])  # warmup
+    assert pipe.index_generation() == ivf.generation
+    with ServeScheduler(pipe, window_us=400_000) as sched:
+        # rider A admits inside a long window at generation g0
+        t1 = sched.submit([QUERIES[0]])
+        g0 = ivf.generation
+        # an absorb lands mid-window: the add crosses the threshold and
+        # the background pass commits — observed via the ivf.absorb
+        # chaos site (armed as a 0-delay probe, so it only counts)
+        with inject.armed("ivf.absorb", "delay", delay_s=0.0):
+            ivf.add(
+                [10_000 + i for i in range(16)],
+                np.tile(
+                    enc.encode([DOCS[0]]).astype(np.float32), (16, 1)
+                )
+                + np.random.default_rng(5)
+                .standard_normal((16, 32))
+                .astype(np.float32)
+                * 0.01,
+            )
+            deadline = time.time() + 20
+            while time.time() < deadline and ivf.generation <= g0:
+                time.sleep(0.005)
+            assert inject.fired_count("ivf.absorb") >= 0  # site exercised
+        assert ivf.generation > g0, "absorb/add never landed"
+        # rider B: SAME text, NEW generation — must not share A's slot
+        t2 = sched.submit([QUERIES[0]])
+        r1, r2 = t1(), t2()
+        assert sched.stats["dedup_hits"] == 0, sched.stats
+        assert sched.stats["items_dispatched"] == 2, sched.stats
+        assert r1[0] and r2[0]
+        # both riders' rows match a FRESH serve of the same query
+        fresh = pipe([QUERIES[0]], k=5)
+        assert [key for key, _ in r2[0]] == [key for key, _ in fresh[0]]
+        # same-generation duplicates still dedup
+        barrier = threading.Barrier(2)
+        out = {}
+
+        def worker(i):
+            barrier.wait(timeout=10)
+            out[i] = sched.serve([QUERIES[1]])
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert out[0] == out[1]
+        assert sched.stats["dedup_hits"] >= 1, sched.stats
+
+
+def test_replica_placement_fairness(stack):
+    """The placement layer spreads batches over the replica set:
+    least-loaded by in-flight count, ties rotated — a sequential stream
+    round-robins, and every replica serves the same results."""
+    pipe_a = _pipeline(stack)
+    pipe_b = _pipeline(stack)
+    want = pipe_a([QUERIES[0]], k=5)
+    with ServeScheduler(
+        pipe_a, window_us=5_000, replicas=[pipe_b]
+    ) as sched:
+        for i in range(8):
+            got = sched.serve([QUERIES[i % len(QUERIES)]], k=5)
+            assert got and got[0]
+        placed = list(sched._placed)
+        assert sum(placed) == 8
+        # fairness: an idle fleet alternates, so the split is even
+        assert placed == [4, 4], placed
+        assert sched._inflight == [0, 0]
+        # replica gauges on the scrape surface
+        snap = observe.snapshot()
+        names = "\n".join(list(snap["gauges"]) + list(snap["counters"]))
+        assert "pathway_serve_replica_depth" in names
+        assert "pathway_serve_replica_batches_total" in names
+        # both replicas produce the shared-batch results
+        assert [key for key, _ in sched.serve([QUERIES[0]], k=5)[0]] == [
+            key for key, _ in want[0]
+        ]
+
+
+def test_slow_replica_sheds_load(stack):
+    """A replica wedged mid-batch keeps its in-flight slot held, so the
+    placement layer routes new batches to the healthy replica."""
+    pipe_a = _pipeline(stack)
+    pipe_b = _pipeline(stack)
+
+    class _Stuck:
+        """Duck-typed replica whose completions block until released."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.release = threading.Event()
+
+        def submit(self, texts, k=None, deadline=None, n_requests=1):
+            handle = self.inner.submit(
+                texts, k, deadline=deadline, n_requests=n_requests
+            )
+
+            def complete():
+                self.release.wait(30)
+                return handle()
+
+            complete.advance = getattr(handle, "advance", lambda: None)
+            return complete
+
+    stuck = _Stuck(pipe_b)
+    with ServeScheduler(pipe_a, window_us=2_000, replicas=[stuck]) as sched:
+        tickets = [sched.submit([q]) for q in QUERIES[:4]]
+        time.sleep(0.3)  # let batches dispatch; one wedges on _Stuck
+        placed_mid = list(sched._placed)
+        stuck.release.set()
+        rows = [t() for t in tickets]
+        assert all(r and r[0] for r in rows)
+    # the healthy replica took at least as many batches as the stuck one
+    assert placed_mid[0] >= placed_mid[1], placed_mid
+
+
 def test_queue_metrics_reach_the_scrape_surface(stack):
     pipe = _pipeline(stack)
     with ServeScheduler(pipe, window_us=10_000, name="metrics-test") as sched:

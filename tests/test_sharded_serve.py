@@ -1,0 +1,441 @@
+"""Sharded serving (ISSUE 7): shard-resident IVF + forward index with
+scatter-dispatch, hierarchical top-k merge, and per-shard failure domains.
+
+Correctness bar: the scatter-dispatch + on-device tree merge must be
+BIT-identical to the host-merged reference at any shard count, and an
+8-shard group must be bit-identical to a 1-shard group at matched
+composition (exact mode always; IVF mode at full probe, where the probed
+candidate set is partition-independent by construction — at partial
+probe each shard trains its own k-means, so 8-vs-1 parity is checked
+against the host-merged per-shard reference instead).  Budget bar: one
+sharded serve batch stays at 2 LOGICAL dispatches + 2 fetches (the
+dispatch counter's per-shard-group accounting mode carries the physical
+fan-out width).  Failure bar: one dead shard degrades recall on its
+partition (rung ``shard_skipped``), never the request, and the budget
+holds with the shard down.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe
+from pathway_tpu.index.forward import ForwardIndex, ShardedForwardIndex
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.ops import dispatch_counter
+from pathway_tpu.ops.ivf import ShardedIvfIndex
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+from pathway_tpu.ops.serving import FusedEncodeSearch
+from pathway_tpu.ops.topk import tree_merge_topk, tree_merge_topk_host
+from pathway_tpu.parallel.mesh import make_mesh
+from pathway_tpu.robust import SHARD_SKIPPED, inject
+from pathway_tpu.serve import ServeScheduler
+
+DOCS = {
+    i: f"document number {i} about {topic} case {i % 7} with live updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+            "mesh collectives", "tokenizer ingest", "serving latency",
+            "cross encoders", "top k selection", "packing rows",
+        ]
+        * 6
+    )
+}
+QUERIES = [
+    "rag retrieval serving", "exactly once stream", "packing segment rows",
+    "kafka offsets replay", "vector index search", "mesh collective sync",
+]
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def corpus(enc):
+    keys = sorted(DOCS)
+    return keys, enc.encode([DOCS[i] for i in keys])
+
+
+def _sharded(enc, corpus, n_shards, n_probe=None, **kw):
+    keys, vecs = corpus
+    idx = ShardedIvfIndex(
+        32, metric="cos", n_shards=n_shards, n_probe=n_probe,
+        absorb_threshold=kw.pop("absorb_threshold", 4096), **kw,
+    )
+    idx.add(keys, vecs)
+    idx.build()
+    return FusedEncodeSearch(enc, idx, k=5)
+
+
+# -- merge kernel vs NumPy reference ----------------------------------------
+
+def test_tree_merge_kernel_matches_numpy_reference():
+    rng = np.random.default_rng(7)
+    for S in (1, 2, 3, 5, 8):
+        scores = rng.standard_normal((S, 4, 6)).astype(np.float32)
+        scores[0, 0, 3] = -np.inf  # absent candidate survives as -inf
+        # pre-sort each shard's list descending, like the shard kernels emit
+        order = np.argsort(-scores, axis=2)
+        scores = np.take_along_axis(scores, order, axis=2)
+        shard_ids = np.broadcast_to(
+            np.arange(S, dtype=np.int32)[:, None, None], scores.shape
+        ).copy()
+        ids = rng.integers(0, 1000, scores.shape).astype(np.int32)
+        k = 5
+
+        @jax.jit
+        def merged(s, h, i):
+            return tree_merge_topk(s, h, i, k)
+
+        ds, dh, di = (np.asarray(x) for x in merged(scores, shard_ids, ids))
+        hs, hh, hi = tree_merge_topk_host(scores, shard_ids, ids, k)
+        np.testing.assert_array_equal(ds, hs)
+        # scores are distinct (random draws), so provenance matches too
+        np.testing.assert_array_equal(dh, hh)
+        np.testing.assert_array_equal(di, hi)
+
+
+# -- serve-path bit-identity -------------------------------------------------
+
+def test_sharded_serve_matches_host_reference(enc, corpus):
+    """The scatter-dispatch + device tree merge returns exactly the
+    rows a host merge of the per-shard searches would."""
+    serve = _sharded(enc, corpus, 8)
+    got = serve(QUERIES, k=5)
+    q = enc.encode(QUERIES)
+    want = serve.index.search(q, 5)
+    for g, w in zip(got, want):
+        assert [key for key, _ in g] == [key for key, _ in w]
+        np.testing.assert_allclose(
+            [s for _, s in g], [s for _, s in w], rtol=1e-5, atol=1e-6
+        )
+
+
+def test_device_merge_bit_identical_to_host_merge(enc, corpus):
+    """The on-device hierarchical merge and the host tree merge of the
+    SAME per-shard candidate lists are bit-identical — the kernel-level
+    scatter/merge parity check."""
+    serve = _sharded(enc, corpus, 8)
+    dev = serve(QUERIES, k=5)
+    serve.shard_host_merge = True
+    try:
+        host = serve(QUERIES, k=5)
+    finally:
+        serve.shard_host_merge = False
+    assert list(dev) == list(host)  # floats compare bit-equal
+
+
+def test_ivf_8_vs_1_shard_bit_identical_at_full_probe(enc, corpus):
+    """At full probe the IVF candidate set is partition-independent, so
+    an 8-shard serve is bit-identical to a 1-shard serve at matched
+    (sorted-unique) composition through the scheduler."""
+    s1 = _sharded(enc, corpus, 1, n_probe=10 ** 6)
+    s8 = _sharded(enc, corpus, 8, n_probe=10 ** 6)
+    with ServeScheduler(s1, window_us=0) as sched1:
+        r1 = sched1.serve(QUERIES, k=5)
+    with ServeScheduler(s8, window_us=0) as sched8:
+        r8 = sched8.serve(QUERIES, k=5)
+    assert list(r1) == list(r8)
+    assert r8.degraded == ()
+
+
+def test_exact_8_vs_1_shard_bit_identical(enc, corpus):
+    """Exact mode: the mesh-sharded DeviceKnnIndex through the fused
+    serve kernel matches the unsharded index bit-for-bit at matched
+    composition (exact scoring is partition-independent)."""
+    keys, vecs = corpus
+
+    def build(mesh):
+        idx = DeviceKnnIndex(
+            dimension=32, metric="cos", initial_capacity=256, mesh=mesh
+        )
+        idx.add(keys, vecs)
+        return FusedEncodeSearch(enc, idx, k=5)
+
+    serve1 = build(None)
+    serve8 = build(make_mesh(8, 1))
+    with ServeScheduler(serve1, window_us=0) as sched:
+        r1 = sched.serve(QUERIES, k=5)
+    with ServeScheduler(serve8, window_us=0) as sched:
+        r8 = sched.serve(QUERIES, k=5)
+    assert [[key for key, _ in row] for row in r1] == [
+        [key for key, _ in row] for row in r8
+    ]
+    for a, b in zip(r1, r8):
+        np.testing.assert_allclose(
+            [s for _, s in a], [s for _, s in b], rtol=1e-6, atol=1e-6
+        )
+
+
+# -- dispatch budget ---------------------------------------------------------
+
+def test_sharded_budget_2_plus_2_logical(enc, corpus):
+    """One sharded retrieve→rerank batch = 2 LOGICAL dispatches + 2
+    fetches (per-shard-group accounting); the physical fan-out width is
+    tracked separately and covers every shard."""
+    keys, _ = corpus
+    serve = _sharded(enc, corpus, 8)
+    # the forward tier shares the IVF tier's group: co-partitioned data
+    fwd = ShardedForwardIndex(
+        enc, group=serve.index.group, tokens_per_doc=8
+    )
+    fwd.add(keys, [DOCS[i] for i in keys])
+    pipe = RetrieveRerankPipeline(
+        serve, forward_index=fwd, k=5, candidates=16
+    )
+    pipe(QUERIES)  # warmup compiles
+    with dispatch_counter.DispatchCounter() as counter:
+        res = pipe(QUERIES)
+    assert res and res[0] and res.degraded == ()
+    assert counter.dispatches == 2, counter.events
+    assert counter.fetches == 2, counter.events
+    # physical accounting: stage 1 = encode + 8 shards + merge, stage 2 =
+    # per-owning-shard gathers + merge — strictly more than logical
+    assert counter.physical_dispatches > 2 + 8
+    # the physical mode flips the headline counters for width assertions
+    with dispatch_counter.DispatchCounter(mode="physical") as physical:
+        pipe(QUERIES)
+    assert physical.dispatches == physical.physical_dispatches > 4
+
+
+# -- failure domains ---------------------------------------------------------
+
+def test_dead_shard_degrades_recall_never_the_request(enc, corpus):
+    """A persistently dead shard yields ``shard_skipped`` degradation:
+    the serve succeeds with the live shards' candidates, ONLY the dead
+    shard's partition is missing, the 2+2 logical budget holds, and the
+    skip counter reaches the scrape surface."""
+    serve = _sharded(enc, corpus, 4, n_probe=10 ** 6)
+    healthy = serve(QUERIES, k=8)
+    group = serve.index.group
+    dead = 2
+    dead_keys = {
+        key for key in sorted(DOCS) if group.owner_of(key) == dead
+    }
+    before = observe.counter(
+        "pathway_serve_degraded_total", reason=SHARD_SKIPPED
+    ).value
+    with inject.armed(f"shard.dispatch.{dead}", "raise"):
+        with dispatch_counter.DispatchCounter() as counter:
+            res = serve(QUERIES, k=8)
+    assert counter.dispatches == 1 and counter.fetches == 1
+    assert SHARD_SKIPPED in res.degraded
+    assert res.meta["shards_skipped"] == (dead,)
+    assert (
+        observe.counter(
+            "pathway_serve_degraded_total", reason=SHARD_SKIPPED
+        ).value
+        > before
+    )
+    for qi, row in enumerate(res):
+        got = [key for key, _ in row]
+        assert got, "a dead shard must not empty the serve"
+        assert not (set(got) & dead_keys)
+        # the live shards' ranking starts exactly like the healthy
+        # ranking with the dead partition's keys removed (it may then
+        # run deeper — the live shards backfill the freed rank slots)
+        want = [key for key, _ in healthy[qi] if key not in dead_keys]
+        assert got[: len(want)] == want
+    assert group.skips[dead] >= 1
+    # recovered on the next serve (site disarmed, breaker still closed
+    # after one failure)
+    clean = serve(QUERIES, k=8)
+    assert clean.degraded == ()
+    assert list(clean) == list(healthy)
+
+
+def test_transient_merge_fault_is_retried(enc, corpus):
+    serve = _sharded(enc, corpus, 4)
+    want = serve(QUERIES[:2], k=5)
+    with inject.armed("shard.merge", "raise", times=1):
+        got = serve(QUERIES[:2], k=5)
+    assert got.degraded == ()
+    assert list(got) == list(want)
+
+
+def test_shard_absorb_chaos_drops_only_that_shard(enc, corpus):
+    """An ingest fault on one shard drops THAT shard's documents from
+    the round; the other shards commit theirs and the group serves."""
+    keys, vecs = corpus
+    idx = ShardedIvfIndex(32, metric="cos", n_shards=4)
+    with inject.armed("shard.absorb.1", "raise"):
+        idx.add(keys, vecs)
+    owned = {s: [k for k in keys if idx.group.owner_of(k) == s] for s in range(4)}
+    assert len(idx.shards[1]) == 0
+    for s in (0, 2, 3):
+        assert len(idx.shards[s]) == len(owned[s])
+    assert idx.stats["route_drops"] == 1
+    assert idx.stats["route_drop_docs"] == len(owned[1])
+    # the forward tier shares the chaos site family
+    fwd = ShardedForwardIndex(enc, group=idx.group, tokens_per_doc=8)
+    with inject.armed("shard.absorb.2", "raise"):
+        n = fwd.add(keys[:40], [DOCS[i] for i in keys[:40]])
+    assert n == sum(
+        1 for k in keys[:40] if idx.group.owner_of(k) != 2
+    )
+
+
+def test_all_shards_dead_is_retrieval_failed_not_a_crash(enc, corpus):
+    from pathway_tpu.robust import RETRIEVAL_FAILED
+
+    serve = _sharded(enc, corpus, 2)
+    serve(QUERIES[:1])  # warmup
+    with ServeScheduler(serve, window_us=0) as sched:
+        with inject.armed("shard.dispatch.0", "raise"), inject.armed(
+            "shard.dispatch.1", "raise"
+        ):
+            res = sched.serve(QUERIES[:1])
+    assert res == [[]]
+    assert RETRIEVAL_FAILED in res.degraded
+
+
+# -- absorb under serve (owning shard) ---------------------------------------
+
+def test_absorb_under_serve_lands_on_owning_shard(enc, corpus):
+    """Concurrent ingest past the absorb threshold while serving: the
+    absorb runs on the OWNING shard's maintenance thread, serving never
+    throws, and the absorbed rows stay retrievable throughout."""
+    keys, vecs = corpus
+    idx = ShardedIvfIndex(
+        32, metric="cos", n_shards=4, n_probe=10 ** 6, absorb_threshold=8
+    )
+    half = len(keys) // 2
+    idx.add(keys[:half], vecs[:half])
+    idx.build()
+    serve = FusedEncodeSearch(enc, idx, k=5)
+    serve(QUERIES[:2])  # warmup
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        rng = np.random.default_rng(3)
+        i = half
+        try:
+            while not stop.is_set() and i < len(keys):
+                step = int(rng.integers(4, 12))
+                idx.add(keys[i : i + step], vecs[i : i + step])
+                i += step
+                time.sleep(0.002)
+        except Exception as exc:
+            errors.append(exc)
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(12):
+            res = serve(QUERIES[:2])
+            assert res and all(row for row in res)
+    finally:
+        stop.set()
+        t.join(30)
+    assert not errors, errors
+    # wait out in-flight background absorbs, then verify routing: every
+    # absorb/tail row lives on its owner
+    deadline = time.time() + 20
+    while time.time() < deadline and any(c._absorbing for c in idx.shards):
+        time.sleep(0.01)
+    for s, child in enumerate(idx.shards):
+        for key in list(child._rows):
+            assert idx.group.owner_of(key) == s
+    assert sum(c.stats["absorbs"] for c in idx.shards) >= 1
+    # post-churn serve sees the late rows
+    res = serve([DOCS[keys[-1]]], k=3)
+    assert keys[-1] in [key for key, _ in res[0]]
+
+
+# -- sharded forward index ----------------------------------------------------
+
+def test_sharded_forward_matches_single_index(enc, corpus):
+    """Late interaction over the sharded forward index returns the same
+    ranking and scores as one unsharded ForwardIndex holding every row
+    (ownership-disjoint tables merge by max — bit-comparable)."""
+    keys, vecs = corpus
+    texts = [DOCS[i] for i in keys]
+
+    def pipeline(fwd):
+        idx = ShardedIvfIndex(
+            32, metric="cos", n_shards=4, n_probe=10 ** 6
+        )
+        idx.add(keys, vecs)
+        idx.build()
+        return RetrieveRerankPipeline(
+            FusedEncodeSearch(enc, idx, k=8),
+            forward_index=fwd, k=5, candidates=16,
+        )
+
+    fwd8 = ShardedForwardIndex(enc, n_shards=8, tokens_per_doc=8)
+    fwd8.add(keys, texts)
+    fwd1 = ForwardIndex(enc, tokens_per_doc=8)
+    fwd1.add(keys, texts)
+    r8 = pipeline(fwd8)(QUERIES)
+    r1 = pipeline(fwd1)(QUERIES)
+    assert r8.degraded == () and r1.degraded == ()
+    for a, b in zip(r8, r1):
+        assert [key for key, _ in a] == [key for key, _ in b]
+        np.testing.assert_allclose(
+            [s for _, s in a], [s for _, s in b], rtol=1e-5, atol=1e-6
+        )
+
+
+def test_sharded_forward_missing_docs_backfill(enc, corpus):
+    """Candidates resident on NO shard are reported missing and
+    backfilled from the previous stage — same contract as the single
+    index."""
+    keys, vecs = corpus
+    texts = [DOCS[i] for i in keys]
+    idx = ShardedIvfIndex(32, metric="cos", n_shards=4, n_probe=10 ** 6)
+    idx.add(keys, vecs)
+    idx.build()
+    fwd = ShardedForwardIndex(enc, n_shards=4, tokens_per_doc=8)
+    fwd.add(keys[: len(keys) // 2], texts[: len(keys) // 2])
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, idx, k=8), forward_index=fwd,
+        k=5, candidates=16,
+    )
+    res = pipe(QUERIES[:2])
+    assert res.degraded == ()
+    missing = res.meta.get("forward_missing", ())
+    assert missing and all(int(k) not in fwd for k in missing)
+
+
+# -- observability ------------------------------------------------------------
+
+def test_shard_metrics_reach_the_scrape_surface(enc, corpus):
+    serve = _sharded(enc, corpus, 4)
+    with inject.armed("shard.dispatch.1", "raise", times=1):
+        serve(QUERIES[:2])
+    serve(QUERIES[:2])
+    snap = observe.snapshot()
+    joined = "\n".join(list(snap["counters"]) + list(snap["gauges"]))
+    assert "pathway_serve_shard_skips_total" in joined
+    assert "pathway_serve_shard_breaker_open" in joined
+    assert "pathway_serve_shard_resident_vectors" in joined
+    assert "pathway_serve_shard_dispatches_total" in joined
+    # the /serve_stats shard column groups shard-labeled samples (keys
+    # keep the non-shard labels so distinct groups never collide)
+    assert snap["shards"], "shard column missing from /serve_stats snapshot"
+    some_shard = next(iter(snap["shards"].values()))
+    assert any(
+        k.startswith("pathway_serve_shard_resident_vectors")
+        for k in some_shard
+    )
+    hist_names = "\n".join(observe.snapshot()["histograms"])
+    assert "pathway_serve_shard_stage_seconds" in hist_names
+    lines = "\n".join(observe.render_prometheus())
+    assert "pathway_serve_shard_skips_total" in lines
