@@ -836,7 +836,12 @@ def phase_concurrent_serve(backend: str, extras: dict) -> float:
         lats: list = [None] * n_req
         errors: list = []
         sched = (
-            ServeScheduler(pipe, window_us=window_us, max_batch=cs_max_batch)
+            # result_cache=None: this phase prices COALESCING alone; the
+            # serve_cache phase owns the cache-on/off A/B
+            ServeScheduler(
+                pipe, window_us=window_us, max_batch=cs_max_batch,
+                result_cache=None,
+            )
             if scheduler_on
             else None
         )
@@ -984,7 +989,11 @@ def phase_sharded_serve(backend: str, extras: dict) -> float:
         return FusedEncodeSearch(enc, idx, k=k)
 
     def drive(serve: FusedEncodeSearch, tag: str):
-        sched = ServeScheduler(serve, window_us=5000, max_batch=16)
+        # result_cache=None: the phase prices the sharded dispatch path;
+        # a tier-0 hit on the repeating pool would skip it entirely
+        sched = ServeScheduler(
+            serve, window_us=5000, max_batch=16, result_cache=None
+        )
         lats: list = [None] * n_req
         errors: list = []
         barrier = threading.Barrier(conc)
@@ -1133,6 +1142,170 @@ def _realistic_corpus(n: int, seed: int = 0):
             )
         docs.append(f"document {i}: " + " ".join(words[: n_words[i]]) + ".")
     return docs
+
+
+def phase_serve_cache(backend: str, extras: dict) -> float:
+    """Multi-tier serve cache (ISSUE 8, pathway_tpu/cache): the SAME
+    hot-head mix ``concurrent_serve`` uses, driven at concurrency 8
+    through the coalescing scheduler with the cache OFF, RESULT-tier
+    only, and ALL serve tiers (result + embedding).  Reports QPS and
+    p50/p99 per arm, per-tier hit rates, and the zero-dispatch fraction
+    (requests resolved with no device work at all), plus the generator
+    prefix/KV tier's prefill-token savings over a shared-prefix RAG
+    prompt set.  Phase value: QPS speedup, all tiers vs cache off
+    (arxiv 2412.15246 reports this caching layer as the dominant RAG
+    serving speedup — here it is measured, not assumed)."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu.cache import EmbeddingCache, PrefixKVCache, ResultCache
+    from pathway_tpu.models.generator import TextGenerator
+    from pathway_tpu.ops import dispatch_counter
+    from pathway_tpu.serve import ServeScheduler
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_SC_DOCS", "20000" if on_tpu else "1000"))
+    k, candidates = 10, 32
+    pipe, _cross, docs, _queries = _build_rr_pipeline(
+        n_docs, 16, k, candidates, small=not on_tpu
+    )
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(64)
+    ]
+    hot = pool[:4]
+
+    def workload(n: int):
+        # the concurrent_serve hot-head mix: every 2nd request hits one
+        # of 4 hot queries — the repeat traffic the cache tiers absorb
+        return [
+            hot[i % len(hot)] if i % 2 == 0 else pool[(i * 7) % len(pool)]
+            for i in range(n)
+        ]
+
+    for q in pool:
+        pipe([q], k)  # warm the solo compile shapes
+    for b in range(2, 9):
+        pipe(sorted(set(workload(3 * b)))[:b], k)
+
+    conc = int(os.environ.get("BENCH_SC_CONC", "8"))
+    n_req = int(os.environ.get("BENCH_SC_REQUESTS", str(conc * 16)))
+
+    def drive(arm: str, result_cache, embed):
+        pipe.retriever.embed_cache = embed
+        # the embedding tier persists across the warm pre-pass, so its
+        # rate must come from THIS drive's deltas (the scheduler stats
+        # below are per-drive already — the two rates must be comparable)
+        embed0 = dict(embed.stats) if embed is not None else {}
+        sched = ServeScheduler(
+            pipe, window_us=5000, max_batch=8, result_cache=result_cache
+        )
+        reqs = workload(n_req)
+        lats: list = [None] * n_req
+        errors: list = []
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    t0 = time.perf_counter()
+                    rows = sched.serve([reqs[i]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    assert rows and rows[0]
+            except Exception as exc:
+                errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        t_all = time.perf_counter()
+        with dispatch_counter.DispatchCounter(max_events=16) as counter:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        elapsed = time.perf_counter() - t_all
+        stats = dict(sched.stats)
+        sched.stop()
+        pipe.retriever.embed_cache = None
+        if errors:
+            raise RuntimeError(f"serve_cache arm {arm} failed: {errors[:3]}")
+        done = np.asarray([l for l in lats if l is not None])
+        qps = n_req / elapsed
+        extras[f"qps_{arm}"] = round(qps, 2)
+        extras[f"p50_{arm}_ms"] = round(float(np.percentile(done, 50)), 3)
+        extras[f"p99_{arm}_ms"] = round(float(np.percentile(done, 99)), 3)
+        if result_cache is not None:
+            hits = stats.get("cache_hits", 0)
+            extras[f"result_hit_rate_{arm}"] = round(hits / n_req, 3)
+            # a tier-0 hit is a serve with ZERO device work
+            extras[f"zero_dispatch_fraction_{arm}"] = round(hits / n_req, 3)
+        if embed is not None:
+            hits = embed.stats["hits"] - embed0.get("hits", 0)
+            misses = embed.stats["misses"] - embed0.get("misses", 0)
+            extras["embed_hit_rate_all"] = round(
+                hits / max(hits + misses, 1), 3
+            )
+        extras[f"dispatches_{arm}"] = counter.dispatches
+        return qps
+
+    qps_by_arm = {}
+    enc = pipe.retriever.encoder
+    for i, arm in enumerate(("off", "result", "all")):
+        # per-arm caches persist across the pre-pass and the measured
+        # pass, and an index ADD lands in between: the measurement is
+        # the honest production shape — a mutation just invalidated
+        # every tier-0 entry (generation keying), so the result tier
+        # earns only its IN-PASS repeat hits, while the embedding tier
+        # (keyed on token ids, mutation-immune) still skips the encode
+        # for every query the pre-pass saw.
+        result_cache = None if arm == "off" else ResultCache()
+        embed = EmbeddingCache() if arm == "all" else None
+        drive(arm, result_cache, embed)  # unmeasured warm pre-pass
+        pipe.retriever.index.add(
+            [10**7 + i], enc.encode([f"invalidation probe document {i}"])
+        )
+        qps_by_arm[arm] = drive(arm, result_cache, embed)
+    speedup = qps_by_arm["all"] / max(qps_by_arm["off"], 1e-9)
+    extras["serve_cache_speedup"] = round(speedup, 3)
+    extras["serve_cache_speedup_result_only"] = round(
+        qps_by_arm["result"] / max(qps_by_arm["off"], 1e-9), 3
+    )
+
+    # -- generator prefix/KV tier: prefill-token savings --------------------
+    kv = PrefixKVCache(block=16)
+    gen = TextGenerator(
+        dimension=64 if not on_tpu else 256,
+        n_layers=2 if not on_tpu else 4,
+        n_heads=4,
+        max_length=192,
+        vocab_size=4096,
+        kv_cache=kv,
+    )
+    shared = (
+        "answer strictly from the retrieved context. "
+        + " ".join(docs[0].split()[:60])
+        + " "
+    )
+    prompts = [shared + q for q in pool[:8]]
+    gen.generate([prompts[0]], max_new_tokens=8)  # cold: seeds the prefix
+    kv.stats_tokens.update(reused=0, computed=0)
+    t0 = time.perf_counter()
+    for p in prompts[1:]:
+        gen.generate([p], max_new_tokens=8)
+    extras["kv_generate_s"] = round(time.perf_counter() - t0, 3)
+    reused = kv.stats_tokens["reused"]
+    computed = kv.stats_tokens["computed"]
+    extras["kv_prefill_tokens_reused"] = int(reused)
+    extras["kv_prefill_tokens_computed"] = int(computed)
+    # sub-linearity: the shared prefix is reused, so the marginal prompt
+    # prefills strictly less than its full length
+    extras["kv_prefill_savings_fraction"] = round(
+        reused / max(reused + computed, 1), 3
+    )
+    assert reused > 0, "shared-prefix prompts reused no prefill blocks"
+    return round(speedup, 3)
 
 
 def phase_ingest(backend: str, extras: dict) -> float:
@@ -1745,6 +1918,7 @@ _PHASES = {
     "fault_tolerance": (phase_fault_tolerance, 450),
     "concurrent_serve": (phase_concurrent_serve, 600),
     "sharded_serve": (phase_sharded_serve, 600),
+    "serve_cache": (phase_serve_cache, 450),
     "ingest": (phase_ingest, 900),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
@@ -1901,6 +2075,7 @@ def main() -> None:
         ("fault_tolerance", lambda: device_phase("fault_tolerance")),
         ("concurrent_serve", lambda: device_phase("concurrent_serve")),
         ("sharded_serve", lambda: device_phase("sharded_serve")),
+        ("serve_cache", lambda: device_phase("serve_cache")),
         ("ingest", lambda: device_phase("ingest")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)

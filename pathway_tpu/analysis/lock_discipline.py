@@ -27,7 +27,15 @@ they execute later, not under the lock):
   future-handoff contract (serve/scheduler.py) is dispatch on the
   scheduler thread, fetch on the WAITER: blocking on a batch while
   holding the admission lock would stall every admitter for a full
-  device round trip.
+  device round trip;
+- serve-cache access (``<*_cache>.get/put/lookup/...`` — the
+  pathway_tpu/cache tiers): a cache call takes the tier's own lock and
+  fires the ``cache.get``/``cache.put`` chaos sites, which may delay or
+  HANG — under a serve lock the fault (or just the tier's contention)
+  would stall every admitter instead of only the calling request.  The
+  in-flight ownership pattern (persistence/object_cache.py
+  ``get_or_compute``) is the sanctioned shape: the global lock guards
+  only the owner dict; compute, backend I/O and pickling run off it.
 
 Deliberate cases (e.g. a dispatch-only launch under the lock that
 snapshots device state consistently and never blocks on the result) are
@@ -43,6 +51,7 @@ from typing import Set
 from .core import ModuleContext, Rule
 from .registry import (
     dotted_name,
+    is_cache_access,
     is_device_value_arg,
     is_device_value_base,
     is_handle_fetch,
@@ -164,6 +173,7 @@ class LockDisciplineRule(Rule):
                 )
             else:
                 handle = is_handle_fetch(node, handle_vars)
+                cache = is_cache_access(node)
                 if handle is not None:
                     ctx.report(
                         self.name, node,
@@ -172,4 +182,13 @@ class LockDisciplineRule(Rule):
                         "future-handoff contract is dispatch on the "
                         "scheduler thread, fetch on the WAITER off-lock "
                         "(blocking here stalls every admitter)",
+                    )
+                elif cache is not None:
+                    ctx.report(
+                        self.name, node,
+                        f"serve-cache access `{cache}(...)` under lock — "
+                        "cache calls take the tier's own lock and fire "
+                        "the cache.get/cache.put chaos sites (delay/hang);"
+                        " keep lookups off the serve locks so a cache "
+                        "fault wedges only its own request",
                     )

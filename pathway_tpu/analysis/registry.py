@@ -39,6 +39,8 @@ from typing import Dict, Iterable, List, Optional, Set
 __all__ = [
     "collect_jit_names",
     "dotted_name",
+    "is_cache_access",
+    "is_cache_wrapper",
     "is_handle_fetch",
     "is_lock_context",
     "scope_handle_vars",
@@ -69,6 +71,27 @@ _JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
 # hidden-sync fetch/budget checks), or wrapping a launch in a retry
 # would silently launder it out of both rules
 _RETRY_WRAPPERS = {"retry_call"}
+
+# the cache-wrapper convention (pathway_tpu/cache): a function named
+# ``_cached_*`` / ``get_or_*`` wraps a device dispatch behind a cache
+# lookup — ``_cached_embeddings`` (ops/serving.py), ``_cached_encode_rows``
+# (models/encoder.py), ``get_or_compute`` (persistence/object_cache.py).
+# Its dispatch fires only on a MISS and is accounted inside the CALLER's
+# logical dispatch group (``record_dispatch(tag, shards=<launches>)``),
+# so the hidden-sync budget check must not demand a record_dispatch in
+# the wrapper scope itself — a cache lookup guarding a dispatch is not a
+# hidden sync.  Everything else (sync-in-dispatch-scope, lock
+# discipline) applies to wrapper scopes unchanged.
+_CACHE_WRAPPER_RE = re.compile(r"^_?(cached_\w+|get_or_\w+)$")
+
+# cache ACCESS, for the lock-discipline rule: a get/put-style method on
+# a receiver whose terminal identifier is spelled like a cache
+# (``self._result_cache.get(...)``, ``self.embed_cache.put_row(...)``).
+# Cache lookups take the tier's own lock and fire the cache.get /
+# cache.put chaos sites (which may delay or hang) — under a serve lock
+# they would stall every admitter for the fault's duration.
+_CACHE_METHOD_RE = re.compile(r"^(get|put|lookup|store|admit|match)")
+_CACHE_RECEIVER_RE = re.compile(r"cache$", re.IGNORECASE)
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:
@@ -213,6 +236,29 @@ def _is_retry_wrapped_dispatch(call: ast.Call, jit_fns: Set[str]) -> bool:
         if name in jit_fns or name.rsplit(".", 1)[-1] in jit_fns:
             return True
     return False
+
+
+def is_cache_wrapper(scope_name: str) -> bool:
+    """A scope following the cache-wrapper naming convention (see
+    ``_CACHE_WRAPPER_RE``): its miss-path dispatch is accounted by the
+    calling serve path's dispatch group."""
+    return bool(_CACHE_WRAPPER_RE.match(scope_name or ""))
+
+
+def is_cache_access(call: ast.Call) -> Optional[str]:
+    """``<something spelled like a cache>.get/put/lookup/store/...`` —
+    returns the dotted spelling for the diagnostic, or None."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if not _CACHE_METHOD_RE.match(func.attr):
+        return None
+    receiver = dotted_name(func.value)
+    if receiver is None:
+        return None
+    if _CACHE_RECEIVER_RE.search(receiver.rsplit(".", 1)[-1]):
+        return f"{receiver}.{func.attr}"
+    return None
 
 
 def is_jit_call(call: ast.Call, jit_fns: Set[str]) -> bool:

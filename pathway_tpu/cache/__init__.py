@@ -1,0 +1,66 @@
+"""Multi-tier serve cache: cross-window results, query embeddings, and
+generator prefix/KV blocks behind ONE bounded, thread-safe,
+metrics-instrumented store.
+
+The serve path is sharded, batched, and fault-tolerant; what it still
+pays on every request is *repeat work*.  Production RAG traffic is
+hot-headed across seconds-to-minutes windows ("Accelerating
+Retrieval-Augmented Generation", arxiv 2412.15246 — which reports this
+caching layer as the dominant RAG serving speedup), and three kinds of
+repeat work dominate:
+
+========================  ==========================================  =============================
+tier                      keyed on                                    invalidation
+========================  ==========================================  =============================
+result (``result.py``)    (query text, index generation, k)           generation bump (structural)
+                                                                      + TTL + LRU/bytes
+embedding                 token ids digest                            LRU/bytes (+ optional TTL) —
+(``embedding.py``)                                                    index mutations do NOT apply
+generator KV              hash chain over token-id blocks             LRU/bytes (+ optional TTL) —
+(``prefix.py``)                                                       content-addressed, can never
+                                                                      alias a different prefix
+========================  ==========================================  =============================
+
+- A **result hit is a zero-dispatch serve**: the scheduler
+  (serve/scheduler.py) resolves the ticket before admission — no
+  coalescing window, no device work, bit-identical to the serve that
+  populated the entry.
+- An **embedding hit skips the stage-1 encode**: the serving path
+  composes cached device rows with freshly encoded ones in the shared
+  bucketed batch and dispatches a search-only kernel (ops/serving.py).
+- A **KV-block hit skips generator prefill** for the shared prompt
+  prefix (models/generator.py) — sub-linear prefill cost across RAG
+  prompts sharing system-prompt + chunk prefixes.
+
+Shared guarantees (``store.py``): LRU + byte-budget bounded; lookups
+off the serve locks; ``cache.get`` / ``cache.put`` chaos sites where a
+failed or corrupt entry degrades to a recompute (a miss), never a
+failed or wrong serve; ``pathway_cache_*`` hit/miss/evict/bytes on the
+one scrape surface plus a ``/serve_stats`` per-tier column.
+
+Env knobs: ``PATHWAY_CACHE`` (global kill switch),
+``PATHWAY_CACHE_RESULT[_BYTES|_TTL_S]``,
+``PATHWAY_CACHE_EMBED[_BYTES|_TTL_S]`` (opt-in),
+``PATHWAY_CACHE_KV[_BYTES|_TTL_S|_BLOCK]``.
+"""
+
+from .embedding import EmbeddingCache, embedding_cache_from_env
+from .keys import block_chain_keys, query_key, result_key, token_ids_key
+from .prefix import PrefixKVCache, prefix_kv_cache_from_env
+from .result import ResultCache, result_cache_from_env
+from .store import CacheTier, cache_enabled
+
+__all__ = [
+    "CacheTier",
+    "EmbeddingCache",
+    "PrefixKVCache",
+    "ResultCache",
+    "block_chain_keys",
+    "cache_enabled",
+    "embedding_cache_from_env",
+    "prefix_kv_cache_from_env",
+    "query_key",
+    "result_cache_from_env",
+    "result_key",
+    "token_ids_key",
+]

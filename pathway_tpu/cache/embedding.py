@@ -1,0 +1,128 @@
+"""Tier 1: the query-embedding cache, keyed on token ids.
+
+A result-cache miss on a *known* query (the common post-mutation shape:
+absorb bumped the generation, the hot head repeats) still should not pay
+the stage-1 trunk forward — the embedding depends on the tokenizer and
+encoder params, NOT on index state, so it survives every generation
+bump.  Entries are DEVICE-RESIDENT ``[d]`` rows (f32, a few KB each):
+
+- the serve path composes cached rows with freshly encoded ones into
+  the shared bucketed ``[B, d]`` batch on device (ops/serving.py
+  ``_cached_embeddings``) and feeds the search-only kernels — an
+  all-hit batch skips the encode launch entirely;
+- ``SentenceEncoder.encode_to_device`` reuses the same tier for the
+  ingest/QA encode paths.
+
+Keeping rows device-resident means a hit never re-crosses the host link
+(capturing a row is an async device slice; no fetch, no upload).  Byte
+accounting uses the array's ``.nbytes`` metadata — no sync.  The tier is
+per-encoder: token ids only mean anything relative to one tokenizer +
+parameter set, so sharing a tier across encoders would be a correctness
+bug, not a win.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .keys import token_ids_key
+from .store import CacheTier, cache_enabled, env_bytes, env_float
+
+__all__ = ["EmbeddingCache", "embedding_cache_from_env"]
+
+
+class EmbeddingCache:
+    """Device-resident embedding rows behind one bounded ``CacheTier``.
+
+    No integrity fingerprint: checksumming a device array is a hidden
+    host sync (the analyzer's rule); corruption of immutable device
+    buffers is not a failure mode the serve path defends against."""
+
+    def __init__(
+        self,
+        max_bytes: Optional[int] = None,
+        ttl_s: Optional[float] = None,
+        max_entries: Optional[int] = None,
+    ):
+        if max_bytes is None:
+            max_bytes = env_bytes("PATHWAY_CACHE_EMBED_BYTES", 64 << 20)
+        if ttl_s is None:
+            ttl = env_float("PATHWAY_CACHE_EMBED_TTL_S", 0.0)
+            ttl_s = ttl if ttl > 0 else None
+        self._tier = CacheTier(
+            "embedding",
+            max_bytes=max_bytes,
+            ttl_s=ttl_s,
+            max_entries=max_entries,
+        )
+
+    @property
+    def stats(self):
+        return self._tier.stats
+
+    def __len__(self) -> int:
+        return len(self._tier)
+
+    def clear(self) -> None:
+        self._tier.clear()
+
+    def row_key(
+        self, ids_row: np.ndarray, mask_row: np.ndarray, space: str = ""
+    ) -> bytes:
+        # ``space`` partitions the key space per PRODUCER: the serve
+        # path stores metric-normalized rows from the fused trunk while
+        # the plain encoder stores its own normalize-contract rows —
+        # same token ids, different value spaces.  Folding the producer
+        # signature into the key makes sharing one tier instance across
+        # both paths safe by construction (no cross-space aliasing).
+        return space.encode() + b"\x00" + token_ids_key(ids_row, mask_row)
+
+    def lookup_rows(
+        self,
+        ids: np.ndarray,
+        mask: np.ndarray,
+        n_real: int,
+        deadline=None,
+        space: str = "",
+    ) -> Tuple[List[Any], List[int], List[bytes]]:
+        """Per-row lookup for a tokenized batch: returns ``(rows,
+        miss_indices, keys)`` where ``rows[i]`` is a device ``[d]`` row
+        or None, ``miss_indices`` the real rows needing a fresh encode,
+        and ``keys`` each real row's cache key (for the capture pass).
+        ``space`` is the producer's value-space signature (see
+        ``row_key``)."""
+        rows: List[Any] = []
+        misses: List[int] = []
+        keys: List[bytes] = []
+        for i in range(n_real):
+            key = self.row_key(ids[i], mask[i], space)
+            keys.append(key)
+            row = self._tier.get(key, deadline=deadline)
+            rows.append(row)
+            if row is None:
+                misses.append(i)
+        return rows, misses, keys
+
+    def put_row(self, key: bytes, row: Any, deadline=None) -> bool:
+        return self._tier.put(
+            key, row, nbytes=getattr(row, "nbytes", 64), deadline=deadline
+        )
+
+
+def embedding_cache_from_env() -> Optional[EmbeddingCache]:
+    """Serve-path construction: OPT-IN via ``PATHWAY_CACHE_EMBED=1``
+    (gated on the global ``PATHWAY_CACHE`` switch).  Unlike the result
+    tier, composing cached embeddings swaps the fused encode+search
+    kernel for the split encode → search-only pair, so the tier changes
+    low-order score bits across compositions — it defaults off and is
+    enabled deliberately (bench/serving configs), while ``ServeScheduler``
+    callers get the bit-stable result tier by default."""
+    import os
+
+    if not cache_enabled():
+        return None
+    if os.environ.get("PATHWAY_CACHE_EMBED", "0") in ("1", "true", "on"):
+        return EmbeddingCache()
+    return None

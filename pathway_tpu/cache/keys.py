@@ -1,0 +1,91 @@
+"""The ONE place serve-cache keys are derived.
+
+Three consumers key on overlapping facts and must never drift:
+
+- the coalescing scheduler's **in-window dedup** key — ``(text, index
+  generation)``: only duplicates that observed the SAME index state may
+  share a dispatched slot (serve/scheduler.py);
+- the **cross-window result cache** key — the dedup key plus the serve
+  config (the requested ``k``): a hit must be exactly the result the
+  same request would have dispatched, so everything that shapes the
+  response is in the key, and a generation bump (absorb / retrain /
+  remove) makes a stale hit *structurally impossible* — the old entry's
+  key simply can never be asked for again (generations are monotone);
+- the **embedding cache** key — the token ids alone: an embedding
+  depends on the tokenizer + trunk, NOT on index state, so it survives
+  generation bumps (that asymmetry is the whole point of the tier — a
+  result-cache miss on a known query still skips the stage-1 encode);
+- the **generator prefix/KV** block keys — a hash CHAIN over token-id
+  blocks, so two prompts sharing a prefix share exactly the cached
+  blocks covering it (causal attention makes a block's K/V a pure
+  function of the tokens up to its end).
+
+Before this module the dedup key was derived inline in
+``serve/scheduler.py`` — the result cache arriving with its own spelling
+would have been the classic two-sites-one-fact drift bug.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Tuple
+
+import numpy as np
+
+__all__ = [
+    "block_chain_keys",
+    "query_key",
+    "result_key",
+    "token_ids_key",
+]
+
+
+def query_key(text: Any, generation: int) -> Tuple[str, int]:
+    """``(text, index generation)`` — the scheduler's in-window dedup
+    item AND the result-cache key prefix.  Everything downstream treats
+    it as opaque; only this function spells it."""
+    return (str(text), int(generation))
+
+
+def result_key(
+    text: Any, generation: int, k: int
+) -> Tuple[str, int, int]:
+    """Cross-window serve-result cache key: the dedup key plus the
+    requested ``k`` (the serve config that shapes the response rows).
+    Keyed on the SAME ``query_key`` fields so the two can never drift."""
+    return query_key(text, generation) + (int(k),)
+
+
+def token_ids_key(ids_row: np.ndarray, mask_row: np.ndarray) -> bytes:
+    """Embedding-cache key: a digest of one query's REAL token ids (the
+    masked prefix).  Trimming the pad tail makes the key invariant to
+    the batch's padded length — the same query tokenized into a longer
+    batch must hit the row it cached from a shorter one (a pooled
+    embedding never depends on pad tokens).  Deliberately independent of
+    index generation: embeddings survive absorb/retrain, which is the
+    whole point of the tier."""
+    ids_row = np.ascontiguousarray(ids_row)
+    real = np.ascontiguousarray(ids_row[np.asarray(mask_row) > 0])
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(real.dtype).encode())
+    h.update(np.int64(real.size).tobytes())
+    h.update(real.tobytes())
+    return h.digest()
+
+
+def block_chain_keys(ids_row: np.ndarray, n_blocks: int, block: int) -> list:
+    """Generator prefix/KV block keys: ``key[j] = H(key[j-1] || tokens of
+    block j)`` — content addressing over the PREFIX, so block j's key
+    commits to every token before it (a block's K/V under causal
+    attention is a function of exactly that prefix).  Two prompts
+    sharing ``m`` leading blocks produce identical ``keys[:m]``."""
+    ids_row = np.ascontiguousarray(ids_row)
+    keys = []
+    prev = b"pathway-kv-root"
+    for j in range(n_blocks):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(prev)
+        h.update(ids_row[j * block : (j + 1) * block].tobytes())
+        prev = h.digest()
+        keys.append(prev)
+    return keys

@@ -1,0 +1,142 @@
+"""Tier 0: the cross-window serve-result cache.
+
+The coalescing scheduler's in-window dedup absorbs *simultaneous*
+duplicates; production hot-head traffic repeats across seconds and
+minutes ("Accelerating Retrieval-Augmented Generation", arxiv
+2412.15246).  This tier turns those repeats into ZERO-dispatch serves:
+the scheduler looks rows up before admission, and a full hit skips the
+coalescing window, the device, and the demux entirely.
+
+Keying and invalidation (cache/keys.py ``result_key``):
+
+- ``(query text, index generation, k)`` — the generation is the index's
+  public result-visibility counter (bumped by every absorb / retrain /
+  add / remove), so a mutation makes every pre-mutation entry
+  structurally unreachable: no epoch scans, no invalidation callbacks,
+  no stale-hit window.  TTL bounds staleness of everything else (doc
+  text drift behind unchanged keys).
+- Only CLEAN results are cached: a degraded serve (rerank_skipped,
+  shard_skipped, …) reflects a transient outage, and caching it would
+  pin the outage for a TTL.
+- The capture path double-checks the DISPATCH-time generation the serve
+  path stamps into ``meta["index_generation"]`` (ops/serving.py):
+  a result whose dispatch observed a newer generation than its
+  admission is never stored under the stale admission key.
+
+A hit is bit-identical to the serve that populated it — the rows ARE
+that serve's rows — which is exactly the acceptance contract: repeat a
+query at a stable generation and you get the same bytes with zero
+device work; mutate the index and the next serve re-dispatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .keys import result_key
+from .store import CacheTier, cache_enabled, env_bytes, env_float
+
+__all__ = ["ResultCache", "result_cache_from_env"]
+
+
+def _rows_fingerprint(row: Any) -> int:
+    """Integrity fingerprint for one cached result row (a list of
+    ``(key, score)`` pairs): cheap, exact for the int/float payloads,
+    recomputed on every hit so an entry mutated in place degrades to a
+    recompute instead of a wrong serve."""
+    return hash(tuple((int(k), float(s)) for k, s in row))
+
+
+class ResultCache:
+    """The serve-result tier over one bounded ``CacheTier``.
+
+    ``get_rows`` is all-or-nothing over a request's texts: a request
+    only skips dispatch when EVERY row is cached (partial hits fall
+    through to the shared batch — the embedding tier still catches the
+    encode, and a split serve would change batch composition and break
+    the bit-identity contract)."""
+
+    def __init__(
+        self,
+        max_bytes: Optional[int] = None,
+        ttl_s: Optional[float] = None,
+        max_entries: Optional[int] = None,
+    ):
+        if max_bytes is None:
+            max_bytes = env_bytes("PATHWAY_CACHE_RESULT_BYTES", 32 << 20)
+        if ttl_s is None:
+            ttl = env_float("PATHWAY_CACHE_RESULT_TTL_S", 60.0)
+            ttl_s = ttl if ttl > 0 else None
+        self._tier = CacheTier(
+            "result",
+            max_bytes=max_bytes,
+            ttl_s=ttl_s,
+            max_entries=max_entries,
+            fingerprint=_rows_fingerprint,
+        )
+
+    @property
+    def stats(self):
+        return self._tier.stats
+
+    def __len__(self) -> int:
+        return len(self._tier)
+
+    def clear(self) -> None:
+        self._tier.clear()
+
+    def get_rows(
+        self,
+        items: Sequence[Tuple[str, int]],
+        k: int,
+        deadline=None,
+    ) -> Optional[List[list]]:
+        """Rows for a full request of ``(text, generation)`` dedup items
+        at serve config ``k`` — or None unless every text hits."""
+        rows: List[list] = []
+        for text, gen in items:
+            row = self._tier.get(result_key(text, gen, k), deadline=deadline)
+            if row is None:
+                return None
+            rows.append(list(row))
+        return rows
+
+    def put_row(
+        self,
+        text: str,
+        generation: int,
+        k: int,
+        row: Sequence[Tuple[int, float]],
+        deadline=None,
+    ) -> bool:
+        try:
+            # canonicalize INSIDE the failure containment: the scheduler
+            # is generic over its target, and a target emitting rows that
+            # are not (numeric, numeric) pairs must cost a dropped store,
+            # never a failed ticket on the waiter thread
+            row = [(int(key), float(s)) for key, s in row]
+        except Exception:
+            self._tier._count("failures")
+            return False
+        # ~32 B per (key, score) pair + entry overhead
+        return self._tier.put(
+            result_key(text, generation, k),
+            row,
+            nbytes=64 + 32 * len(row),
+            deadline=deadline,
+        )
+
+    def observe_metrics(self):  # delegate: one provider per tier is enough
+        return iter(())
+
+
+def result_cache_from_env() -> Optional[ResultCache]:
+    """The scheduler's default tier-0 construction: enabled unless
+    ``PATHWAY_CACHE=0`` or ``PATHWAY_CACHE_RESULT=0``."""
+    import os
+
+    if not cache_enabled():
+        return None
+    if os.environ.get("PATHWAY_CACHE_RESULT", "1") in ("0", "false", "off"):
+        return None
+    return ResultCache()

@@ -64,6 +64,11 @@ class SentenceEncoder:
         self.mesh = mesh
         self._lock = threading.Lock()
         self._fns: Dict[tuple, Any] = {}
+        # optional tier-1 embedding cache (pathway_tpu/cache): per-row
+        # reuse on the plain encode path, keyed on token ids — opt-in
+        # via set_embed_cache (ingest/QA re-embeds of hot text); the
+        # fused serve path carries its OWN tier on FusedEncodeSearch
+        self.embed_cache = None
         # recompile tripwire: every new compile shape is counted; past the
         # budget it warns (fails under tests) — see ops/recompile_guard.py
         self._tripwire = RecompileTripwire(f"SentenceEncoder[{model}]")
@@ -161,6 +166,61 @@ class SentenceEncoder:
             self._fns[key] = fn
         return self._fns[key]
 
+    def set_embed_cache(self, cache) -> None:
+        """Arm the tier-1 embedding cache on the plain encode path
+        (``EmbeddingCache`` or None).  Cached rows are the encoder's own
+        previous outputs, device-resident — a hit skips the trunk
+        forward for that row and never crosses the host link."""
+        self.embed_cache = cache
+
+    def _cached_encode_rows(self, ids, mask, n: int):
+        """Cache wrapper for ``encode_to_device``: per-row lookup keyed
+        on token ids, ONE bucketed forward for the misses, device-side
+        composition.  The dispatch here is the plain encode's own launch
+        (same ``encoder.dispatch`` retry/fault site), guarded by the
+        cache lookup — the analyzer's cache-wrapper convention.  Twin of
+        ``ops/serving.py _cached_embeddings`` (the serve-batch contract:
+        [B, d] incl. pad rows, deadline-plumbed, serve.dispatch site) —
+        kept parallel rather than shared so the dispatch stays lexically
+        visible to the analyzer; fix cache-path bugs in BOTH."""
+        cache = self.embed_cache
+        ids = np.asarray(ids)
+        mask = np.asarray(mask)
+        # value-space signature: this path stores rows under the
+        # encoder's own normalize contract — partitioned from the serve
+        # path's metric-normalized space even on a shared tier
+        rows, misses, row_keys = cache.lookup_rows(
+            ids, mask, n, space=f"encode:{int(self.normalize)}"
+        )
+        fresh: Dict[int, Any] = {}
+        if misses:
+            n_miss = len(misses)
+            bm = _bucket(n_miss)
+            L = ids.shape[1]
+            ids_m = ids[misses]
+            mask_m = mask[misses]
+            if bm > n_miss:
+                ids_m = np.concatenate(
+                    [ids_m, np.zeros((bm - n_miss, L), ids.dtype)]
+                )
+                mask_m = np.concatenate(
+                    [mask_m, np.zeros((bm - n_miss, L), mask.dtype)]
+                )
+            with self._lock:
+                fn = self._forward_fn(bm, L)
+            observe.record_occupancy("encoder", n_miss, bm)
+            out_m = retry_call(
+                "encoder.dispatch", fn, self.params,
+                jnp.asarray(ids_m), jnp.asarray(mask_m),
+            )
+            for j, i in enumerate(misses):
+                row = out_m[j]
+                fresh[i] = row
+                cache.put_row(row_keys[i], row)
+        return jnp.stack(
+            [rows[i] if rows[i] is not None else fresh[i] for i in range(n)]
+        )
+
     def encode_to_device(self, texts: Sequence[str]):
         """Batch encode with the result left in HBM ([B, d] jax array) —
         feed ``DeviceKnnIndex.add_from_device`` for device-to-device ingest
@@ -176,6 +236,10 @@ class SentenceEncoder:
         b = _bucket(n)
         padded = list(texts) + [""] * (b - n)
         ids, mask = self.tokenizer.encode_batch(padded)
+        if self.embed_cache is not None:
+            # tier-1 reuse: known rows skip the forward; misses encode
+            # in one bucketed launch and compose on device
+            return self._cached_encode_rows(ids, mask, n)
         with self._lock:
             fn = self._forward_fn(ids.shape[0], ids.shape[1])
         # dispatch OFF the lock (lock-discipline): params/fn are stable

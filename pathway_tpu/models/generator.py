@@ -1,14 +1,38 @@
 """TextGenerator — local causal LM for chat-style generation.
 
 TPU-native analog of the reference's HFPipelineChat local generator
-(xpacks/llm/llms.py:441).  Greedy/temperature decoding runs as a
-``lax.scan`` over a fixed-size token buffer inside one jit — no per-token
-python round trips.  With random-init weights the output is noise; with a
-trained checkpoint it generates — either way the serving path, batching and
+(xpacks/llm/llms.py:441).  Decoding is a real **KV-cache decode**: one
+jitted function runs the prompt prefill (suffix only, when the prefix
+cache below has the leading blocks) and then ``lax.scan``s single-token
+steps against persistent per-layer K/V buffers — O(steps × L) attention
+instead of the old full re-attend's O(steps × L²), still with no
+per-token python round trips (ONE dispatch per generate call, as
+before).
+
+**Prefix/KV reuse** (pathway_tpu/cache/prefix.py): prompt token ids are
+content-addressed in fixed blocks under a hash chain, and the K/V of
+every full block is captured device-resident after the decode.  RAG
+prompts sharing a system-prompt + retrieved-chunk prefix prefill only
+their tails — prefill cost across a shared-prefix prompt set is
+sub-linear, measured by the ``serve_cache`` bench phase via the
+``pathway_cache_prefill_tokens_total{kind=reused|computed}`` counters.
+
+Bit-reproducibility: the KV twin (models/transformer.py
+``KVTransformerDecoder``) keeps the attention math line-for-line with
+the trunk, the K/V buffer width is constant across prefix splits, and
+masked slots carry exactly-zero probability — so warm (cached-prefix)
+decodes emit the SAME tokens as cold ones, and the KV path matches the
+legacy full re-attend decode token-for-token (tests/test_serve_cache.py
+parity tests).  ``PATHWAY_GENERATOR_KV=0`` falls back to the legacy
+decode.
+
+With random-init weights the output is noise; with a trained checkpoint
+it generates — either way the serving path, batching, caching and
 compile behavior are the product."""
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -22,7 +46,12 @@ from ..robust import retry_call
 from ._params import unbox as _unbox
 
 from .tokenizer import HashTokenizer
-from .transformer import TransformerConfig, TransformerEncoder, resolve_heads
+from .transformer import (
+    KVTransformerDecoder,
+    TransformerConfig,
+    TransformerEncoder,
+    resolve_heads,
+)
 
 __all__ = ["TextGenerator"]
 
@@ -43,6 +72,7 @@ class TextGenerator:
         seed: int = 2,
         checkpoint_path: Optional[str] = None,
         dtype=jnp.bfloat16,
+        kv_cache: Any = "env",
     ):
         self.config = TransformerConfig(
             vocab_size=vocab_size,
@@ -57,10 +87,12 @@ class TextGenerator:
         )
         self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
         self.module = TransformerEncoder(self.config)
+        self._kv_module = KVTransformerDecoder(self.config)
         self._lock = threading.Lock()
         self._fns: Dict[tuple, Any] = {}
         # recompile tripwire (ops/recompile_guard.py): decode shapes are
-        # (batch bucket, padded length, steps); a leak fails under tests
+        # (batch bucket, padded length, prefix bucket, steps); a leak
+        # fails under tests
         from ..ops.recompile_guard import RecompileTripwire
 
         self._tripwire = RecompileTripwire(f"TextGenerator[{model}]")
@@ -70,7 +102,18 @@ class TextGenerator:
         self.params = _unbox(self.params)
         # weight-tied readout: logits = h @ tok_embed.T
         self._vocab_table = None
+        # tier-2 prefix/KV cache (pathway_tpu/cache): per-generator —
+        # K/V blocks are only meaningful against this instance's params
+        if kv_cache == "env":
+            from ..cache import prefix_kv_cache_from_env
 
+            kv_cache = prefix_kv_cache_from_env()
+        self.kv_cache = kv_cache
+        self._use_kv = os.environ.get("PATHWAY_GENERATOR_KV", "1") not in (
+            "0", "false", "off",
+        )
+
+    # -- legacy full re-attend decode (parity reference / fallback) ----------
     def _decode_fn(self, B: int, L: int, steps: int):
         key = (B, L, steps)
         fn = self._fns.get(key)
@@ -113,17 +156,225 @@ class TextGenerator:
             self._fns[key] = fn
         return fn
 
+    # -- KV-cache decode -----------------------------------------------------
+    def _kv_fn(self, B: int, L_sfx: int, P: int, steps: int):
+        """Compiled prefill+decode: ``(params, suffix_ids, n_lens,
+        prefix_k, prefix_v, temperature, rng) -> (tokens [B, steps],
+        k_buf, v_buf)``.  ``P`` is the static cached-prefix split (the
+        batch-min match, bucketed to power-of-two block multiples by
+        ``_cached_prefix``) — the K/V buffer width is ``P + L_sfx +
+        steps`` == the legacy decode's constant attention width, which
+        is what makes warm and cold decodes bit-identical.
+        The returned buffers stay device-resident; the capture pass
+        slices prompt blocks out of them for the prefix cache."""
+        key = ("kv", B, L_sfx, P, steps)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+        cfg = self.config
+        decoder = self._kv_module
+        H = cfg.n_heads
+        hd = cfg.d_model // H
+        T = P + L_sfx + steps
+
+        def run(params, suffix_ids, n_lens, prefix_k, prefix_v, temperature, rng):
+            emb = params["tok_embed"]["embedding"]
+            kbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
+            vbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
+            if P:
+                kbuf = jax.lax.dynamic_update_slice(
+                    kbuf, prefix_k.astype(cfg.dtype), (0, 0, 0, 0, 0)
+                )
+                vbuf = jax.lax.dynamic_update_slice(
+                    vbuf, prefix_v.astype(cfg.dtype), (0, 0, 0, 0, 0)
+                )
+            # prefill: the suffix tokens sit at global positions
+            # [P, P + L_sfx); every row shares the static split point
+            positions = jnp.broadcast_to(
+                (P + jnp.arange(L_sfx, dtype=jnp.int32))[None, :], (B, L_sfx)
+            )
+            write_pos = jnp.full((B,), P, jnp.int32)
+            hidden, kbuf, vbuf = decoder.apply(
+                {"params": params}, suffix_ids, positions, kbuf, vbuf,
+                write_pos, positions,
+            )
+            logits = jnp.einsum(
+                "bld,vd->blv", hidden.astype(jnp.float32), emb.astype(jnp.float32)
+            )
+            # first decode logits: the last REAL prompt position, in
+            # suffix-local coordinates (the prefix cache always leaves
+            # >= 1 real suffix token, so n - 1 - P >= 0 on real rows)
+            last0 = jnp.take_along_axis(
+                logits,
+                jnp.maximum(n_lens - 1 - P, 0)[:, None, None],
+                axis=1,
+            )[:, 0, :]
+
+            def step(carry, _):
+                kbuf_c, vbuf_c, last, pos, rng_c = carry
+                rng_c, sub = jax.random.split(rng_c)
+                greedy = jnp.argmax(last, axis=-1)
+                sampled = jax.random.categorical(
+                    sub, last / jnp.maximum(temperature, 1e-4)
+                )
+                nxt = jnp.where(temperature <= 0.0, greedy, sampled).astype(
+                    jnp.int32
+                )
+                h1, kbuf_c, vbuf_c = decoder.apply(
+                    {"params": params}, nxt[:, None], pos[:, None],
+                    kbuf_c, vbuf_c, pos, pos[:, None],
+                )
+                logits1 = jnp.einsum(
+                    "bld,vd->blv",
+                    h1.astype(jnp.float32),
+                    emb.astype(jnp.float32),
+                )[:, 0, :]
+                return (kbuf_c, vbuf_c, logits1, pos + 1, rng_c), nxt
+
+            (kbuf, vbuf, _, _, _), toks = jax.lax.scan(
+                step, (kbuf, vbuf, last0, n_lens, rng), None, length=steps
+            )
+            return toks.T, kbuf, vbuf  # toks [B, steps]
+
+        fn = jax.jit(run)
+        self._fns[key] = fn
+        return fn
+
+    def _cached_prefix(self, ids: np.ndarray, n_lens: np.ndarray, n: int):
+        """Cache wrapper for the prefix tier: per-row longest cached
+        block chain, batched at the row MINIMUM (the static split point
+        every row shares — the RAG shape is many prompts over one
+        system+chunks prefix, where the minimum IS the shared prefix),
+        then rounded DOWN to a power-of-two block multiple so the split
+        point (a compile-shape dimension) takes O(log) values instead of
+        one per distinct prefix length — a mix of prompt families must
+        not compile one decode program each.  Returns ``(P, matches)``;
+        pure host + cache work, no dispatch."""
+        matches = [
+            self.kv_cache.match(ids[i], int(n_lens[i])) for i in range(n)
+        ]
+        P = min((m[0] for m in matches), default=0)
+        blk = self.kv_cache.block
+        bucket = 0
+        step = blk
+        while step <= P:
+            bucket = step
+            step *= 2
+        return bucket, matches
+
+    def _generate_kv(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: int,
+        temperature: float,
+        seed: int,
+    ) -> List[str]:
+        cfg = self.config
+        n = len(prompts)
+        # tokenize + pad OFF the lock (the tokenizer is stateless), same
+        # discipline as the serve/encode paths: concurrent generates
+        # overlap their host prep; the lock covers only the compiled-fn
+        # cache below
+        from .encoder import _bucket
+
+        b = _bucket(n)
+        texts = [str(p) for p in prompts] + [""] * (b - n)
+        L_budget = cfg.max_len - max_new_tokens
+        ids, mask = self.tokenizer.encode_batch(texts, max_length=L_budget)
+        ids = np.asarray(ids)
+        mask = np.asarray(mask)
+        n_lens = mask.sum(axis=1).astype(np.int32)
+        # tier-2 lookup OFF the lock (cache traffic, incl. chaos sites,
+        # must never stall a concurrent generate)
+        P, matches = (0, [])
+        if self.kv_cache is not None:
+            P, matches = self._cached_prefix(ids, n_lens, n)
+        L_sfx = ids.shape[1] - P
+        H = cfg.n_heads
+        hd = cfg.d_model // H
+        if P:
+            n_pblk = P // self.kv_cache.block
+            rows_k = []
+            rows_v = []
+            for i in range(b):
+                if i < n:
+                    blocks = matches[i][1][:n_pblk]
+                    rows_k.append(jnp.concatenate([blk[0] for blk in blocks], axis=1))
+                    rows_v.append(jnp.concatenate([blk[1] for blk in blocks], axis=1))
+                else:
+                    rows_k.append(jnp.zeros((cfg.n_layers, P, H, hd), cfg.dtype))
+                    rows_v.append(jnp.zeros((cfg.n_layers, P, H, hd), cfg.dtype))
+            prefix_k = jnp.stack(rows_k)
+            prefix_v = jnp.stack(rows_v)
+        else:
+            prefix_k = jnp.zeros((b, cfg.n_layers, 0, H, hd), cfg.dtype)
+            prefix_v = jnp.zeros((b, cfg.n_layers, 0, H, hd), cfg.dtype)
+        with self._lock:
+            fn = self._kv_fn(b, L_sfx, P, max_new_tokens)
+        t0 = time.perf_counter_ns()
+        observe.record_occupancy("generator", n, b)
+        # "generator.dispatch" is the retry/fault site: a generator that
+        # stays down raises out of here, and the QA layer's ladder rung
+        # answers extractively from the retrieved passages instead
+        toks, kbuf, vbuf = retry_call(
+            "generator.dispatch",
+            fn,
+            self.params,
+            jnp.asarray(ids[:, P:]),
+            jnp.asarray(n_lens),
+            prefix_k,
+            prefix_v,
+            jnp.float32(temperature),
+            jax.random.PRNGKey(seed),
+        )
+        toks = np.asarray(toks)[:n]
+        _H_READY.observe_ns(time.perf_counter_ns() - t0)
+        # capture: admit the prompt's uncached full blocks as async
+        # device slices of the returned buffers (prompt region only —
+        # block j covers buffer positions [j*blk, (j+1)*blk), identical
+        # in global and buffer coordinates since the prefix sits at 0)
+        if self.kv_cache is not None:
+            blk = self.kv_cache.block
+            for i in range(n):
+                matched, _blocks, chain = matches[i]
+                self.kv_cache.admit(
+                    chain,
+                    matched // blk,
+                    lambda j, row=i: (
+                        kbuf[row, :, j * blk : (j + 1) * blk],
+                        vbuf[row, :, j * blk : (j + 1) * blk],
+                    ),
+                )
+                self.kv_cache.note_prefill(
+                    reused=P, computed=int(n_lens[i]) - P
+                )
+        # hashing tokenizer is not invertible; render token ids
+        return [
+            " ".join(f"<{int(t)}>" for t in row if t != self.tokenizer.PAD)
+            for row in toks
+        ]
+
     def generate(
         self,
         prompts: Sequence[str],
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         seed: int = 0,
+        use_kv: Optional[bool] = None,
     ) -> List[str]:
+        """Generate ``max_new_tokens`` per prompt.  ``use_kv`` overrides
+        the decode path (None = the ``PATHWAY_GENERATOR_KV`` default):
+        the KV path and the legacy full re-attend emit identical tokens
+        — the legacy path survives as the parity oracle and fallback."""
+        if not prompts:
+            return []
+        if use_kv if use_kv is not None else self._use_kv:
+            return self._generate_kv(
+                prompts, max_new_tokens, temperature, seed
+            )
         with self._lock:
             n = len(prompts)
-            if n == 0:
-                return []
             from .encoder import _bucket
 
             b = _bucket(n)
@@ -140,9 +391,6 @@ class TextGenerator:
         # compiled-fn cache
         t0 = time.perf_counter_ns()
         observe.record_occupancy("generator", n, b)
-        # "generator.dispatch" is the retry/fault site: a generator that
-        # stays down raises out of here, and the QA layer's ladder rung
-        # answers extractively from the retrieved passages instead
         toks = retry_call(
             "generator.dispatch",
             fn,

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
+    "KVTransformerDecoder",
     "TransformerConfig",
     "TransformerEncoder",
     "normalized_token_states",
@@ -175,6 +176,142 @@ class EncoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=cfg.dtype)(x)
         x = x + MlpBlock(cfg)(h)
         return x
+
+
+class KVSelfAttention(nn.Module):
+    """Params-compatible incremental twin of ``SelfAttention``: attends
+    ``Ln`` NEW tokens against a persistent K/V buffer instead of
+    re-projecting the whole sequence.  The new tokens' K/V are inserted
+    at ``write_pos`` (per row) and the updated buffers returned — the
+    caller (``KVTransformerDecoder``) threads them through the decode.
+
+    Numerics are kept LINE-FOR-LINE with ``SelfAttention`` (same
+    projection names/dtypes, same ``big_neg`` masking, f32 softmax):
+    under causal attention a position's K/V depends only on tokens at or
+    before it, so for real query positions the score rows here are
+    bit-identical to the full re-attend — the parity test in
+    tests/test_serve_cache.py holds token-for-token."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, k_cache, v_cache, write_pos, q_pos):
+        cfg = self.config
+        B, Ln, D = x.shape
+        T = k_cache.shape[1]
+        head_dim = cfg.d_model // cfg.n_heads
+
+        def proj(name, logical):
+            return nn.Dense(
+                cfg.d_model,
+                dtype=cfg.dtype,
+                name=name,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.xavier_uniform(), logical
+                ),
+            )
+
+        q = proj("query", ("embed", "heads"))(x)
+        k_new = proj("key", ("embed", "heads"))(x)
+        v_new = proj("value", ("embed", "heads"))(x)
+        q = q.reshape(B, Ln, cfg.n_heads, head_dim)
+        k_new = k_new.reshape(B, Ln, cfg.n_heads, head_dim)
+        v_new = v_new.reshape(B, Ln, cfg.n_heads, head_dim)
+        # insert the new tokens' K/V at each row's write position (rows
+        # decode at different offsets: prompts have different lengths)
+        insert = jax.vmap(
+            lambda buf, new, p: jax.lax.dynamic_update_slice(
+                buf, new, (p, 0, 0)
+            )
+        )
+        k_cache = insert(k_cache, k_new, write_pos)
+        v_cache = insert(v_cache, v_new, write_pos)
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k_cache) / np.sqrt(head_dim)
+        big_neg = jnp.finfo(jnp.float32).min
+        # query at global position q_pos[b, l] attends key slot t iff
+        # t <= q_pos — slots past the write frontier are either unwritten
+        # (zeros) or stale pad K/V, and both are masked to exact zero
+        # probability, so they can never perturb the output
+        key_pos = jnp.arange(T, dtype=jnp.int32)
+        attn_mask = key_pos[None, None, :] <= q_pos[:, :, None]
+        scores = jnp.where(attn_mask[:, None, :, :], scores, big_neg)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhlm,bmhd->blhd", probs, v_cache).reshape(
+            B, Ln, cfg.d_model
+        )
+        return proj("out", ("heads", "embed"))(out), k_cache, v_cache
+
+
+class KVEncoderBlock(nn.Module):
+    """Params-compatible incremental twin of ``EncoderBlock`` — explicit
+    submodule names pin the param tree to the trunk's layout."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, k_cache, v_cache, write_pos, q_pos):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_0")(x)
+        attn, k_cache, v_cache = KVSelfAttention(
+            cfg, name="SelfAttention_0"
+        )(h, k_cache, v_cache, write_pos, q_pos)
+        x = x + attn
+        h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_1")(x)
+        x = x + MlpBlock(cfg, name="MlpBlock_0")(h)
+        return x, k_cache, v_cache
+
+
+class KVTransformerDecoder(nn.Module):
+    """Incremental causal decode over the SAME params as a causal
+    ``TransformerEncoder`` (the generator trunk): forward ``Ln`` new
+    tokens against per-layer K/V buffers ``[B, n_layers, T, H, hd]``,
+    returning the final-LN hidden states for those tokens plus the
+    updated buffers.  One module serves both phases of a KV decode:
+
+    - **prefill**: ``Ln`` = the prompt suffix, ``write_pos`` = the
+      cached-prefix length (0 cold);
+    - **decode step**: ``Ln = 1``, ``write_pos`` = the row's current
+      token count.
+
+    This is what turns the generator's O(steps × L²) re-attend decode
+    into O(steps × L) — and, with the prefix cache
+    (pathway_tpu/cache/prefix.py), lets prompts sharing a prefix skip
+    its prefill entirely."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, ids_new, positions, k_caches, v_caches, write_pos, q_pos):
+        cfg = self.config
+        tok = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="tok_embed",
+        )(ids_new)
+        pos = nn.Embed(
+            cfg.max_len,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("pos", "embed")
+            ),
+            name="pos_embed",
+        )(positions)
+        x = tok + pos
+        new_k = []
+        new_v = []
+        for i in range(cfg.n_layers):
+            x, ki, vi = KVEncoderBlock(cfg, name=f"block_{i}")(
+                x, k_caches[:, i], v_caches[:, i], write_pos, q_pos
+            )
+            new_k.append(ki)
+            new_v.append(vi)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_ln")(x)
+        return x, jnp.stack(new_k, axis=1), jnp.stack(new_v, axis=1)
 
 
 class TransformerEncoder(nn.Module):

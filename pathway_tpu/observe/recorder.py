@@ -382,8 +382,9 @@ def snapshot() -> Dict[str, Any]:
     """JSON-able view for ``GET /serve_stats``: per-series histogram
     summaries (count/sum/p50/p95/p99 bucket-bound estimates), counters,
     gauges (provider-sampled), a per-shard column (every provider
-    sample labeled ``shard=...`` grouped by shard id), and the recent
-    event ring."""
+    sample labeled ``shard=...`` grouped by shard id), a per-tier cache
+    column (samples labeled ``tier=...`` — the pathway_tpu/cache
+    hit/miss/evict/bytes families), and the recent event ring."""
     with _registry_lock:
         hist_items = {name: dict(series) for name, series in _hists.items()}
         counter_items = {
@@ -425,6 +426,11 @@ def snapshot() -> Dict[str, Any]:
     # keying by bare metric name would let whichever provider iterates
     # last silently overwrite the others
     shards: Dict[str, Dict[str, float]] = {}
+    # the cache column: provider samples labeled tier=... (the
+    # pathway_tpu/cache tiers) grouped per tier, same shape as shards —
+    # /serve_stats readers get hit/miss/evict/bytes per tier without
+    # parsing Prometheus label strings
+    caches: Dict[str, Dict[str, float]] = {}
     for kind, name, key, value in _provider_samples():
         target = counters if kind == "counter" else gauges
         target[series_name(name, key)] = value
@@ -435,6 +441,10 @@ def snapshot() -> Dict[str, Any]:
                 (lk, lv) for lk, lv in key if lk != "shard"
             )
             shards.setdefault(shard, {})[series_name(name, rest)] = value
+        tier = labels.get("tier")
+        if tier is not None:
+            rest = tuple((lk, lv) for lk, lv in key if lk != "tier")
+            caches.setdefault(tier, {})[series_name(name, rest)] = value
     events, total = _ring.snapshot()
     return {
         "enabled": _state.enabled,
@@ -442,6 +452,7 @@ def snapshot() -> Dict[str, Any]:
         "counters": counters,
         "gauges": gauges,
         "shards": {k: shards[k] for k in sorted(shards, key=_shard_sort_key)},
+        "caches": {k: caches[k] for k in sorted(caches)},
         "events": [
             {
                 "ts": e[0],

@@ -531,7 +531,15 @@ class RetrieveRerankPipeline:
         """Result-visibility generation of the stage-1 index, for the
         coalescing scheduler's generation-keyed in-window dedup (an
         absorb/retrain landing mid-window must not let a later rider
-        share a slot dispatched against the pre-mutation index)."""
+        share a slot dispatched against the pre-mutation index).
+
+        The serve-cache plumb-through rides the same counter: stage 1
+        stamps its DISPATCH-time generation into
+        ``meta["index_generation"]`` (ops/serving.py), ``_submit_chain``
+        merges stage-1 meta into the final ``ServeResult``, and the
+        scheduler's tier-0 capture refuses any row whose dispatch
+        observed a newer generation than its admission key
+        (serve/scheduler.py ``_demux``)."""
         gen_fn = getattr(self.retriever, "index_generation", None)
         if callable(gen_fn):
             return int(gen_fn())

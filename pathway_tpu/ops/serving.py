@@ -72,12 +72,23 @@ class FusedEncodeSearch:
     remove) never recompile."""
 
     def __init__(self, encoder, index, k: int = 10,
-                 export_query_tokens: bool = False):
+                 export_query_tokens: bool = False,
+                 embed_cache: Any = "env"):
         self.encoder = encoder
         self.index = index
         self.k = k
         self._lock = threading.Lock()
         self._fns: Dict[Tuple, Any] = {}
+        # tier-1 query-embedding cache (pathway_tpu/cache): keyed on
+        # token ids, so a known query skips the stage-1 trunk forward
+        # even after an index mutation invalidated its result-cache
+        # entry.  ``"env"`` resolves the PATHWAY_CACHE_EMBED knob
+        # (opt-in); pass an EmbeddingCache or None explicitly otherwise.
+        if embed_cache == "env":
+            from ..cache import embedding_cache_from_env
+
+            embed_cache = embedding_cache_from_env()
+        self.embed_cache = embed_cache
         # recompile tripwire (ops/recompile_guard.py): the fused kernel
         # must stay at a handful of compile shapes in steady state
         self._tripwire = RecompileTripwire("FusedEncodeSearch")
@@ -155,9 +166,15 @@ class FusedEncodeSearch:
 
         return forward
 
-    def _compiled(self, B: int, L: int, k: int, capacity: int):
-        export = self._exporting()
-        key = (B, L, k, capacity, export)
+    def _compiled(self, B: int, L: int, k: int, capacity: int,
+                  from_z: bool = False):
+        """Exact-index stage-1 kernel.  ``from_z=False`` is the classic
+        fused encode+search (params, ids, mask, ...); ``from_z=True`` is
+        the SEARCH-ONLY twin taking a precomputed (metric-normalized)
+        ``[B, d]`` embedding — the embedding-cache path composes cached
+        and fresh rows on device and skips the trunk forward here."""
+        export = self._exporting() and not from_z
+        key = (B, L, k, capacity, export, from_z)
         fn = self._fns.get(key)
         if fn is not None:
             return fn
@@ -166,13 +183,7 @@ class FusedEncodeSearch:
         normalize = metric == "cos"
         forward = self._query_forward(export)
 
-        @jax.jit
-        def fused(params, ids, mask, matrix, valid, keys_hi, keys_lo):
-            z, qtok = forward(params, ids, mask)
-            if normalize:
-                z = z / jnp.maximum(
-                    jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
-                )
+        def search(z, qtok, matrix, valid, keys_hi, keys_lo):
             scores = jnp.dot(
                 z.astype(matrix.dtype),
                 matrix.T,
@@ -202,16 +213,39 @@ class FusedEncodeSearch:
                 return packed, qtok
             return packed
 
+        if from_z:
+
+            @jax.jit
+            def fused(z, matrix, valid, keys_hi, keys_lo):
+                # z arrives already metric-normalized (_encode_fn /
+                # cached rows captured from it)
+                return search(z, None, matrix, valid, keys_hi, keys_lo)
+
+        else:
+
+            @jax.jit
+            def fused(params, ids, mask, matrix, valid, keys_hi, keys_lo):
+                z, qtok = forward(params, ids, mask)
+                if normalize:
+                    z = z / jnp.maximum(
+                        jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
+                    )
+                return search(z, qtok, matrix, valid, keys_hi, keys_lo)
+
         self._fns[key] = fused
         return fused
 
-    def _compiled_ivf(self, B: int, L: int, k: int, t_pad: int):
+    def _compiled_ivf(self, B: int, L: int, k: int, t_pad: int,
+                      from_z: bool = False):
         """Returns (fused_fn, k_main, k_tail) — the kernel's output is
         [B, 2*k_main + 2*k_tail] int32 columns: k_main score bit-patterns,
         k_main slots, then k_tail tail-score bit-patterns, k_tail tail row
         indices.  ``t_pad`` is the bucketed exact-tail size (0 = no tail):
         fresh rows not yet absorbed into the slabs are brute-force scored
-        INSIDE the same dispatch, so serving never triggers a rebuild."""
+        INSIDE the same dispatch, so serving never triggers a rebuild.
+        ``from_z=True`` is the search-only twin over a precomputed
+        metric-normalized ``[B, d]`` embedding (the embedding-cache
+        path) — probe + rescore + tail scan unchanged, no trunk forward."""
         index = self.index
         normalize = index.metric == "cos"
         M = index._M_pad
@@ -221,13 +255,14 @@ class FusedEncodeSearch:
         p = min(p, C)
         k_main = min(k, p * M)
         k_tail = min(k, t_pad) if t_pad else 0
-        export = self._exporting()
+        export = self._exporting() and not from_z
         shape_key = (
             "ivf", B, L, k, p, t_pad,
             index._slabs.shape[0],
             C,
             M,
             export,
+            from_z,
         )
         fn = self._fns.get(shape_key)
         if fn is not None:
@@ -236,13 +271,7 @@ class FusedEncodeSearch:
         use_pallas = jax.default_backend() == "tpu"
         forward = self._query_forward(export)
 
-        @jax.jit
-        def fused(params, ids, mask, slabs, bias, centroids, tail_mat, tail_valid):
-            z, qtok = forward(params, ids, mask)
-            if normalize:
-                z = z / jnp.maximum(
-                    jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
-                )
+        def search(z, qtok, slabs, bias, centroids, tail_mat, tail_valid):
             cscores = jnp.dot(
                 z.astype(centroids.dtype), centroids.T,
                 preferred_element_type=jnp.float32,
@@ -284,6 +313,29 @@ class FusedEncodeSearch:
                 return packed, qtok
             return packed
 
+        if from_z:
+
+            @jax.jit
+            def fused(z, slabs, bias, centroids, tail_mat, tail_valid):
+                return search(
+                    z, None, slabs, bias, centroids, tail_mat, tail_valid
+                )
+
+        else:
+
+            @jax.jit
+            def fused(
+                params, ids, mask, slabs, bias, centroids, tail_mat, tail_valid
+            ):
+                z, qtok = forward(params, ids, mask)
+                if normalize:
+                    z = z / jnp.maximum(
+                        jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-9
+                    )
+                return search(
+                    z, qtok, slabs, bias, centroids, tail_mat, tail_valid
+                )
+
         self._fns[shape_key] = fused
         return fused, k_main, k_tail
 
@@ -318,6 +370,64 @@ class FusedEncodeSearch:
 
             self._fns[key] = fn
             return fn
+
+    def _cached_embeddings(self, ids, mask, n_real: int, deadline=None):
+        """Tier-1 cache wrapper (pathway_tpu/cache): resolve the batch's
+        query embeddings — cached device rows where the token ids are
+        known, ONE bucketed ``_encode_fn`` launch for the misses — and
+        compose them into the shared ``[B, d]`` device batch the
+        search-only kernels consume.  Returns ``(z, encoded)`` where
+        ``encoded`` says whether an encode launch happened (the caller
+        reports it inside the stage-1 logical dispatch group via
+        ``record_dispatch(tag, shards=...)`` — the analyzer's
+        cache-wrapper convention: a dispatch guarded by a cache lookup
+        is accounted by the serve path that owns the group).  All cache
+        traffic stays off the serve/index locks; fresh rows are captured
+        as async device slices (no fetch, no upload).
+
+        ``models/encoder.py _cached_encode_rows`` is this wrapper's twin
+        for the plain encode contract ([n, d], its own retry site, no
+        deadline plumbing) — deliberately parallel rather than shared,
+        so each dispatch stays lexically visible to the analyzer; a
+        cache-path fix here almost certainly applies there too."""
+        cache = self.embed_cache
+        B, L = ids.shape
+        # value-space signature: rows here are the fused trunk's
+        # metric-normalized f32 embeddings — a tier shared with the
+        # plain encoder must never serve its rows into this space
+        rows, misses, row_keys = cache.lookup_rows(
+            ids, mask, n_real, deadline=deadline,
+            space=f"serve:{self.index.metric}",
+        )
+        fresh: Dict[int, Any] = {}
+        if misses:
+            n_miss = len(misses)
+            Bm = _bucket(n_miss)
+            ids_m = ids[misses]
+            mask_m = mask[misses]
+            if Bm > n_miss:
+                ids_m = np.concatenate(
+                    [ids_m, np.zeros((Bm - n_miss, L), ids.dtype)]
+                )
+                mask_m = np.concatenate(
+                    [mask_m, np.zeros((Bm - n_miss, L), mask.dtype)]
+                )
+            enc = self._encode_fn(Bm, L)
+            z_m = retry_call(
+                "serve.dispatch", enc, self.encoder.params,
+                jnp.asarray(ids_m), jnp.asarray(mask_m), deadline=deadline,
+            )
+            for j, i in enumerate(misses):
+                row = z_m[j]
+                fresh[i] = row
+                cache.put_row(row_keys[i], row, deadline=deadline)
+        d = self.index.dimension
+        parts = [
+            rows[i] if rows[i] is not None else fresh[i]
+            for i in range(n_real)
+        ]
+        parts += [jnp.zeros((d,), jnp.float32)] * (B - n_real)
+        return jnp.stack(parts), bool(misses)
 
     def _shard_search_fn(self, child, B: int, K: int, t_pad: int):
         """Compiled per-shard search kernel: ``(z [B, d] f32, slabs,
@@ -476,8 +586,13 @@ class FusedEncodeSearch:
         index = self.index
         group = index.group
         shards = index.shards
+        # dispatch-time GROUP generation snapshot (sums the shard gens),
+        # stamped into the result for the tier-0 capture guard
+        gen0 = self.index_generation()
         if len(index) == 0:
-            empty = ServeResult([[] for _ in texts])
+            empty = ServeResult(
+                [[] for _ in texts], meta={"index_generation": gen0}
+            )
             handle = lambda: empty  # noqa: E731
             handle.query_tokens = None
             handle.query_mask = mask
@@ -582,7 +697,9 @@ class FusedEncodeSearch:
                     f"every nonempty shard failed stage-1 dispatch "
                     f"(skipped={skipped})"
                 )
-            empty = ServeResult([[] for _ in texts])
+            empty = ServeResult(
+                [[] for _ in texts], meta={"index_generation": gen0}
+            )
             handle = lambda: empty  # noqa: E731
             handle.query_tokens = qtok
             handle.query_mask = mask
@@ -678,9 +795,9 @@ class FusedEncodeSearch:
                 flags.append(TAIL_SKIPPED)
             if skipped:
                 flags.append(SHARD_SKIPPED)
-            meta = (
-                {"shards_skipped": tuple(skipped)} if skipped else None
-            )
+            meta: Dict[str, Any] = {"index_generation": gen0}
+            if skipped:
+                meta["shards_skipped"] = tuple(skipped)
             return ServeResult(results, degraded=flags, meta=meta)
 
         complete.query_tokens = qtok
@@ -697,6 +814,8 @@ class FusedEncodeSearch:
         k: int,
         t_start: int,
         deadline: Optional[Deadline] = None,
+        z=None,
+        stage1_launches: int = 1,
     ):
         """IVF flavor of submit (holds both locks; ``ids``/``mask`` were
         tokenized and bucket-padded OFF them by the caller): centroid
@@ -708,10 +827,19 @@ class FusedEncodeSearch:
         (O(B*k)) — the key mapping is snapshotted AT DISPATCH
         (keys_by_slot reference + tail key list), so completion reflects
         dispatch-time state even if a rebuild or removal lands in between
-        (ADVICE r4 low #3)."""
+        (ADVICE r4 low #3).
+
+        ``z`` (embedding-cache path) is a precomputed metric-normalized
+        ``[B, d]`` device embedding: the search-only kernel twin skips
+        the trunk forward, and ``stage1_launches`` carries the launch
+        group's physical width (2 when the cache wrapper encoded misses,
+        1 all-hit) into the dispatch counter's group accounting."""
         index = self.index
         if len(index) == 0:
-            empty = ServeResult([[] for _ in texts])
+            empty = ServeResult(
+                [[] for _ in texts],
+                meta={"index_generation": self.index_generation()},
+            )
             return lambda: empty
         if index._slabs is None:
             index.build()  # first build only: nothing to serve from yet
@@ -729,12 +857,13 @@ class FusedEncodeSearch:
         # the degraded counter was bumped by the snapshot itself
         tail_skipped = bool(getattr(index, "tail_degraded", False))
         fn, k_main, k_tail = self._compiled_ivf(
-            ids.shape[0], ids.shape[1], k_eff, t_pad
+            ids.shape[0], ids.shape[1], k_eff, t_pad, from_z=z is not None
         )
-        args = [
-            self.encoder.params,
-            ids,
-            mask,
+        if z is not None:
+            args = [z]
+        else:
+            args = [self.encoder.params, ids, mask]
+        args += [
             index._slabs,
             index._bias,
             index._centroids
@@ -743,10 +872,14 @@ class FusedEncodeSearch:
             tail_dev,
             tail_valid_dev,
         ]
+        # dispatch-time generation snapshot, stamped into the result so
+        # the tier-0 capture can refuse a row whose dispatch observed a
+        # newer index state than its admission key
+        gen0 = self.index_generation()
         # transient dispatch failures retry with backoff under the site's
         # budget ("ivf.dispatch" is also the chaos-suite fault site); the
         # deadline bounds both the attempts and the backoff sleeps
-        if self._exporting():
+        if self._exporting() and z is None:
             out, qtok = retry_call(
                 "ivf.dispatch", fn, *args,
                 deadline=deadline, policy=_LOCKED_DISPATCH_RETRY,
@@ -757,7 +890,7 @@ class FusedEncodeSearch:
                 deadline=deadline, policy=_LOCKED_DISPATCH_RETRY,
             )
             qtok = None
-        record_dispatch("serve_ivf")
+        record_dispatch("serve_ivf", shards=stage1_launches)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         # instrumentation: timestamps only between dispatch and fetch —
@@ -808,7 +941,9 @@ class FusedEncodeSearch:
                 results.append(dedup[:k])
             _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
             return ServeResult(
-                results, degraded=(TAIL_SKIPPED,) if tail_skipped else ()
+                results,
+                degraded=(TAIL_SKIPPED,) if tail_skipped else (),
+                meta={"index_generation": gen0},
             )
 
         # DEVICE-RESIDENT query token states for a late-interaction rerank
@@ -862,19 +997,57 @@ class FusedEncodeSearch:
             return self._submit_sharded(
                 texts, ids, mask, n_real, k, t_start, deadline
             )
+        # tier-1 embedding cache (pathway_tpu/cache): resolve the batch's
+        # embeddings BEFORE any serve lock — cached device rows compose
+        # with one bucketed encode launch for the misses, and the search
+        # kernels below run their from_z twins.  Gated off while a
+        # late-interaction stage needs the per-token export (pooled rows
+        # cannot stand in for token states).
+        z = None
+        stage1_launches = 1
+        if self.embed_cache is not None and not self._exporting():
+            z, encoded = self._cached_embeddings(ids, mask, n_real, deadline)
+            stage1_launches = 2 if encoded else 1
         if self._ivf:
             with index._lock, self._lock:
                 return self._submit_ivf(
-                    texts, ids, mask, n_real, k, t_start, deadline
+                    texts, ids, mask, n_real, k, t_start, deadline,
+                    z=z, stage1_launches=stage1_launches,
                 )
+        return self._submit_exact(
+            texts, ids, mask, n_real, k, t_start, deadline,
+            z=z, stage1_launches=stage1_launches,
+        )
+
+    def _submit_exact(
+        self,
+        texts: Sequence[str],
+        ids: np.ndarray,
+        mask: np.ndarray,
+        n_real: int,
+        k: int,
+        t_start: int,
+        deadline: Optional[Deadline] = None,
+        z=None,
+        stage1_launches: int = 1,
+    ):
+        """Exact-index flavor of submit (``ids``/``mask`` tokenized and
+        bucket-padded off-lock by the caller; ``z``/``stage1_launches``
+        as in ``_submit_ivf``)."""
+        index = self.index
         with index._lock, self._lock:
             n_items = len(index.key_to_slot)
             if n_items == 0:
-                empty = ServeResult([[] for _ in texts])
+                empty = ServeResult(
+                    [[] for _ in texts],
+                    meta={"index_generation": self.index_generation()},
+                )
                 return lambda: empty
             k_eff = min(k, n_items)
             B, L = ids.shape
-            fn = self._compiled(B, L, k_eff, index.capacity)
+            fn = self._compiled(
+                B, L, k_eff, index.capacity, from_z=z is not None
+            )
             # capture the device view under the lock; LAUNCH off it.  The
             # exact index replaces matrix/valid/keys functionally (never
             # in place, never donated), so refs snapshotted here stay
@@ -883,25 +1056,28 @@ class FusedEncodeSearch:
             # before unlocking.  Nothing else host-side to snapshot: the
             # winners' keys come back IN the packed output, and a slot
             # removed at snapshot time scores -inf and is dropped below.
-            args = (
-                self.encoder.params,
-                ids,
-                mask,
+            planes = (
                 index._matrix,
                 index._valid,
                 index._keys_hi,
                 index._keys_lo,
             )
+            args = (
+                (z,) + planes
+                if z is not None
+                else (self.encoder.params, ids, mask) + planes
+            )
+            gen0 = self.index_generation()  # dispatch-time snapshot
         # transient dispatch failures retry with backoff ("serve.dispatch"
         # doubles as the chaos-suite fault site); deadline bounds attempts
-        if self._exporting():
+        if self._exporting() and z is None:
             out, qtok = retry_call(
                 "serve.dispatch", fn, *args, deadline=deadline
             )
         else:
             out = retry_call("serve.dispatch", fn, *args, deadline=deadline)
             qtok = None
-        record_dispatch("serve_exact")
+        record_dispatch("serve_exact", shards=stage1_launches)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         t_dispatch = time.perf_counter_ns()
@@ -929,7 +1105,7 @@ class FusedEncodeSearch:
                     row.append((int(keys[qi, j]), s))
                 results.append(row[:k])
             _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
-            return ServeResult(results)
+            return ServeResult(results, meta={"index_generation": gen0})
 
         # device-resident query token states for a late-interaction stage
         # (see _submit_ivf): attached, never fetched here

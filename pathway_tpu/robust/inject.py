@@ -6,12 +6,15 @@ degradation ladder — ``robust/retry.py``, ``robust/degrade.py``) must be
 deterministic nor portable to CPU CI.  This registry gives each
 instrumented failure point a NAME — ``ivf.dispatch``,
 ``cross_encoder.fetch``, ``exchange.send``, ``ivf.absorb``,
-``forward.upload``, ``forward.gather``, ``forward.absorb``, and the
+``forward.upload``, ``forward.gather``, ``forward.absorb``, the
 sharded-serve family ``shard.dispatch`` / ``shard.merge`` /
 ``shard.absorb`` (each also addressable per shard as
 ``shard.<site>.<n>``, so a game-day can kill exactly one shard of a
-group), … — and lets a test (or an operator running a game-day) arm
-any site to
+group), and the serve-cache pair ``cache.get`` / ``cache.put``
+(pathway_tpu/cache — a faulted lookup degrades to a recompute MISS and
+a faulted store drops the entry; the serve result is never wrong and
+never fails, proven by the chaos triple in tests/test_robust.py), … —
+and lets a test (or an operator running a game-day) arm any site to
 
 - ``raise`` a ``FaultInjected`` (a transient dispatch/socket error),
 - ``delay`` execution by a fixed duration (a slow link or device), or

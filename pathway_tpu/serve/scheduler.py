@@ -35,6 +35,10 @@ splits the work so no thread ever blocks while holding the queue lock:
   *sorted* unique text list, so identical windows produce bit-identical
   device batches (and therefore bit-identical results) regardless of
   thread arrival order.
+- **Tier-0 result cache** (``pathway_tpu/cache``): cross-WINDOW repeats
+  — the hot-head traffic in-window dedup cannot see — resolve before
+  admission under ``(text, index generation, k)``: zero dispatches, no
+  window wait, generation-bump invalidation (see ``ServeScheduler``).
 - **Degradation stays per-request**: a stage-1 failure inside a
   coalesced batch flags ``retrieval_failed`` on (and counts) each rider
   of that batch, and the next batch starts clean — one bad window never
@@ -68,6 +72,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import observe
+from ..cache import query_key, result_cache_from_env
 from ..robust import Deadline, RETRIEVAL_FAILED, ServeResult, log_once, record_degraded
 
 __all__ = [
@@ -115,6 +120,7 @@ class _Request:
 
     __slots__ = (
         "items", "k", "deadline", "t_enqueue_ns", "event", "batch", "slots",
+        "cache_store",
     )
 
     def __init__(self, items: Sequence[Any], k: Optional[int], deadline):
@@ -125,6 +131,9 @@ class _Request:
         self.event = threading.Event()
         self.batch: Optional["_Batch"] = None
         self.slots: List[int] = []
+        # tier-0 capture flag: set at admission when a result cache is
+        # armed (cache-hit tickets never re-store their own rows)
+        self.cache_store = False
 
 
 class _Batch:
@@ -572,6 +581,18 @@ class ServeScheduler(_CoalescerBase):
     or recovering replica sheds load automatically; per-replica
     queue-depth gauges and placement counters export on the scrape
     surface (``pathway_serve_replica_*``).
+
+    **Tier-0 result cache** (``pathway_tpu/cache``): before admission,
+    the request's rows are looked up under ``(text, index generation,
+    k)`` — a full hit resolves the ticket immediately: no coalescing
+    window, ZERO device dispatches, bit-identical to the serve that
+    populated the entry.  Rows are captured at demux (on the waiter's
+    thread, off every scheduler lock) only for CLEAN results whose
+    dispatch-time generation matches the admission generation, so an
+    absorb/retrain/remove — which bumps the index generation — makes
+    every stale entry structurally unreachable.  ``result_cache`` is an
+    explicit ``ResultCache``, ``"auto"`` (the default: built from the
+    ``PATHWAY_CACHE[_RESULT]*`` env knobs), or ``None`` to disable.
     """
 
     _degrade_empty = True
@@ -585,9 +606,13 @@ class ServeScheduler(_CoalescerBase):
         max_batch: Optional[int] = None,
         autostart: bool = True,
         replicas: Optional[Sequence[Any]] = None,
+        result_cache: Any = "auto",
     ):
         self.target = target
         self.k = k or getattr(target, "k", 10)
+        self._result_cache = (
+            result_cache_from_env() if result_cache == "auto" else result_cache
+        )
         # data-parallel replica set: the placement layer spreads batches
         # over [target, *replicas]; a single-target scheduler is the
         # degenerate one-replica case with zero extra cost
@@ -605,6 +630,7 @@ class ServeScheduler(_CoalescerBase):
         super().__init__(
             name=name, window_us=window_us, max_batch=max_batch, autostart=autostart
         )
+        self.stats.setdefault("cache_hits", 0)
 
     # -- public serve surface ----------------------------------------------
     def submit(
@@ -628,10 +654,36 @@ class ServeScheduler(_CoalescerBase):
             except Exception:
                 gen = 0
         # dedup item = (text, generation-at-admission): only duplicates
-        # that observed the SAME index state may share a dispatched slot
-        return self._admit(
-            [(str(t), gen) for t in texts], k or self.k, deadline
-        )
+        # that observed the SAME index state may share a dispatched slot.
+        # The SAME helper derives the result-cache key (cache/keys.py),
+        # so the two spellings can never drift.
+        items = [query_key(t, gen) for t in texts]
+        k_eff = k or self.k
+        cache = self._result_cache
+        if cache is not None and items:
+            # tier-0 lookup BEFORE admission (and before any scheduler
+            # lock): a full hit is a zero-dispatch serve that skips the
+            # coalescing window entirely; any miss (or cache failure)
+            # falls through to the shared batch unchanged
+            rows = cache.get_rows(items, k_eff, deadline=deadline)
+            if rows is not None:
+                with self._qlock:
+                    self.stats["cache_hits"] = (
+                        self.stats.get("cache_hits", 0) + 1
+                    )
+                    self.stats["items"] += len(items)
+                req = _Request(items, k_eff, deadline)
+                req.slots = list(range(len(items)))
+                hit = ServeResult(rows)
+                req.batch = _Batch(
+                    lambda: hit, len(items), 1, self._degrade_empty
+                )
+                req.event.set()
+                return _Ticket(self, req)
+        ticket = self._admit(items, k_eff, deadline)
+        if cache is not None:
+            ticket._request.cache_store = True
+        return ticket
 
     def serve(
         self,
@@ -695,16 +747,41 @@ class ServeScheduler(_CoalescerBase):
                 else []
             )
             rows.append(list(row[:k]))
-        return ServeResult(
+        result = ServeResult(
             rows,
             degraded=tuple(getattr(batch_result, "degraded", ())),
             meta=getattr(batch_result, "meta", None),
         )
+        cache = self._result_cache
+        if cache is not None and req.cache_store and not result.degraded:
+            # tier-0 capture, on the WAITER's thread off every scheduler
+            # lock.  Clean results only (a cached degraded serve would
+            # pin a transient outage for a TTL), and only when the
+            # dispatch-time generation the serve path stamped into the
+            # result matches this item's admission generation — a
+            # mutation landing mid-flight must not be stored under the
+            # pre-mutation key.
+            meta_gen = result.meta.get("index_generation")
+            for (text, gen), row in zip(req.items, rows):
+                if meta_gen is not None and int(meta_gen) != int(gen):
+                    continue
+                cache.put_row(text, gen, k, row, deadline=req.deadline)
+        return result
 
     # -- flight-recorder provider ------------------------------------------
     def observe_metrics(self):
         yield from super().observe_metrics()
         labels = {"scheduler": self.name}
+        if self._result_cache is not None:
+            # requests resolved entirely from the tier-0 result cache
+            # (zero-dispatch serves); per-tier hit/miss/bytes render
+            # from the cache's own provider (pathway_cache_*)
+            yield (
+                "counter",
+                "pathway_serve_queue_requests_total",
+                {**labels, "mode": "cached"},
+                self.stats.get("cache_hits", 0),
+            )
         for r in range(len(self._replicas)):
             rl = {**labels, "replica": str(r)}
             yield (

@@ -647,6 +647,157 @@ def test_hidden_sync_skips_non_serve_modules():
     assert found == []
 
 
+# -- cache-wrapper pattern (ISSUE 8) -----------------------------------------
+
+def test_cache_wrapper_dispatch_exempt_from_budget():
+    """A ``_cached_*`` scope wraps its dispatch behind a cache lookup —
+    the launch fires on a MISS only and is booked by the caller's
+    dispatch group (``record_dispatch(tag, shards=...)``), so the budget
+    check must not demand record_dispatch inside the wrapper.  The SAME
+    dispatch in a normally named scope still needs its record call."""
+    wrapper = _SERVE_HDR + textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+        from pathway_tpu.robust import retry_call
+
+        class Serve:
+            def _cached_embeddings(self, ids, mask, n_real):
+                rows, misses, keys = self.embed_cache.lookup_rows(ids, mask, n_real)
+                fresh = {}
+                if misses:
+                    enc = self._encode_fn(len(misses), ids.shape[1])
+                    z_m = retry_call("serve.dispatch", enc, self.params, ids, mask)
+                    for j, i in enumerate(misses):
+                        fresh[i] = z_m[j]
+                        self.embed_cache.put_row(keys[i], z_m[j])
+                return jnp.stack([rows[i] or fresh[i] for i in range(n_real)])
+    """)
+    assert _live(analyze_source(wrapper, "fixtures/serve.py"), "hidden-sync") == []
+
+    unwrapped = _SERVE_HDR + textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+        from pathway_tpu.robust import retry_call
+
+        class Serve:
+            def _embeddings(self, ids, mask, n_real):
+                enc = self._encode_fn(n_real, ids.shape[1])
+                z_m = retry_call("serve.dispatch", enc, self.params, ids, mask)
+                return z_m
+    """)
+    found = _live(analyze_source(unwrapped, "fixtures/serve.py"), "hidden-sync")
+    assert len(found) == 1 and "record_dispatch" in found[0].message
+
+
+def test_cache_wrapper_still_flags_sync_in_scope():
+    """The wrapper exemption covers BUDGET accounting only: a cache
+    wrapper that fetches its own dispatch to host is still a blocking
+    round trip on the serve path."""
+    bad = _SERVE_HDR + textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+        from pathway_tpu.robust import retry_call
+
+        class Serve:
+            def _cached_embeddings(self, ids, mask):
+                enc = self._encode_fn(ids.shape[0], ids.shape[1])
+                z_m = retry_call("serve.dispatch", enc, self.params, ids, mask)
+                return np.asarray(z_m)  # host fetch in the dispatch scope
+    """)
+    found = _live(analyze_source(bad, "fixtures/serve.py"), "hidden-sync")
+    assert len(found) == 1 and "synchronous round trip" in found[0].message
+
+
+def test_cache_access_under_lock_flagged():
+    """Serve-cache get/put take the tier's own lock and fire the
+    cache.get/cache.put chaos sites (delay/hang) — under a serve lock a
+    cache fault would stall every admitter.  Off-lock access is the
+    sanctioned shape."""
+    bad = """
+        import threading
+
+        class Scheduler:
+            def __init__(self):
+                self._qlock = threading.Lock()
+
+            def submit(self, items, k):
+                with self._qlock:
+                    rows = self._result_cache.get_rows(items, k)
+                return rows
+    """
+    found = _live(_run(bad), "lock-discipline")
+    assert len(found) == 1, found
+    assert "serve-cache access" in found[0].message
+
+    good = """
+        import threading
+
+        class Scheduler:
+            def __init__(self):
+                self._qlock = threading.Lock()
+
+            def submit(self, items, k):
+                rows = self._result_cache.get_rows(items, k)
+                with self._qlock:
+                    self.stats["cache_hits"] += 1
+                return rows
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
+def test_get_or_compute_inflight_ownership_stays_off_global_lock():
+    """The sanctioned get_or_compute shape (persistence/object_cache.py):
+    the global lock guards only the in-flight owner dict; compute and
+    pickling run OFF it.  Holding the lock across compute+pickle — the
+    round-5 exchange bug class — is still flagged."""
+    good = """
+        import pickle
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inflight = {}
+
+            def get_or_compute(self, key, compute):
+                blob = self.backend.get(key)
+                if blob is not None:
+                    return pickle.loads(blob)
+                with self._lock:
+                    waiter = self._inflight.get(key)
+                    if waiter is None:
+                        self._inflight[key] = threading.Event()
+                value = compute()
+                self.backend.put(key, pickle.dumps(value))
+                return value
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+    bad = """
+        import pickle
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def get_or_compute(self, key, compute):
+                with self._lock:
+                    value = compute()
+                    blob = pickle.dumps(value)
+                    self.backend.put(key, blob)
+                return value
+    """
+    found = _live(_run(bad), "lock-discipline")
+    assert len(found) == 1 and "pickle.dumps" in found[0].message
+
+
 # -- recompile-hazard --------------------------------------------------------
 
 def test_recompile_hazard_flags_unbucketed_shapes():

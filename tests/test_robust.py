@@ -10,7 +10,9 @@ Sites covered: serve.dispatch, serve.fetch, ivf.dispatch,
 ivf.tail_upload, ivf.absorb, ivf.retrain, rerank.dispatch,
 cross_encoder.dispatch, cross_encoder.fetch, encoder.dispatch,
 generator.dispatch, generator.chat, clip.dispatch, exchange.send,
-qa.rerank, forward.absorb, forward.upload, forward.gather.
+qa.rerank, forward.absorb, forward.upload, forward.gather, and the
+serve-cache pair cache.get / cache.put (ISSUE 8: a faulted or corrupt
+cache degrades to recompute — a MISS — never a failed or wrong serve).
 
 Plus: Deadline / RetryPolicy / CircuitBreaker / ServeResult units,
 ``PATHWAY_FAULTS`` parsing, the missing-doc response-metadata
@@ -564,6 +566,85 @@ def test_clip_dispatch_transient_failure_retries():
     with inject.armed("clip.dispatch", "raise", times=1):
         got = clip.encode_text(["a slide about latency"])
     np.testing.assert_allclose(got, clean, rtol=1e-6)
+
+
+# -- chaos: serve cache (ISSUE 8) --------------------------------------------
+
+
+def test_cache_chaos_triple_raise_delay_hang(stack):
+    """``cache.get`` / ``cache.put`` armed raise, delay, and hang: a
+    cache fault is a MISS (recompute) or a dropped store — never a
+    failed serve, never a wrong serve, and never a degradation rung
+    (the cache is an optimization, not a pipeline stage)."""
+    from pathway_tpu.cache import EmbeddingCache, ResultCache
+    from pathway_tpu.serve import ServeScheduler
+
+    enc, ce, index = stack
+    serve = FusedEncodeSearch(enc, index, k=8, embed_cache=EmbeddingCache())
+    pipe = RetrieveRerankPipeline(
+        serve, ce, DOCS, k=5, candidates=16,
+        rerank_breaker=CircuitBreaker(
+            "test-ce-cache", failure_threshold=100, reset_s=60
+        ),
+    )
+    sched = ServeScheduler(pipe, window_us=0, result_cache=ResultCache())
+    try:
+        clean = sched.serve([QUERIES[0]])
+        assert list(sched.serve([QUERIES[0]])) == list(clean)  # warm hit
+        failures0 = sched._result_cache.stats["failures"]
+        # GET faults: raise and hang turn every lookup into a miss (the
+        # serve re-dispatches, bit-identical rows at the same solo
+        # composition); delay just slows the hit.  All three unflagged.
+        for mode, kwargs in (
+            ("raise", {}),
+            ("delay", {"delay_s": 0.02}),
+            ("hang", {"hang_s": 0.2}),
+        ):
+            with inject.armed("cache.get", mode, **kwargs):
+                got = sched.serve([QUERIES[0]])
+            assert got.degraded == (), mode
+            assert list(got) == list(clean), mode
+        assert sched._result_cache.stats["failures"] > failures0
+        # PUT faults: the store drops silently; the serve stays clean
+        # and the NEXT serve recomputes from a cold entry
+        for mode, kwargs in (
+            ("raise", {}),
+            ("delay", {"delay_s": 0.02}),
+            ("hang", {"hang_s": 0.2}),
+        ):
+            with inject.armed("cache.put", mode, **kwargs):
+                got = sched.serve([QUERIES[1]])
+            assert got.degraded == () and got[0], mode
+    finally:
+        sched.stop()
+
+
+def test_generator_kv_cache_chaos_never_changes_tokens():
+    """A faulted prefix cache forces the cold prefill; a faulted store
+    drops the blocks — the emitted tokens are identical either way
+    (warm/cold bit-reproducibility + degrade-to-miss)."""
+    from pathway_tpu.cache import PrefixKVCache
+    from pathway_tpu.models.generator import TextGenerator
+
+    gen = TextGenerator(
+        dimension=32, n_layers=1, n_heads=4, max_length=64, vocab_size=512,
+        kv_cache=PrefixKVCache(block=8),
+    )
+    prompt = (
+        "retrieval augmented generation shares long prompt prefixes "
+        "across many requests in production serving"
+    )
+    clean = gen.generate([prompt], max_new_tokens=4)
+    gen.kv_cache.clear()
+    with inject.armed("cache.put", "raise"):
+        assert gen.generate([prompt], max_new_tokens=4) == clean
+    assert len(gen.kv_cache) == 0  # faulted stores dropped every block
+    assert gen.generate([prompt], max_new_tokens=4) == clean  # now stores
+    assert len(gen.kv_cache) > 0
+    with inject.armed("cache.get", "raise"):
+        # lookup faulted: cold prefill, same tokens
+        assert gen.generate([prompt], max_new_tokens=4) == clean
+    assert gen.generate([prompt], max_new_tokens=4) == clean  # warm path
 
 
 # -- chaos: exchange plane ---------------------------------------------------

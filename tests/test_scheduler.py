@@ -237,8 +237,10 @@ def test_stage1_failure_degrades_only_affected_requests(stack):
 
 
 def test_stop_drains_pending_tickets(stack):
+    # result_cache=None: this test asserts the post-stop SOLO admission
+    # path, which a tier-0 hit on the already-served query would bypass
     pipe = _pipeline(stack)
-    sched = ServeScheduler(pipe, window_us=50_000)
+    sched = ServeScheduler(pipe, window_us=50_000, result_cache=None)
     tickets = [sched.submit([q]) for q in QUERIES[:3]]
     sched.stop()
     for t, q in zip(tickets, QUERIES[:3]):
@@ -419,8 +421,10 @@ def test_replica_placement_fairness(stack):
     pipe_a = _pipeline(stack)
     pipe_b = _pipeline(stack)
     want = pipe_a([QUERIES[0]], k=5)
+    # result_cache=None: placement fairness counts PLACED batches, and a
+    # tier-0 hit on a repeated query would (correctly) place nothing
     with ServeScheduler(
-        pipe_a, window_us=5_000, replicas=[pipe_b]
+        pipe_a, window_us=5_000, replicas=[pipe_b], result_cache=None
     ) as sched:
         for i in range(8):
             got = sched.serve([QUERIES[i % len(QUERIES)]], k=5)

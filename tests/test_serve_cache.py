@@ -1,0 +1,494 @@
+"""Multi-tier serve cache (ISSUE 8): cross-window result cache,
+embedding cache, and generator prefix/KV reuse (pathway_tpu/cache).
+
+Correctness bars, in order of importance:
+
+- **Zero-dispatch repeats**: a repeated query at a stable index
+  generation costs ZERO device dispatches (asserted via the
+  ``dispatch_counter`` hook) and is bit-identical to the serve that
+  populated the entry.
+- **Invalidation under mutation**: absorb / retrain / add / remove —
+  during an open coalescing window or between repeated queries — bumps
+  the index generation, so the next serve RE-dispatches and never
+  returns a pre-mutation cached row (bit-identity vs an uncached serve
+  at matched generation; the sharded path's group generation included).
+- **Embedding tier**: a result-cache miss on a known query skips the
+  stage-1 encode (physical launch counts), survives generation bumps,
+  and composes cached rows with fresh ones in one bucketed batch.
+- **Generator tier**: the KV-cache decode is token-identical to the
+  legacy full re-attend decode (greedy and sampled), warm prefix reuse
+  is token-identical to cold, and prefill cost across shared-prefix
+  prompts is sub-linear (reused-token accounting).
+- **Bounded + observable**: LRU/byte/TTL bounds, corrupt entries
+  degrade to recompute, ``pathway_cache_*`` on the scrape surface and
+  the ``/serve_stats`` per-tier cache column.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe
+from pathway_tpu.cache import (
+    CacheTier,
+    EmbeddingCache,
+    PrefixKVCache,
+    ResultCache,
+    block_chain_keys,
+    query_key,
+    result_key,
+)
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.models.generator import TextGenerator
+from pathway_tpu.ops import dispatch_counter
+from pathway_tpu.ops.ivf import IvfKnnIndex, ShardedIvfIndex
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+from pathway_tpu.ops.serving import FusedEncodeSearch
+from pathway_tpu.serve import ServeScheduler
+
+DOCS = {
+    i: f"document number {i} about {topic} case {i % 7} with live updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+            "mesh collectives", "tokenizer ingest", "serving latency",
+            "cross encoders", "top k selection", "packing rows",
+        ]
+        * 2
+    )
+}
+QUERIES = [
+    "rag retrieval serving", "exactly once stream", "packing segment rows",
+    "kafka offsets replay", "vector index search", "mesh collective sync",
+]
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def ce():
+    return CrossEncoderModel(
+        dimension=32, n_layers=2, n_heads=4, max_length=64,
+        vocab_size=512, dtype=jnp.float32,
+    )
+
+
+def _exact_index(enc, n=None):
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    keys = sorted(DOCS)[:n] if n else sorted(DOCS)
+    index.add(keys, enc.encode([DOCS[i] for i in keys]))
+    return index
+
+
+# -- store units -------------------------------------------------------------
+
+def test_tier_lru_byte_budget_and_counters():
+    tier = CacheTier("unit", max_bytes=300)
+    for i in range(5):
+        assert tier.put(i, f"value-{i}", nbytes=100)
+    # 300-byte budget holds the 3 most recent entries
+    assert len(tier) == 3 and tier.bytes == 300
+    assert tier.stats["evictions"] == 2
+    assert tier.get(0) is None and tier.get(4) == "value-4"
+    # LRU: touching 2 makes 3 the eviction victim
+    assert tier.get(2) == "value-2"
+    tier.put(9, "v", nbytes=100)
+    assert tier.get(3) is None and tier.get(2) == "value-2"
+    # an entry larger than the whole budget is refused
+    assert not tier.put("huge", "x", nbytes=10_000)
+    assert tier.stats["hits"] == 3 and tier.stats["misses"] == 2
+
+
+def test_tier_ttl_expiry_and_max_entries():
+    tier = CacheTier("unit-ttl", max_bytes=1 << 20, ttl_s=0.05, max_entries=2)
+    tier.put("a", 1)
+    tier.put("b", 2)
+    tier.put("c", 3)
+    assert len(tier) == 2  # entry cap
+    assert tier.get("c") == 3
+    time.sleep(0.08)
+    assert tier.get("c") is None  # TTL expired -> miss
+    assert tier.stats["expirations"] >= 1
+
+
+def test_corrupt_entry_degrades_to_recompute():
+    tier = CacheTier(
+        "unit-fp", max_bytes=1 << 20, fingerprint=lambda rows: hash(tuple(rows))
+    )
+    tier.put("k", [1, 2, 3])
+    assert tier.get("k") == [1, 2, 3]
+    # mutate the stored value in place: the fingerprint re-check must
+    # turn the wrong value into a MISS, never serve it
+    with tier._lock:
+        tier._entries["k"].value.append(999)
+    assert tier.get("k") is None
+    assert tier.stats["corrupt"] == 1
+    assert "k" not in tier
+
+
+def test_key_helpers_share_fields_and_chain_prefixes():
+    # the result key IS the dedup key plus config — same helper, no drift
+    assert result_key("q", 7, 5)[:2] == query_key("q", 7)
+    ids_a = np.arange(64, dtype=np.int32)
+    ids_b = ids_a.copy()
+    ids_b[40:] += 1  # diverges in block 2 (block=16)
+    ka = block_chain_keys(ids_a, 4, 16)
+    kb = block_chain_keys(ids_b, 4, 16)
+    assert ka[:2] == kb[:2]  # shared prefix blocks share keys
+    assert ka[2:] != kb[2:]  # divergence poisons every later key
+
+
+# -- tier 0: result cache ----------------------------------------------------
+
+def _pipeline(enc, ce, index, **kw):
+    return RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8, embed_cache=None), ce, DOCS,
+        k=5, candidates=16, **kw,
+    )
+
+
+def test_repeated_query_is_zero_dispatch_and_bit_identical(enc, ce):
+    pipe = _pipeline(enc, ce, _exact_index(enc))
+    with ServeScheduler(
+        pipe, window_us=0, result_cache=ResultCache()
+    ) as sched:
+        first = sched.serve([QUERIES[0]])
+        with dispatch_counter.DispatchCounter() as counter:
+            second = sched.serve([QUERIES[0]])
+        assert counter.dispatches == 0 and counter.fetches == 0
+        assert counter.physical_dispatches == 0
+        assert list(second) == list(first)  # floats compare bit-equal
+        assert second.degraded == ()
+        assert sched.stats["cache_hits"] == 1
+        # a different k is a different serve config: no cross-k hit
+        with dispatch_counter.DispatchCounter() as counter:
+            third = sched.serve([QUERIES[0]], k=3)
+        assert counter.dispatches > 0
+        assert [key for key, _ in third[0]] == [
+            key for key, _ in first[0][:3]
+        ]
+
+
+def test_mutation_invalidates_between_repeats(enc, ce):
+    """add/remove on the exact index bump its generation: the repeat
+    after a mutation re-dispatches and matches a FRESH uncached serve of
+    the post-mutation index bit-for-bit (no stale hit, ever)."""
+    index = _exact_index(enc)
+    pipe = _pipeline(enc, ce, index)
+    with ServeScheduler(
+        pipe, window_us=0, result_cache=ResultCache()
+    ) as sched:
+        sched.serve([QUERIES[0]])  # populates the cache
+        gen0 = pipe.index_generation()
+        index.add([10_001], enc.encode(["a brand new document about rag"]))
+        assert pipe.index_generation() > gen0
+        with dispatch_counter.DispatchCounter() as counter:
+            post = sched.serve([QUERIES[0]])
+        assert counter.dispatches > 0, "stale hit served after mutation"
+        fresh = pipe([QUERIES[0]], k=5)  # uncached, matched generation
+        assert list(post) == list(fresh)
+        # and the post-mutation result is itself cached at the new gen
+        with dispatch_counter.DispatchCounter() as counter:
+            again = sched.serve([QUERIES[0]])
+        assert counter.dispatches == 0
+        assert list(again) == list(post)
+        # remove() invalidates the same way
+        index.remove([10_001])
+        with dispatch_counter.DispatchCounter() as counter:
+            sched.serve([QUERIES[0]])
+        assert counter.dispatches > 0
+
+
+def test_absorb_during_open_window_never_caches_stale(enc, ce):
+    """An IVF absorb landing while a serve window is open: the rider's
+    result was dispatched at the pre-absorb generation, the absorb bumps
+    it mid-flight, and BOTH the dedup key and the result cache must
+    refuse to serve that row to post-absorb requests."""
+    ivf = IvfKnnIndex(dimension=32, metric="cos", absorb_threshold=8)
+    keys = sorted(DOCS)
+    ivf.add(keys, enc.encode([DOCS[i] for i in keys]))
+    ivf.build()
+    pipe = _pipeline(enc, ce, ivf)
+    pipe([QUERIES[0]])  # warmup compiles
+    with ServeScheduler(
+        pipe, window_us=400_000, result_cache=ResultCache()
+    ) as sched:
+        t1 = sched.submit([QUERIES[0]])  # admitted at g0, window open
+        g0 = ivf.generation
+        ivf.add(
+            [10_000 + i for i in range(16)],
+            np.tile(enc.encode([DOCS[0]]).astype(np.float32), (16, 1))
+            + np.random.default_rng(5)
+            .standard_normal((16, 32))
+            .astype(np.float32)
+            * 0.01,
+        )
+        deadline = time.time() + 20
+        while time.time() < deadline and ivf.generation <= g0:
+            time.sleep(0.005)
+        assert ivf.generation > g0, "absorb/add never landed"
+        r1 = t1()
+        assert r1[0]
+        # the post-mutation repeat must re-dispatch: whatever the rider
+        # cached (admission gen g0, possibly dispatched at g1) is
+        # unreachable from the NEW generation's key
+        with dispatch_counter.DispatchCounter() as counter:
+            r2 = sched.serve([QUERIES[0]])
+        assert counter.dispatches > 0, "stale cross-generation hit"
+        fresh = pipe([QUERIES[0]], k=5)
+        assert list(r2) == list(fresh)
+
+
+def test_sharded_group_generation_invalidates(enc):
+    """The sharded path: an absorb routed to ONE shard bumps the group
+    generation (sum of child gens), so the tier-0 key rolls over and the
+    repeat re-dispatches against the post-absorb group."""
+    keys = sorted(DOCS)
+    idx = ShardedIvfIndex(
+        32, metric="cos", n_shards=4, absorb_threshold=4096
+    )
+    idx.add(keys, enc.encode([DOCS[i] for i in keys]))
+    idx.build()
+    serve = FusedEncodeSearch(enc, idx, k=5, embed_cache=None)
+    with ServeScheduler(
+        serve, window_us=0, result_cache=ResultCache()
+    ) as sched:
+        first = sched.serve([QUERIES[1]])
+        with dispatch_counter.DispatchCounter() as counter:
+            hit = sched.serve([QUERIES[1]])
+        assert counter.dispatches == 0
+        assert list(hit) == list(first)
+        g0 = idx.generation
+        idx.add([20_000], enc.encode(["fresh sharded document"]))
+        assert idx.generation > g0
+        with dispatch_counter.DispatchCounter() as counter:
+            post = sched.serve([QUERIES[1]])
+        assert counter.dispatches > 0, "stale hit across group generation"
+        fresh = serve([QUERIES[1]], k=5)
+        assert list(post) == list(fresh)
+
+
+def test_degraded_results_are_never_cached(enc, ce):
+    from pathway_tpu.robust import RETRIEVAL_FAILED, inject
+
+    pipe = _pipeline(enc, ce, _exact_index(enc))
+    pipe([QUERIES[2]])  # warmup
+    with ServeScheduler(
+        pipe, window_us=0, result_cache=ResultCache()
+    ) as sched:
+        with inject.armed("serve.dispatch", "raise", times=3):
+            bad = sched.serve([QUERIES[2]])
+        assert RETRIEVAL_FAILED in bad.degraded
+        # the degraded empty row must NOT have been captured: the next
+        # serve dispatches and returns the real rows
+        with dispatch_counter.DispatchCounter() as counter:
+            good = sched.serve([QUERIES[2]])
+        assert counter.dispatches > 0
+        assert good.degraded == () and good[0]
+
+
+def test_ttl_expiry_forces_redispatch(enc, ce):
+    pipe = _pipeline(enc, ce, _exact_index(enc))
+    with ServeScheduler(
+        pipe, window_us=0, result_cache=ResultCache(ttl_s=0.05)
+    ) as sched:
+        sched.serve([QUERIES[3]])
+        time.sleep(0.08)
+        with dispatch_counter.DispatchCounter() as counter:
+            sched.serve([QUERIES[3]])
+        assert counter.dispatches > 0
+
+
+# -- tier 1: embedding cache -------------------------------------------------
+
+def test_embedding_cache_skips_stage1_encode(enc):
+    """Serve twice at a STABLE generation with only the embedding tier:
+    the repeat's stage-1 is search-only (1 physical launch vs 2), and
+    the scores match the fused path to float tolerance."""
+    index = _exact_index(enc)
+    plain = FusedEncodeSearch(enc, index, k=5, embed_cache=None)
+    want = plain([QUERIES[0]])
+    serve = FusedEncodeSearch(enc, index, k=5, embed_cache=EmbeddingCache())
+    with dispatch_counter.DispatchCounter(mode="physical") as c1:
+        r1 = serve([QUERIES[0]])
+    assert c1.physical_dispatches == 2  # encode (miss) + search
+    with dispatch_counter.DispatchCounter(mode="physical") as c2:
+        r2 = serve([QUERIES[0]])
+    assert c2.physical_dispatches == 1  # search only: encode skipped
+    assert c2.dispatches == 1 and c2.fetches == 1
+    assert serve.embed_cache.stats["hits"] == 1
+    assert [k for k, _ in r1[0]] == [k for k, _ in r2[0]] == [
+        k for k, _ in want[0]
+    ]
+    assert list(r1) == list(r2)  # cached row -> bit-stable repeat
+    np.testing.assert_allclose(
+        [s for _, s in r2[0]], [s for _, s in want[0]], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_embedding_survives_generation_bump(enc):
+    """The tier-1 asymmetry that motivates the tier: after an index
+    mutation (result cache invalid) the embedding is still valid — the
+    repeat re-SEARCHES but never re-encodes."""
+    index = _exact_index(enc)
+    serve = FusedEncodeSearch(enc, index, k=5, embed_cache=EmbeddingCache())
+    serve([QUERIES[0]])
+    index.add([30_000], enc.encode(["new doc lands between repeats"]))
+    with dispatch_counter.DispatchCounter(mode="physical") as counter:
+        rows = serve([QUERIES[0]])
+    assert counter.physical_dispatches == 1  # search-only re-dispatch
+    assert rows[0]
+    assert serve.embed_cache.stats["hits"] >= 1
+
+
+def test_embedding_composes_hits_with_fresh_rows(enc):
+    """A mixed batch — one known query, one new — encodes ONLY the miss
+    (one bucketed launch) and composes on device; rows match the
+    all-fresh serve to float tolerance."""
+    index = _exact_index(enc)
+    plain = FusedEncodeSearch(enc, index, k=5, embed_cache=None)
+    want = plain([QUERIES[0], QUERIES[1]])
+    serve = FusedEncodeSearch(enc, index, k=5, embed_cache=EmbeddingCache())
+    serve([QUERIES[0]])
+    with dispatch_counter.DispatchCounter(mode="physical") as counter:
+        mixed = serve([QUERIES[0], QUERIES[1]])
+    assert counter.physical_dispatches == 2  # miss encode + search
+    assert serve.embed_cache.stats["hits"] == 1
+    for got, ref in zip(mixed, want):
+        assert [k for k, _ in got] == [k for k, _ in ref]
+        np.testing.assert_allclose(
+            [s for _, s in got], [s for _, s in ref], rtol=1e-5, atol=1e-6
+        )
+
+
+def test_embedding_cache_on_plain_encoder(enc):
+    """SentenceEncoder.encode_to_device reuses the tier for ingest/QA
+    re-embeds: hit rows are the encoder's own previous outputs."""
+    local = SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+    cold = local.encode(["alpha beta", "gamma delta"])
+    local.set_embed_cache(EmbeddingCache())
+    a = local.encode(["alpha beta", "gamma delta"])
+    b = local.encode(["alpha beta", "gamma delta"])  # all-hit
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(a, cold, rtol=1e-5, atol=1e-6)
+    assert local.embed_cache.stats["hits"] == 2
+    mixed = local.encode(["alpha beta", "epsilon zeta"])  # one hit, one miss
+    np.testing.assert_array_equal(mixed[0], a[0])
+
+
+# -- tier 2: generator KV ----------------------------------------------------
+
+def test_kv_decode_matches_legacy_decode():
+    """The KV-cache decode is the legacy full re-attend decode,
+    token-for-token — greedy and seeded sampling, bf16 and f32."""
+    for dtype in (jnp.float32, jnp.bfloat16):
+        gen = TextGenerator(
+            dimension=32, n_layers=2, n_heads=4, max_length=64,
+            vocab_size=512, dtype=dtype, kv_cache=None,
+        )
+        prompts = ["hello world this is a test", "the quick brown fox"]
+        assert gen.generate(
+            prompts, max_new_tokens=6, use_kv=False
+        ) == gen.generate(prompts, max_new_tokens=6, use_kv=True)
+        assert gen.generate(
+            prompts, max_new_tokens=6, temperature=0.8, seed=3, use_kv=False
+        ) == gen.generate(
+            prompts, max_new_tokens=6, temperature=0.8, seed=3, use_kv=True
+        )
+
+
+def test_prefix_reuse_is_sublinear_and_token_identical():
+    """Two RAG prompts sharing a prefix: the second prefills only its
+    tail (reused tokens > 0, computed strictly fewer than its prompt
+    length) and emits the SAME tokens as with a cold cache."""
+    kv = PrefixKVCache(block=8)
+    gen = TextGenerator(
+        dimension=32, n_layers=2, n_heads=4, max_length=96,
+        vocab_size=512, kv_cache=kv,
+    )
+    shared = (
+        "system prompt answer strictly from the retrieved context "
+        "chunk one about dataflow chunk two about serving "
+    )
+    p1 = shared + "what is incremental computation"
+    p2 = shared + "how does the scheduler coalesce"
+    cold2 = gen.generate([p2], max_new_tokens=5)
+    kv.clear()
+    kv.stats_tokens.update(reused=0, computed=0)
+    gen.generate([p1], max_new_tokens=5)
+    assert kv.stats_tokens["reused"] == 0  # cold: everything prefilled
+    first_cost = kv.stats_tokens["computed"]
+    warm2 = gen.generate([p2], max_new_tokens=5)
+    assert warm2 == cold2  # warm == cold, token-for-token
+    assert kv.stats_tokens["reused"] > 0
+    # sub-linear: the second prompt's prefill cost is strictly below its
+    # own full prompt cost (it paid only the unshared tail)
+    assert kv.stats_tokens["computed"] - first_cost < first_cost
+    # a fully repeated prompt reuses every cacheable block
+    before = kv.stats_tokens["reused"]
+    assert gen.generate([p2], max_new_tokens=5) == cold2
+    assert kv.stats_tokens["reused"] > before
+
+
+def test_prefix_blocks_never_alias_different_prefixes():
+    """Content addressing: prompts that diverge INSIDE a block share no
+    keys from that block on — a cached chain can never be replayed under
+    a different prefix."""
+    kv = PrefixKVCache(block=8)
+    gen = TextGenerator(
+        dimension=32, n_layers=1, n_heads=4, max_length=64,
+        vocab_size=512, kv_cache=kv,
+    )
+    a = "alpha beta gamma delta epsilon zeta eta theta iota kappa"
+    b = "alpha beta gamma DIFFERENT epsilon zeta eta theta iota kappa"
+    cold_b = gen.generate([b], max_new_tokens=4)
+    kv.clear()
+    gen.generate([a], max_new_tokens=4)
+    warm_b = gen.generate([b], max_new_tokens=4)
+    assert warm_b == cold_b  # divergent prefix -> no (wrong) reuse
+
+
+# -- observability -----------------------------------------------------------
+
+def test_cache_metrics_on_scrape_surface(enc, ce):
+    pipe = _pipeline(enc, ce, _exact_index(enc))
+    with ServeScheduler(
+        pipe, window_us=0, result_cache=ResultCache()
+    ) as sched:
+        sched.serve([QUERIES[4]])
+        sched.serve([QUERIES[4]])
+    lines = "\n".join(observe.render_prometheus())
+    for family in (
+        "pathway_cache_hits_total",
+        "pathway_cache_misses_total",
+        "pathway_cache_evictions_total",
+        "pathway_cache_bytes",
+        "pathway_cache_entries",
+    ):
+        assert family in lines, family
+    assert 'tier="result"' in lines
+    snap = observe.snapshot()
+    assert "result" in snap["caches"]
+    col = snap["caches"]["result"]
+    assert any("pathway_cache_hits_total" in k for k in col)
+    joined = "\n".join(list(snap["counters"]))
+    assert 'pathway_serve_queue_requests_total{mode="cached"' in joined
