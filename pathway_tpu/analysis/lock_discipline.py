@@ -37,6 +37,16 @@ they execute later, not under the lock):
   ``get_or_compute``) is the sanctioned shape: the global lock guards
   only the owner dict; compute, backend I/O and pickling run off it.
 
+And the INVERSE scope check on serve-path modules: a trace span opened
+as a context manager (``with trace.span(...):`` / ``start_span`` /
+``span_timer``) whose body ACQUIRES a lock.  Spans time *work*, not
+lock waits — a span held across ``with <lock>:`` silently folds queue
+contention into the stage it claims to measure, which is exactly the
+mis-attribution per-request tracing exists to kill.  The serve paths
+therefore record spans with EXPLICIT timestamps
+(``trace.current().add_span(name, t0, t1)``), reusing the clock reads
+the stage histograms already take.
+
 Deliberate cases (e.g. a dispatch-only launch under the lock that
 snapshots device state consistently and never blocks on the result) are
 suppressed at the ``with`` statement with a reviewed reason:
@@ -46,6 +56,7 @@ suppressed at the ``with`` statement with a reviewed reason:
 from __future__ import annotations
 
 import ast
+import re
 from typing import Set
 
 from .core import ModuleContext, Rule
@@ -74,6 +85,45 @@ _PICKLE_CALLS = {
 }
 _COERCIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
               "float", "int"}
+# span-opening context managers (observe/trace.py and OTLP-style APIs):
+# `with trace.span(...)`, `with tracer.start_span(...)`, span timers
+_SPAN_CM_LEAVES = {"span", "start_span", "span_timer"}
+
+
+def _is_span_context(with_node: ast.With) -> bool:
+    """``with <something>.span(...):`` / ``start_span`` / ``span_timer``
+    — a context manager that TIMES its body as a trace span."""
+    return _span_item_index(with_node) is not None
+
+
+def _span_item_index(with_node: ast.With):
+    """Index of the first span-opening item in the with statement, or
+    None."""
+    for i, item in enumerate(with_node.items):
+        expr = item.context_expr
+        if not isinstance(expr, ast.Call):
+            continue
+        callee = dotted_name(expr.func)
+        if callee is None:
+            continue
+        if callee.rsplit(".", 1)[-1] in _SPAN_CM_LEAVES:
+            return i
+    return None
+
+
+def _lock_item_index(with_node: ast.With):
+    """Index of the first lock item in the with statement, or None."""
+    for i, item in enumerate(with_node.items):
+        name = dotted_name(item.context_expr)
+        if name and _LOCK_ITEM_RE.search(name.rsplit(".", 1)[-1]):
+            return i
+    return None
+
+
+# mirrors registry.is_lock_context's name heuristic, applied per item so
+# the combined `with tracer.span(...), self._lock:` form resolves with
+# ITEM ORDER (span before lock = the lock wait is timed)
+_LOCK_ITEM_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
 
 
 class LockDisciplineRule(Rule):
@@ -106,6 +156,36 @@ class LockDisciplineRule(Rule):
             for node in walk_scope(scope):
                 if isinstance(node, ast.With) and is_lock_context(node):
                     self._check_lock_body(ctx, node, jit_fns, device_vars, handles)
+
+        # the inverse scope check (serve-path modules): a span context
+        # manager whose body acquires a lock times the lock WAIT as if
+        # it were stage work
+        if ctx.serve_path:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.With) and _is_span_context(node):
+                    self._check_span_body(ctx, node)
+
+    def _check_span_body(self, ctx: ModuleContext, span_node: ast.With) -> None:
+        message = (
+            "trace span opened across a `with <lock>:` boundary on "
+            "a serve-path module — spans time WORK, not lock waits; "
+            "record the span with explicit timestamps "
+            "(trace.current().add_span(name, t0, t1)) around the "
+            "work itself, outside the lock acquisition"
+        )
+        # combined single-statement form: `with tracer.span(...),
+        # self._lock:` acquires the lock INSIDE the span timing when the
+        # span item comes first (`with self._lock, tracer.span(...)` is
+        # the nested span-under-lock shape, which is allowed)
+        span_i = _span_item_index(span_node)
+        lock_i = _lock_item_index(span_node)
+        if lock_i is not None and span_i is not None and span_i < lock_i:
+            ctx.report(self.name, span_node, message)
+            return
+        for inner in walk_scope(span_node):
+            if isinstance(inner, ast.With) and is_lock_context(inner):
+                ctx.report(self.name, span_node, message)
+                return
 
     def _recurse_defs(self, node, fns, dvars, handles, visit_scope) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):

@@ -42,6 +42,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional
 
 from .. import observe
+from ..observe import trace
 from ..robust import log_once
 from ..robust import inject
 
@@ -136,6 +137,15 @@ class CacheTier:
         self.labels = {"tier": self.tier, "id": str(observe.next_id())}
         observe.register_provider(self)
 
+    def _trace_note(self, op: str, outcome: str) -> None:
+        """Hit/miss annotation on the active trace (observe/trace.py):
+        one zero-duration span per cache operation, so a kept trace
+        shows which tiers this request touched and how they answered.
+        One context-var read when untraced."""
+        t = trace.current()
+        if t is not None:
+            t.add_event("cache." + op, tier=self.tier, outcome=outcome)
+
     # -- the serve-facing contract ------------------------------------------
     def get(self, key: Any, deadline=None) -> Optional[Any]:
         """The cached value, or None.  EVERY failure mode — armed chaos
@@ -154,21 +164,27 @@ class CacheTier:
                 self.tier,
                 exc,
             )
+            self._trace_note("get", "error")
             return None
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
                 self.stats["misses"] += 1
-                return None
-            if entry.expires_at is not None and now >= entry.expires_at:
+                entry_state = "miss"
+            elif entry.expires_at is not None and now >= entry.expires_at:
                 self._drop_locked(key, entry)
                 self.stats["expirations"] += 1
                 self.stats["misses"] += 1
-                return None
-            self._entries.move_to_end(key)
-            value = entry.value
-            fp = entry.fingerprint
+                entry_state = "expired"
+            else:
+                self._entries.move_to_end(key)
+                value = entry.value
+                fp = entry.fingerprint
+                entry_state = "hit"
+        if entry_state != "hit":
+            self._trace_note("get", entry_state)
+            return None
         if fp is not None:
             # integrity re-check OFF the lock (pure host compute): a
             # mutated-in-place entry must never become a wrong serve
@@ -185,8 +201,10 @@ class CacheTier:
                     "corrupt cache entry on tier %s; dropped and recomputing",
                     self.tier,
                 )
+                self._trace_note("get", "corrupt")
                 return None
         self._count("hits")
+        self._trace_note("get", "hit")
         return value
 
     def put(
@@ -210,6 +228,7 @@ class CacheTier:
                 self.tier,
                 exc,
             )
+            self._trace_note("put", "dropped")
             return False
         if self.max_bytes <= 0:
             # a zero/negative budget DISABLES the tier (matching the TTL

@@ -11,9 +11,11 @@ This is the ONE metrics surface: alongside the engine/connector series,
 ``/metrics`` renders the serve-path flight recorder
 (``pathway_tpu/observe`` — ``pathway_serve_*`` stage histograms,
 ``pathway_ivf_*`` index gauges, ``pathway_recompile_*`` compile census,
-``pathway_exchange_*`` plane counters), and ``/serve_stats`` serves the
+``pathway_exchange_*`` plane counters), ``/serve_stats`` serves the
 same recorder as a JSON summary (histogram quantile estimates + the
-recent-event ring).
+recent-event ring), and ``/traces`` serves the tail-sampled per-request
+span trees (``pathway_tpu/observe/trace.py``) that the histogram
+exemplars on ``/metrics`` link to (``?limit=N`` caps the payload).
 
 Scrape consistency: the engine graph's operator/table collections are
 snapshotted (and each operator's counters read once) BEFORE any line is
@@ -29,6 +31,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from .config import get_config
 
@@ -41,11 +44,18 @@ def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
-def render_metrics(graph, started_at: Optional[float] = None) -> str:
+def render_metrics(
+    graph,
+    started_at: Optional[float] = None,
+    openmetrics: bool = False,
+) -> str:
     """Render the engine graph's state in Prometheus text exposition
     format.  ``started_at`` is the serving process's start stamp (the
     MetricsServer passes its own); defaults to module import time for
-    direct callers."""
+    direct callers.  ``openmetrics=True`` (negotiated via the Accept
+    header) adds kept-trace exemplars to the histogram buckets and the
+    terminating ``# EOF`` — exemplar syntax is not legal in the classic
+    ``version=0.0.4`` format, where it would fail the whole scrape."""
     # SNAPSHOT before rendering: fix the operator/table lists and read
     # each operator's counters exactly once, so a scrape racing a commit
     # tick cannot see a list mutating under iteration or one operator's
@@ -122,9 +132,29 @@ def render_metrics(graph, started_at: Optional[float] = None) -> str:
     # connectors, and the ML hot path
     from .. import observe
 
-    lines.extend(observe.render_prometheus())
+    lines.extend(observe.render_prometheus(openmetrics=openmetrics))
+    if openmetrics:
+        # OpenMetrics counter semantics: the FAMILY name must not carry
+        # the `_total` suffix — the sample does (`# TYPE x counter` +
+        # `x_total 3`).  The classic rendering declares `# TYPE x_total
+        # counter`, which a strict OM parser rejects as a clashing
+        # name, failing the whole scrape — exactly what the content
+        # negotiation exists to prevent.
+        lines = [_om_type_line(line) for line in lines]
+        lines.append("# EOF")
     lines.append("")
     return "\n".join(lines)
+
+
+def _om_type_line(line: str) -> str:
+    """Rewrite one classic `# TYPE <x>_total counter` declaration into
+    its OpenMetrics form (`# TYPE <x> counter`); everything else passes
+    through untouched."""
+    if line.startswith("# TYPE ") and line.endswith(" counter"):
+        name = line[len("# TYPE "):-len(" counter")]
+        if name.endswith("_total"):
+            return f"# TYPE {name[:-len('_total')]} counter"
+    return line
 
 
 class MetricsServer:
@@ -154,12 +184,39 @@ class MetricsServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
                 if self.path.startswith("/metrics"):
-                    body = render_metrics(graph, started_at=started_at).encode()
-                    ctype = "text/plain; version=0.0.4"
+                    # content negotiation: exemplars only exist in the
+                    # OpenMetrics exposition — a classic scraper gets
+                    # the plain rendering it can parse
+                    accept = self.headers.get("Accept", "") or ""
+                    om = "application/openmetrics-text" in accept
+                    body = render_metrics(
+                        graph, started_at=started_at, openmetrics=om
+                    ).encode()
+                    ctype = (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                        if om
+                        else "text/plain; version=0.0.4"
+                    )
                 elif self.path.startswith("/serve_stats"):
                     from .. import observe
 
                     body = json.dumps(observe.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/traces"):
+                    # kept (tail-sampled) per-request span trees — the
+                    # target the /metrics histogram exemplars link to
+                    from ..observe import trace as _trace
+
+                    limit = None
+                    query = urlparse(self.path).query
+                    raw = parse_qs(query).get("limit")
+                    if raw:
+                        try:
+                            limit = int(raw[0])
+                        except ValueError:
+                            limit = None
+                    body = json.dumps(_trace.snapshot_traces(limit)).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/status"):
                     body = json.dumps(

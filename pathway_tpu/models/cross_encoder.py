@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..observe import trace
 from ..ops.recompile_guard import RecompileTripwire
 from ..robust import Deadline, inject, retry_call
 from ._params import unbox as _unbox
@@ -212,7 +213,14 @@ class CrossEncoderModel:
             if deadline is not None:
                 deadline.check("cross_encoder.fetch")
             scores = np.asarray(out, dtype=np.float32)[:n]
-            _H_READY.observe_ns(time.perf_counter_ns() - t_dispatch)
+            t_ready = time.perf_counter_ns()
+            _H_READY.observe_ns(t_ready - t_dispatch)
+            _t = trace.current()
+            if _t is not None:
+                _t.add_span(
+                    "model.cross_encoder", t_dispatch, t_ready,
+                    exemplar=_H_READY, pairs=n,
+                )
             return scores
 
         return complete
@@ -304,7 +312,14 @@ class CrossEncoderModel:
             if deadline is not None:
                 deadline.check("cross_encoder.fetch")
             arr = np.asarray(out, dtype=np.float32).reshape(-1)
-            _H_READY.observe_ns(time.perf_counter_ns() - t_dispatch)
+            t_ready = time.perf_counter_ns()
+            _H_READY.observe_ns(t_ready - t_dispatch)
+            _t = trace.current()
+            if _t is not None:
+                _t.add_span(
+                    "model.cross_encoder", t_dispatch, t_ready,
+                    exemplar=_H_READY, pairs=n, packed=True,
+                )
             return arr[flat_ix][:n]
 
         return complete

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..observe import trace
 from ..ops.recompile_guard import RecompileTripwire
 from ..robust import retry_call
 from ._params import unbox as _unbox
@@ -261,7 +262,14 @@ class SentenceEncoder:
         # series (host prep/tokenize time is not device latency)
         t0 = time.perf_counter_ns()
         host = np.asarray(out, dtype=np.float32)
-        _H_READY.observe_ns(time.perf_counter_ns() - t0)
+        t_ready = time.perf_counter_ns()
+        _H_READY.observe_ns(t_ready - t0)
+        _t = trace.current()
+        if _t is not None:
+            _t.add_span(
+                "model.encoder", t0, t_ready, exemplar=_H_READY,
+                texts=len(texts),
+            )
         return host
 
     # -- sequence packing ---------------------------------------------------

@@ -34,9 +34,16 @@ Design constraints, in order:
 
 ``PATHWAY_OBSERVE=0`` (or ``set_enabled(False)``) reduces every record
 call to a bool check.
+
+``trace`` (observe/trace.py) is the per-request layer on top: Dapper-
+style span trees across the coalescing scheduler, shards, cascade
+stages and cache tiers, tail-sampled into a bounded kept store served
+on ``GET /traces``, with kept-trace exemplars stamped onto the
+histogram buckets above.
 """
 
 from .histogram import EventRing, LatencyHistogram, N_BUCKETS, bucket_bounds_s
+from . import trace
 from .recorder import (
     Counter,
     Gauge,
@@ -77,4 +84,5 @@ __all__ = [
     "reset",
     "set_enabled",
     "snapshot",
+    "trace",
 ]

@@ -21,6 +21,7 @@ distributions need: the same histogram covers a 40 ns counter read and a
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterable, List, Optional, Tuple
 
 from . import _state
@@ -61,13 +62,17 @@ class LatencyHistogram:
     even while concurrent observes land.
     """
 
-    __slots__ = ("_counts", "_sum_ns", "_n", "_lock")
+    __slots__ = ("_counts", "_sum_ns", "_n", "_lock", "_exemplars")
 
     def __init__(self) -> None:
         self._counts = [0] * N_BUCKETS
         self._sum_ns = 0
         self._n = 0
         self._lock = threading.Lock()
+        # per-bucket exemplar slots (trace_id, value_s, unix_ts) — lazily
+        # allocated on the first stamp, so histograms that never carry
+        # exemplars (the overwhelming majority) pay one None field
+        self._exemplars: Optional[List[Optional[Tuple[str, float, float]]]] = None
 
     def observe_ns(self, ns: int) -> None:
         if not _state.enabled:
@@ -86,11 +91,31 @@ class LatencyHistogram:
         with self._lock:
             return tuple(self._counts), self._sum_ns, self._n
 
+    def set_exemplar(self, ns: int, trace_id: str) -> None:
+        """Stamp ``trace_id`` as the exemplar of the bucket a duration of
+        ``ns`` lands in (newest-wins).  Called ONLY for traces the tail
+        sampler kept, so every exemplar on /metrics resolves on /traces
+        — the Dapper-style aggregate↔trace linkage."""
+        i = _bucket_index(int(ns))
+        with self._lock:
+            if self._exemplars is None:
+                self._exemplars = [None] * N_BUCKETS
+            self._exemplars[i] = (str(trace_id), int(ns) * 1e-9, time.time())
+
+    def exemplars(self) -> Optional[List[Optional[Tuple[str, float, float]]]]:
+        """Per-bucket exemplar snapshot (index-aligned with the counts),
+        or None when this histogram never carried one."""
+        with self._lock:
+            if self._exemplars is None:
+                return None
+            return list(self._exemplars)
+
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * N_BUCKETS
             self._sum_ns = 0
             self._n = 0
+            self._exemplars = None
 
     def merge_from(self, other: "LatencyHistogram") -> None:
         """Element-wise accumulate ``other`` into this histogram (shard
@@ -164,6 +189,13 @@ class EventRing:
                     if e is not None
                 ]
             return events, n
+
+    @property
+    def dropped(self) -> int:
+        """How many appended events have been overwritten (the ring's
+        drop count, rendered on pathway_observe_events_dropped_total)."""
+        with self._lock:
+            return max(0, self._n - self.capacity)
 
     def reset(self) -> None:
         with self._lock:

@@ -241,15 +241,23 @@ _spans_on: Optional[bool] = None
 
 
 def emit_span(name: str, **attributes: Any) -> None:
-    """Emit one OTLP span through ``internals/telemetry.py`` when an
-    endpoint is configured (PATHWAY_MONITORING_SERVER); a boolean check
-    otherwise.  The span is opened and closed at the call, carrying the
-    measured stage durations as attributes — serve timing is measured by
-    the recorder, the span is its export.  Gated on the same global
-    switch as every other record call — PATHWAY_OBSERVE=0 silences span
-    export too."""
+    """Emit one span for the current instant: onto the ACTIVE per-request
+    trace (observe/trace.py — the round-13 rework of what used to be an
+    OTLP-only stub) and, when an endpoint is configured
+    (PATHWAY_MONITORING_SERVER), as an OTLP span through
+    ``internals/telemetry.py``.  The span carries the measured stage
+    durations as attributes — serve timing is measured by the recorder,
+    the span is its export.  Gated on the same global switch as every
+    other record call — PATHWAY_OBSERVE=0 silences span export too."""
     global _telemetry, _spans_on
-    if not _state.enabled or _spans_on is False:
+    if not _state.enabled:
+        return
+    from . import trace as _trace  # lazy: trace.py imports this module
+
+    t = _trace.current()
+    if t is not None:
+        t.add_event(name, **attributes)
+    if _spans_on is False:
         return
     if _spans_on is None:
         try:
@@ -289,6 +297,41 @@ def _fmt_le(bound: float) -> str:
     return repr(bound)
 
 
+def _fmt_exemplar(exemplars, i: int) -> str:
+    """OpenMetrics exemplar suffix for bucket ``i`` ('' when none)."""
+    if exemplars is None or exemplars[i] is None:
+        return ""
+    trace_id, value_s, ts = exemplars[i]
+    return f' # {{trace_id="{_escape(trace_id)}"}} {repr(value_s)} {ts:.3f}'
+
+
+def _ring_health() -> List[Tuple[str, int, int]]:
+    """(ring, capacity, dropped) rows for every bounded ring: the serve
+    event ring, the trace kept/pending stores, and — when a test/bench
+    counter is installed — the dispatch counter's event buffer.  Drop
+    counts were previously tracked but never rendered (ISSUE 9)."""
+    rows: List[Tuple[str, int, int]] = [
+        ("serve_events", _ring.capacity, _ring.dropped)
+    ]
+    try:
+        from . import trace as _trace
+
+        rows.extend(_trace.ring_stats())
+    except Exception:  # pragma: no cover - partial teardown
+        pass
+    try:
+        from ..ops import dispatch_counter as _dc
+
+        active = _dc._active
+        if active is not None:
+            rows.append(
+                ("dispatch_counter", active.max_events, active.events_dropped)
+            )
+    except Exception:  # pragma: no cover - partial teardown
+        pass
+    return rows
+
+
 def _fmt_value(value: float) -> str:
     """Exact sample formatting: integral values render as integers
     (``%g`` would truncate to 6 significant digits — a bytes counter
@@ -299,12 +342,20 @@ def _fmt_value(value: float) -> str:
     return repr(float(value))
 
 
-def render_prometheus() -> List[str]:
+def render_prometheus(openmetrics: bool = False) -> List[str]:
     """All recorder series in Prometheus text exposition format —
     appended to ``internals/metrics.py``'s ``render_metrics`` output so
     one scrape covers engine, connectors, and the serve flight recorder.
     Deterministic ordering (sorted names, sorted label sets) and one
-    consistent snapshot per series."""
+    consistent snapshot per series.
+
+    ``openmetrics=True`` additionally renders kept-trace exemplars on
+    the histogram bucket samples.  Exemplar syntax is ONLY legal in the
+    OpenMetrics exposition (negotiated via the Accept header and served
+    as ``application/openmetrics-text``); a classic
+    ``text/plain; version=0.0.4`` parser errors on the ``#`` token and
+    the WHOLE scrape fails — so the classic rendering never carries
+    them."""
     lines: List[str] = []
     bounds = bucket_bounds_s()
 
@@ -325,16 +376,26 @@ def render_prometheus() -> List[str]:
             continue
         lines.append(f"# TYPE {name} histogram")
         for key in sorted(series):
-            counts, sum_ns, n = series[key].snapshot()
+            h = series[key]
+            counts, sum_ns, n = h.snapshot()
+            # OpenMetrics exemplars: kept-trace ids stamped by the tail
+            # sampler (observe/trace.py) onto the bucket their span
+            # duration landed in — "# {trace_id=...} value ts" appended
+            # to the bucket sample, so a p99 bucket links to /traces
+            exemplars = h.exemplars() if openmetrics else None
             cum = 0
             for i, bound in enumerate(bounds):
                 cum += counts[i]
-                lines.append(
+                line = (
                     f"{name}_bucket"
                     f"{_fmt_labels(key, (('le', _fmt_le(bound)),))} {cum}"
                 )
-            lines.append(
+                lines.append(line + _fmt_exemplar(exemplars, i))
+            inf_line = (
                 f"{name}_bucket{_fmt_labels(key, (('le', '+Inf'),))} {n}"
+            )
+            lines.append(
+                inf_line + _fmt_exemplar(exemplars, len(bounds))
             )
             lines.append(f"{name}_sum{_fmt_labels(key)} {sum_ns * 1e-9:.9f}")
             lines.append(f"{name}_count{_fmt_labels(key)} {n}")
@@ -368,6 +429,20 @@ def render_prometheus() -> List[str]:
         lines.append(f"# TYPE {name} gauge")
         for key, value in sorted(rows):
             lines.append(f"{name}{_fmt_labels(key)} {_fmt_value(value)}")
+    # bounded-ring health: the drop counters were tracked (event ring,
+    # dispatch counter) but never rendered; a silently-saturating ring
+    # reads as "nothing happened" exactly when the most is happening
+    rings = _ring_health()
+    lines.append("# TYPE pathway_observe_events_dropped_total counter")
+    for ring, _capacity, dropped in rings:
+        lines.append(
+            f'pathway_observe_events_dropped_total{{ring="{ring}"}} {dropped}'
+        )
+    lines.append("# TYPE pathway_observe_ring_capacity gauge")
+    for ring, capacity, _dropped in rings:
+        lines.append(
+            f'pathway_observe_ring_capacity{{ring="{ring}"}} {capacity}'
+        )
     return lines
 
 
@@ -448,6 +523,10 @@ def snapshot() -> Dict[str, Any]:
     events, total = _ring.snapshot()
     return {
         "enabled": _state.enabled,
+        "rings": {
+            ring: {"capacity": capacity, "dropped": dropped}
+            for ring, capacity, dropped in _ring_health()
+        },
         "histograms": hists,
         "counters": counters,
         "gauges": gauges,

@@ -41,6 +41,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from .. import observe
+from ..observe import trace as _trace
 
 __all__ = ["DispatchCounter", "install", "uninstall", "record_dispatch", "record_fetch"]
 
@@ -151,10 +152,15 @@ def uninstall() -> None:
 def record_dispatch(tag: str, shards: int = 1) -> None:
     """Report one LOGICAL dispatch.  ``shards > 1`` marks a shard-group
     fan-out: ``shards`` physical kernel launches that together cost the
-    batch one round trip (scatter + per-shard search + merge)."""
+    batch one round trip (scatter + per-shard search + merge).  The
+    active trace (observe/trace.py), when one exists, gets the count
+    stamped too — a kept trace carries its own 2+2 budget evidence."""
     _obs_counter("dispatch", tag).inc()
     if shards > 1:
         _obs_shard_counter("dispatch", tag).inc(shards)
+    t = _trace.current()
+    if t is not None:
+        t.note_dispatch(tag, shards)
     c = _active
     if c is not None:
         c._record("dispatch", tag, shards)
@@ -164,6 +170,9 @@ def record_fetch(tag: str, shards: int = 1) -> None:
     _obs_counter("fetch", tag).inc()
     if shards > 1:
         _obs_shard_counter("fetch", tag).inc(shards)
+    t = _trace.current()
+    if t is not None:
+        t.note_fetch(tag, shards)
     c = _active
     if c is not None:
         c._record("fetch", tag, shards)

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..observe import trace
 from ..robust import (
     RetryPolicy,
     TAIL_SKIPPED,
@@ -888,6 +889,7 @@ class IvfKnnIndex:
         cache = self._tail_cache
         if cache is None:
             self.stats["tail_cache_misses"] += 1
+            t_up0 = time.perf_counter_ns()
             tail, tail_mat, tail_valid, t_pad = self._tail_snapshot()
 
             def _upload():
@@ -923,6 +925,12 @@ class IvfKnnIndex:
                 )
                 record_degraded(TAIL_SKIPPED)
                 self.tail_degraded = True
+                _t = trace.current()
+                if _t is not None:
+                    _t.add_span(
+                        "ivf.tail_upload", t_up0, time.perf_counter_ns(),
+                        status=TAIL_SKIPPED, error=type(exc).__name__,
+                    )
                 return (
                     [],
                     jnp.asarray(
@@ -932,6 +940,15 @@ class IvfKnnIndex:
                     0,
                 )
             self.tail_degraded = False
+            _t = trace.current()
+            if _t is not None:
+                # a serve that paid the (cache-miss) tail re-upload shows
+                # it as its own span — the classic "why was THIS one
+                # slow" answer after an absorb invalidated the cache
+                _t.add_span(
+                    "ivf.tail_upload", t_up0, time.perf_counter_ns(),
+                    rows=t_pad,
+                )
             cache = (tail, dev_mat, dev_valid, t_pad)
             self._tail_cache = cache
         else:

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..observe import trace
 from ..robust import (
     CircuitBreaker,
     CircuitOpen,
@@ -582,6 +583,22 @@ class RetrieveRerankPipeline:
             stages[i + 1].width(k) if i + 1 < len(stages) else k
             for i in range(len(stages))
         ]
+        # per-stage trace bookkeeping (observe/trace.py): submit time and
+        # sub-budget, stamped onto each cascade-stage span so a kept
+        # trace shows WHERE down the ladder a serve degraded and how
+        # much budget the stage had when it ran
+        t_stage: List[int] = [0] * len(stages)
+        stage_budget_ms: List[Optional[float]] = [None] * len(stages)
+
+        def stage_span(i: int, status: str, t_end: int, **attrs) -> None:
+            _t = trace.current()
+            if _t is None:
+                return
+            t0 = t_stage[i] or t_end
+            _t.add_span(
+                "stage." + stages[i].name, t0, t_end, status=status,
+                budget_ms=stage_budget_ms[i], keep=keeps[i], **attrs,
+            )
 
         def skip(stage: RerankStage, exc: BaseException) -> None:
             if not isinstance(exc, (DeadlineExceeded, CircuitOpen)):
@@ -594,6 +611,10 @@ class RetrieveRerankPipeline:
                     exc,
                     stage.rung,
                 )
+            stage_span(
+                stages.index(stage), stage.rung, time.perf_counter_ns(),
+                error=type(exc).__name__,
+            )
             if stage.rung not in flags:
                 flags.append(stage.rung)
                 record_degraded(stage.rung, n_requests)
@@ -605,12 +626,16 @@ class RetrieveRerankPipeline:
             if deadline is not None:
                 deadline.check(f"{stage.name}_submit")
             width = stage.width(k)
+            t_stage[i] = time.perf_counter_ns()
+            sub = stage.sub_deadline(deadline)
+            if sub is not None and trace.current() is not None:
+                stage_budget_ms[i] = round(sub.remaining_s() * 1e3, 3)
             return stage.submit(
                 self,
                 queries,
                 [r[:width] for r in cur_rows],
                 keeps[i],
-                stage.sub_deadline(deadline),
+                sub,
                 query_tokens=query_tokens,
                 query_mask=query_mask,
                 pool_width=width,
@@ -647,6 +672,7 @@ class RetrieveRerankPipeline:
                             stage_meta = dict(stage_meta)
                             stage_meta.pop("degraded_reasons", None)
                             meta.update(stage_meta)
+                        stage_span(i, "ok", time.perf_counter_ns())
                     except Exception as exc:
                         skip(stages[i], exc)
                 i += 1
@@ -819,6 +845,12 @@ class RetrieveRerankPipeline:
         # segments vs the padded [Rb, Sb] segment grid
         observe.record_occupancy("stage2", rows_real, Rb)
         observe.record_occupancy("stage2_pairs", len(pairs), Rb * Sb)
+        _t = trace.current()
+        if _t is not None:
+            _t.add_span(
+                "stage2.pack_dispatch", t_pack, t_dispatch,
+                exemplar=_H_S2PACK, pairs=len(pairs), rows=Rb,
+            )
 
         def complete() -> List[List[Tuple[int, float]]]:
             inject.fire("cross_encoder.fetch", deadline=deadline)
@@ -832,6 +864,11 @@ class RetrieveRerankPipeline:
             record_fetch("rerank_stage2")
             t_fetch = time.perf_counter_ns()
             _H_S2RTT.observe_ns(t_fetch - t_dispatch)
+            _ct = trace.current()
+            if _ct is not None:
+                _ct.add_span(
+                    "stage2.rtt", t_dispatch, t_fetch, exemplar=_H_S2RTT
+                )
             scores = np.ascontiguousarray(arr[:, :k_out]).view(np.float32)
             perm = arr[:, k_out:]
             results: List[List[Tuple[int, float]]] = []
@@ -909,6 +946,12 @@ class RetrieveRerankPipeline:
             record_fetch("rerank_stage2_host")
             t_fetch = time.perf_counter_ns()
             _H_S2RTT.observe_ns(t_fetch - t_dispatch)
+            _ct = trace.current()
+            if _ct is not None:
+                _ct.add_span(
+                    "stage2.rtt", t_dispatch, t_fetch,
+                    exemplar=_H_S2RTT, host=True,
+                )
             results: List[List[Tuple[int, float]]] = []
             pos = 0
             width = pool or self.candidates

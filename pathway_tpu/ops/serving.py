@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..observe import trace
 from ..models.transformer import TransformerEncoder
 from ..robust import (
     CircuitOpen,
@@ -56,6 +57,13 @@ _LOCKED_DISPATCH_RETRY = RetryPolicy(
 # tokenize_pack covers host prep (lock wait + tokenize + pad + compiled-fn
 # lookup) up to the dispatch; stage1_rtt is dispatch→fetch-complete of the
 # fused kernel; postprocess is the host-side result assembly.
+#
+# Tracing (observe/trace.py) reuses the SAME clock reads: every span on
+# this path is recorded with the timestamps already taken for these
+# histograms (explicit t0/t1 — no span context manager is ever held
+# across the serve locks), and the histogram objects ride along as
+# exemplar targets so a kept trace stamps its id onto the exact bucket
+# its stage durations landed in.
 _H_TOKENIZE = observe.histogram("pathway_serve_stage_seconds", stage="tokenize_pack")
 _H_STAGE1 = observe.histogram("pathway_serve_stage_seconds", stage="stage1_rtt")
 _H_POST = observe.histogram("pathway_serve_stage_seconds", stage="postprocess")
@@ -615,6 +623,12 @@ class FusedEncodeSearch:
                 deadline=deadline,
             )
             qtok = None
+        _t = trace.current()
+        if _t is not None:
+            _t.add_span(
+                "stage1.encode", t_start, time.perf_counter_ns(),
+                queries=n_real, batch=B,
+            )
         physical = 1  # the encode launch
         outs: List[Any] = []
         snaps: List[Any] = []
@@ -681,15 +695,26 @@ class FusedEncodeSearch:
                     s,
                     exc,
                 )
+                _t = trace.current()
+                if _t is not None:
+                    _t.add_span(
+                        "shard.dispatch", t_shard, time.perf_counter_ns(),
+                        status="skipped", shard=s,
+                        error=type(exc).__name__,
+                    )
                 outs.append(None)
                 snaps.append(None)
                 continue
             physical += 1
             outs.append(out)
             snaps.append((keys_by_slot, tail, n_slotspace, child))
-            self._shard_hist("dispatch", s).observe_ns(
-                time.perf_counter_ns() - t_shard
-            )
+            t_shard_done = time.perf_counter_ns()
+            self._shard_hist("dispatch", s).observe_ns(t_shard_done - t_shard)
+            _t = trace.current()
+            if _t is not None:
+                _t.add_span(
+                    "shard.dispatch", t_shard, t_shard_done, shard=s
+                )
         live = [s for s in range(len(shards)) if outs[s] is not None]
         if not live:
             if skipped:
@@ -731,6 +756,13 @@ class FusedEncodeSearch:
         )
         _H_TOKENIZE.observe_ns(t_dispatch - t_start)
         observe.record_occupancy("stage1", n_real, B)
+        _t = trace.current()
+        if _t is not None:
+            _t.add_span(
+                "shard.merge", t_merge, t_dispatch,
+                shards=len(live), host_merge=bool(host_merge),
+                skipped=len(skipped),
+            )
 
         def complete() -> List[List[Tuple[int, float]]]:
             inject.fire("serve.fetch", deadline=deadline)
@@ -762,6 +794,12 @@ class FusedEncodeSearch:
                 m_i = arr[:, 2 * k_eff :]
             t_fetch = time.perf_counter_ns()
             _H_STAGE1.observe_ns(t_fetch - t_dispatch)
+            _ct = trace.current()
+            if _ct is not None:
+                _ct.add_span(
+                    "stage1.fetch", t_dispatch, t_fetch,
+                    exemplar=_H_STAGE1, kind="sharded",
+                )
             results: List[List[Tuple[int, float]]] = []
             for qi in range(len(texts)):
                 row: List[Tuple[int, float]] = []
@@ -789,7 +827,10 @@ class FusedEncodeSearch:
                         seen.add(key)
                         dedup.append((key, sc))
                 results.append(dedup[:k])
-            _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
+            t_post = time.perf_counter_ns()
+            _H_POST.observe_ns(t_post - t_fetch)
+            if _ct is not None:
+                _ct.add_span("stage1.postprocess", t_fetch, t_post)
             flags: List[str] = []
             if tail_skipped:
                 flags.append(TAIL_SKIPPED)
@@ -898,6 +939,13 @@ class FusedEncodeSearch:
         t_dispatch = time.perf_counter_ns()
         _H_TOKENIZE.observe_ns(t_dispatch - t_start)
         observe.record_occupancy("stage1", n_real, ids.shape[0])
+        _t = trace.current()
+        if _t is not None:
+            _t.add_span(
+                "stage1.dispatch", t_start, t_dispatch,
+                exemplar=_H_TOKENIZE, kind="ivf",
+                queries=n_real, batch=ids.shape[0], tail=t_pad,
+            )
         keys_by_slot = index._keys_by_slot  # rebuilds REPLACE the array
 
         def complete() -> List[List[Tuple[int, float]]]:
@@ -906,6 +954,12 @@ class FusedEncodeSearch:
             record_fetch("serve_ivf")
             t_fetch = time.perf_counter_ns()
             _H_STAGE1.observe_ns(t_fetch - t_dispatch)
+            _ct = trace.current()
+            if _ct is not None:
+                _ct.add_span(
+                    "stage1.fetch", t_dispatch, t_fetch,
+                    exemplar=_H_STAGE1, kind="ivf",
+                )
             scores = np.ascontiguousarray(arr[:, :k_main]).view(np.float32)
             slots = arr[:, k_main : 2 * k_main]
             if k_tail:
@@ -939,7 +993,10 @@ class FusedEncodeSearch:
                         seen.add(key)
                         dedup.append((key, s))
                 results.append(dedup[:k])
-            _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
+            t_post = time.perf_counter_ns()
+            _H_POST.observe_ns(t_post - t_fetch)
+            if _ct is not None:
+                _ct.add_span("stage1.postprocess", t_fetch, t_post)
             return ServeResult(
                 results,
                 degraded=(TAIL_SKIPPED,) if tail_skipped else (),
@@ -1083,6 +1140,13 @@ class FusedEncodeSearch:
         t_dispatch = time.perf_counter_ns()
         _H_TOKENIZE.observe_ns(t_dispatch - t_start)
         observe.record_occupancy("stage1", n_real, B)
+        _t = trace.current()
+        if _t is not None:
+            _t.add_span(
+                "stage1.dispatch", t_start, t_dispatch,
+                exemplar=_H_TOKENIZE, kind="exact",
+                queries=n_real, batch=B,
+            )
 
         def complete() -> List[List[Tuple[int, float]]]:
             inject.fire("serve.fetch", deadline=deadline)
@@ -1090,6 +1154,12 @@ class FusedEncodeSearch:
             record_fetch("serve_exact")
             t_fetch = time.perf_counter_ns()
             _H_STAGE1.observe_ns(t_fetch - t_dispatch)
+            _ct = trace.current()
+            if _ct is not None:
+                _ct.add_span(
+                    "stage1.fetch", t_dispatch, t_fetch,
+                    exemplar=_H_STAGE1, kind="exact",
+                )
             scores = np.ascontiguousarray(arr[:, :k_eff]).view(np.float32)
             ints = np.ascontiguousarray(arr[:, k_eff:]).view(np.uint32)
             hi = ints[:, :k_eff].astype(np.uint64)
@@ -1104,7 +1174,10 @@ class FusedEncodeSearch:
                         continue
                     row.append((int(keys[qi, j]), s))
                 results.append(row[:k])
-            _H_POST.observe_ns(time.perf_counter_ns() - t_fetch)
+            t_post = time.perf_counter_ns()
+            _H_POST.observe_ns(t_post - t_fetch)
+            if _ct is not None:
+                _ct.add_span("stage1.postprocess", t_fetch, t_post)
             return ServeResult(results, meta={"index_generation": gen0})
 
         # device-resident query token states for a late-interaction stage

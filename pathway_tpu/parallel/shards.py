@@ -35,6 +35,7 @@ from typing import List, Optional, Sequence
 import jax
 
 from .. import observe
+from ..observe import trace
 from ..robust import CircuitBreaker
 
 __all__ = ["ShardGroup", "serve_shards"]
@@ -115,6 +116,11 @@ class ShardGroup:
     def record_skip(self, shard: int) -> None:
         with self._lock:
             self.skips[shard] += 1
+        # annotate the active trace: a kept slow/degraded serve shows
+        # WHICH shard it lost, next to the per-shard dispatch spans
+        t = trace.current()
+        if t is not None:
+            t.add_event("shard.skip", shard=int(shard))
 
     # -- flight-recorder provider ------------------------------------------
     def observe_metrics(self):

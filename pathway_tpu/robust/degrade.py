@@ -44,6 +44,7 @@ from __future__ import annotations
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from .. import observe
+from ..observe import trace as _trace
 
 __all__ = [
     "EXTRACTIVE_ANSWER",
@@ -70,13 +71,19 @@ _degraded_counters: Dict[str, observe.Counter] = {}
 
 def record_degraded(reason: str, n: int = 1) -> None:
     """Count ``n`` degraded serves for ``reason`` on the existing
-    /metrics surface (``pathway_serve_degraded_total{reason=...}``)."""
+    /metrics surface (``pathway_serve_degraded_total{reason=...}``),
+    and stamp the rung onto the active trace (observe/trace.py) — a
+    recorded rung is exactly what the tail sampler's "always keep
+    degraded serves" rule keys on."""
     c = _degraded_counters.get(reason)
     if c is None:
         c = _degraded_counters[reason] = observe.counter(
             "pathway_serve_degraded_total", reason=reason
         )
     c.inc(n)
+    t = _trace.current()
+    if t is not None:
+        t.set_status(reason)
 
 
 class ServeResult(list):

@@ -10,11 +10,18 @@ instrumented failure point a NAME — ``ivf.dispatch``,
 sharded-serve family ``shard.dispatch`` / ``shard.merge`` /
 ``shard.absorb`` (each also addressable per shard as
 ``shard.<site>.<n>``, so a game-day can kill exactly one shard of a
-group), and the serve-cache pair ``cache.get`` / ``cache.put``
+group), the serve-cache pair ``cache.get`` / ``cache.put``
 (pathway_tpu/cache — a faulted lookup degrades to a recompute MISS and
 a faulted store drops the entry; the serve result is never wrong and
-never fails, proven by the chaos triple in tests/test_robust.py), … —
-and lets a test (or an operator running a game-day) arm any site to
+never fails, proven by the chaos triple in tests/test_robust.py), and
+the tracing pair ``trace.record`` / ``trace.export``
+(pathway_tpu/observe/trace.py — ANY armed fault in the tracing path,
+raise/delay/hang alike, degrades to dropped spans counted on
+``pathway_trace_spans_dropped_total`` and a flagged-empty ``/traces``
+payload; the tracing layer fires these sites under an already-spent
+deadline so even a hang releases immediately and a serve is never
+failed or stalled by its own observability), … — and lets a test (or
+an operator running a game-day) arm any site to
 
 - ``raise`` a ``FaultInjected`` (a transient dispatch/socket error),
 - ``delay`` execution by a fixed duration (a slow link or device), or
@@ -46,6 +53,7 @@ from .deadline import Deadline, DeadlineExceeded
 
 __all__ = [
     "FaultInjected",
+    "any_armed",
     "arm",
     "armed",
     "disarm",
@@ -170,6 +178,16 @@ def fired_count(site: str) -> int:
     with _lock:
         spec = _sites.get(site)
         return spec.fired if spec is not None else 0
+
+
+def any_armed() -> bool:
+    """True when at least one site is armed — the same fast-path guard
+    ``fire`` uses, exposed so callers that need pre/post bookkeeping
+    around a fire (the tracing layer's drop-on-any-fault contract) can
+    skip it entirely in the unarmed steady state."""
+    if not _env_loaded:
+        load_env()
+    return _armed_count != 0
 
 
 def fire(site: str, deadline: Optional[Deadline] = None) -> None:

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 # pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
 
+import contextlib
 import inspect
 import os
 import threading
@@ -73,6 +74,7 @@ import numpy as np
 
 from .. import observe
 from ..cache import query_key, result_cache_from_env
+from ..observe import trace
 from ..robust import Deadline, RETRIEVAL_FAILED, ServeResult, log_once, record_degraded
 
 __all__ = [
@@ -113,6 +115,9 @@ def max_batch_queries() -> int:
 # histograms; per-instance split rides the provider counters below)
 _H_QUEUE_WAIT = observe.histogram("pathway_serve_queue_wait_seconds")
 
+# stateless shared no-op context manager for the untraced fast path
+_NOOP_CM = contextlib.nullcontext()
+
 
 class _Request:
     """One admitted serve/score call: resolved by the scheduler with the
@@ -120,7 +125,7 @@ class _Request:
 
     __slots__ = (
         "items", "k", "deadline", "t_enqueue_ns", "event", "batch", "slots",
-        "cache_store",
+        "cache_store", "trace",
     )
 
     def __init__(self, items: Sequence[Any], k: Optional[int], deadline):
@@ -134,6 +139,10 @@ class _Request:
         # tier-0 capture flag: set at admission when a result cache is
         # armed (cache-hit tickets never re-store their own rows)
         self.cache_store = False
+        # per-request TraceContext (observe/trace.py), created at
+        # submit() admission and finished at demux — None when tracing
+        # is off or the request was head-sampled out
+        self.trace = None
 
 
 class _Batch:
@@ -143,9 +152,10 @@ class _Batch:
     ever guards the once-only completion, never a queue."""
 
     __slots__ = ("_handle", "_n_items", "_n_requests", "_degrade_empty",
-                 "_lock", "_done", "_result", "_error")
+                 "_lock", "_done", "_result", "_error", "_trace")
 
-    def __init__(self, handle, n_items: int, n_requests: int, degrade_empty: bool):
+    def __init__(self, handle, n_items: int, n_requests: int,
+                 degrade_empty: bool, trace_ctx=None):
         self._handle = handle
         self._n_items = n_items
         self._n_requests = n_requests
@@ -154,6 +164,11 @@ class _Batch:
         self._done = False
         self._result: Any = None
         self._error: Optional[BaseException] = None
+        # the BATCH trace (observe/trace.py): the shared work — stage-1
+        # dispatch, shard fan-out, cascade stages — records into it;
+        # advance()/result() re-activate it because they run on other
+        # threads (scheduler thread / whichever waiter fetches first)
+        self._trace = trace_ctx
 
     def advance(self) -> None:
         """Pipelining hook: complete stage 1 and dispatch stage 2 of this
@@ -164,7 +179,11 @@ class _Batch:
         if adv is None:
             return
         try:
-            adv()
+            if self._trace is not None:
+                with trace.use(self._trace):
+                    adv()
+            else:
+                adv()
         except Exception:
             pass  # surfaces (once) at result() via the same handle
 
@@ -172,7 +191,11 @@ class _Batch:
         with self._lock:
             if not self._done:
                 try:
-                    self._result = self._handle()
+                    if self._trace is not None:
+                        with trace.use(self._trace):
+                            self._result = self._handle()
+                    else:
+                        self._result = self._handle()
                 except Exception as exc:
                     if self._degrade_empty:
                         # a target without an internal degradation ladder
@@ -193,6 +216,14 @@ class _Batch:
                     else:
                         self._error = exc
                 self._done = True
+                if self._trace is not None:
+                    # finish INSIDE the batch lock: a rider's demux (and
+                    # its link promotion) must never observe the batch
+                    # trace unfinished once result() has returned
+                    flags = tuple(getattr(self._result, "degraded", ()) or ())
+                    if self._error is not None:
+                        flags = flags + ("error",)
+                    trace.finish(self._trace, statuses=flags)
         if self._error is not None:
             raise self._error
         return self._result
@@ -301,8 +332,19 @@ class _CoalescerBase:
         self.stop()
 
     # -- admission ----------------------------------------------------------
-    def _admit(self, items: Sequence[Any], k: Optional[int], deadline) -> _Ticket:
+    def _admit(
+        self, items: Sequence[Any], k: Optional[int], deadline, trace_ctx=None
+    ) -> _Ticket:
         req = _Request(items, k, deadline)
+        # attach the trace BEFORE the queue sees the request: the
+        # scheduler thread may pop and dispatch it immediately, and the
+        # link span is recorded from whatever ``r.trace`` holds then
+        req.trace = trace_ctx
+        if trace_ctx is not None:
+            trace_ctx.add_span(
+                "admission", trace_ctx.t0_ns, req.t_enqueue_ns,
+                items=len(req.items),
+            )
         if not req.items:
             req.slots = []
             req.batch = _Batch(lambda: ServeResult(), 0, 1, self._degrade_empty)
@@ -369,6 +411,10 @@ class _CoalescerBase:
             req.slots = [-1] * len(req.items)
         req.batch = _Batch(handle, len(req.items), 1, self._degrade_empty)
         req.event.set()
+        if req.trace is not None:
+            # the ticket will raise (or demux a degraded empty); either
+            # way this trace's outcome is known — keep it
+            trace.finish(req.trace, statuses=("error",))
 
     def _collect(self) -> Optional[List[_Request]]:
         """Block until work arrives, hold the coalescing window open
@@ -442,6 +488,21 @@ class _CoalescerBase:
         items: List[Any] = []
         total = sum(len(r.items) for r in reqs)
         error: Optional[BaseException] = None
+        # one BATCH trace for the shared work, linked from every traced
+        # rider: sampling already happened at the riders' admission, so
+        # the batch trace is created iff a traced rider is aboard
+        bctx = None
+        if any(r.trace is not None for r in reqs):
+            bctx = trace.start_trace(
+                "serve.batch",
+                deadline=self._batch_deadline(reqs),
+                kind="batch",
+                sample=False,
+            )
+            if bctx is not None:
+                bctx.annotate(
+                    scheduler=self.name, riders=len(reqs), solo=bool(solo)
+                )
         try:
             index: Dict[Any, int] = {}
             for r in reqs:
@@ -454,7 +515,12 @@ class _CoalescerBase:
                 index[it] = i
             for r in reqs:
                 r.slots = [index[it] for it in r.items]
-            handle = self._launch(items, reqs)
+            if bctx is not None:
+                bctx.annotate(items=len(items), deduped=total - len(items))
+                with trace.use(bctx):
+                    handle = self._launch(items, reqs)
+            else:
+                handle = self._launch(items, reqs)
         except Exception as exc:
             # packing or launch failed: every ticket still resolves —
             # the error lands in _Batch.result() (degrade or re-raise)
@@ -465,7 +531,9 @@ class _CoalescerBase:
 
             def handle(_exc: BaseException = error):
                 raise _exc
-        batch = _Batch(handle, len(items), len(reqs), self._degrade_empty)
+        batch = _Batch(
+            handle, len(items), len(reqs), self._degrade_empty, trace_ctx=bctx
+        )
         with self._qlock:
             if not solo:
                 self.stats["batches"] += 1
@@ -474,6 +542,29 @@ class _CoalescerBase:
         t_now = time.perf_counter_ns()
         for r in reqs:
             _H_QUEUE_WAIT.observe_ns(t_now - r.t_enqueue_ns)
+            rt = r.trace
+            if rt is not None:
+                # the rider's LINK span: its duration is the queue wait
+                # (enqueue → handoff, the EXACT interval _H_QUEUE_WAIT
+                # just observed — exemplar and observation must land in
+                # the same bucket), its attrs say which batch it rode
+                # and with how many others; /traces inlines the linked
+                # batch tree under it.
+                if bctx is not None:
+                    rt.add_link(bctx.trace_id)
+                    rt.add_span(
+                        "batch", r.t_enqueue_ns, t_now,
+                        exemplar=_H_QUEUE_WAIT,
+                        linked_trace=bctx.trace_id,
+                        riders=len(reqs), batch_items=len(items),
+                        solo=bool(solo),
+                    )
+                else:
+                    rt.add_span(
+                        "batch", r.t_enqueue_ns, t_now,
+                        exemplar=_H_QUEUE_WAIT,
+                        riders=len(reqs), solo=bool(solo),
+                    )
             r.batch = batch
             r.event.set()
         return batch
@@ -647,6 +738,10 @@ class ServeScheduler(_CoalescerBase):
         if deadline is None:
             default = getattr(self.target, "_default_deadline", Deadline.from_env)
             deadline = default()
+        # per-request trace root (observe/trace.py): admission → cache →
+        # batch link → demux all hang off this context; None (one flag
+        # check, no allocation) when tracing is off or sampled out
+        ctx = trace.start_trace("serve.request", deadline=deadline)
         gen = 0
         if self._generation is not None:
             try:
@@ -665,7 +760,17 @@ class ServeScheduler(_CoalescerBase):
             # lock): a full hit is a zero-dispatch serve that skips the
             # coalescing window entirely; any miss (or cache failure)
             # falls through to the shared batch unchanged
-            rows = cache.get_rows(items, k_eff, deadline=deadline)
+            if ctx is not None:
+                t_c0 = time.perf_counter_ns()
+                with trace.use(ctx):  # tier events annotate this trace
+                    rows = cache.get_rows(items, k_eff, deadline=deadline)
+                ctx.add_span(
+                    "cache.result", t_c0, time.perf_counter_ns(),
+                    status="hit" if rows is not None else "miss",
+                    items=len(items),
+                )
+            else:
+                rows = cache.get_rows(items, k_eff, deadline=deadline)
             if rows is not None:
                 with self._qlock:
                     self.stats["cache_hits"] = (
@@ -673,6 +778,9 @@ class ServeScheduler(_CoalescerBase):
                     )
                     self.stats["items"] += len(items)
                 req = _Request(items, k_eff, deadline)
+                req.trace = ctx
+                if ctx is not None:
+                    ctx.annotate(cache="hit")
                 req.slots = list(range(len(items)))
                 hit = ServeResult(rows)
                 req.batch = _Batch(
@@ -680,7 +788,7 @@ class ServeScheduler(_CoalescerBase):
                 )
                 req.event.set()
                 return _Ticket(self, req)
-        ticket = self._admit(items, k_eff, deadline)
+        ticket = self._admit(items, k_eff, deadline, trace_ctx=ctx)
         if cache is not None:
             ticket._request.cache_store = True
         return ticket
@@ -762,10 +870,19 @@ class ServeScheduler(_CoalescerBase):
             # mutation landing mid-flight must not be stored under the
             # pre-mutation key.
             meta_gen = result.meta.get("index_generation")
-            for (text, gen), row in zip(req.items, rows):
-                if meta_gen is not None and int(meta_gen) != int(gen):
-                    continue
-                cache.put_row(text, gen, k, row, deadline=req.deadline)
+            ctx = req.trace
+            with trace.use(ctx) if ctx is not None else _NOOP_CM:
+                for (text, gen), row in zip(req.items, rows):
+                    if meta_gen is not None and int(meta_gen) != int(gen):
+                        continue
+                    cache.put_row(text, gen, k, row, deadline=req.deadline)
+        ctx = req.trace
+        if ctx is not None:
+            # rider trace complete: the root span IS the request latency
+            # (admission → demux); tail sampling runs now, when the
+            # outcome (rungs, deadline, duration percentile) is known
+            ctx.annotate(k=k)
+            trace.finish(ctx, statuses=tuple(result.degraded))
         return result
 
     # -- flight-recorder provider ------------------------------------------

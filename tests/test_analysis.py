@@ -751,6 +751,121 @@ def test_cache_access_under_lock_flagged():
     assert _live(_run(good), "lock-discipline") == []
 
 
+def test_span_across_lock_flagged_on_serve_path():
+    """ISSUE 9: a trace span opened as a context manager across a
+    ``with <lock>:`` boundary on a serve-path module times the lock
+    WAIT as stage work — spans time work, not lock waits."""
+    bad = """
+        # pathway: serve-path
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def submit(self, tracer, q):
+                with tracer.span("stage1"):
+                    with self._lock:
+                        fn = self._fns.get(q)
+                return fn
+    """
+    found = _live(_run(bad), "lock-discipline")
+    assert len(found) == 1, found
+    assert "span opened across" in found[0].message
+
+    # start_span / span_timer spellings are the same violation
+    bad2 = """
+        # pathway: serve-path
+        import threading
+
+        def f(self, tracer):
+            with tracer.start_span("x"):
+                with self._lock:
+                    pass
+    """
+    assert len(_live(_run(bad2), "lock-discipline")) == 1
+
+    # combined single-statement form, span item FIRST: the lock is
+    # acquired inside the span timing — same violation
+    bad3 = """
+        # pathway: serve-path
+        import threading
+
+        def f(self, tracer):
+            with tracer.span("stage1"), self._lock:
+                pass
+    """
+    assert len(_live(_run(bad3), "lock-discipline")) == 1
+
+    # combined form, LOCK item first: span opens under an already-held
+    # lock (the nested span-under-lock shape) — sanctioned
+    ok_order = """
+        # pathway: serve-path
+        import threading
+
+        def f(self, tracer):
+            with self._lock, tracer.span("work"):
+                pass
+    """
+    assert _live(_run(ok_order), "lock-discipline") == []
+
+    # span AROUND lock-free work, lock elsewhere: sanctioned
+    good = """
+        # pathway: serve-path
+        import threading
+
+        def f(self, tracer):
+            with self._lock:
+                t0 = 1
+            with tracer.span("postprocess"):
+                rows = sorted(())
+            return rows
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+    # the explicit-timestamp shape the serve paths use: never flagged
+    good2 = """
+        # pathway: serve-path
+        import threading
+        import time
+
+        def f(self, trace):
+            t0 = time.perf_counter_ns()
+            with self._lock:
+                x = 1
+            t = trace.current()
+            if t is not None:
+                t.add_span("stage1.dispatch", t0, time.perf_counter_ns())
+            return x
+    """
+    assert _live(_run(good2), "lock-discipline") == []
+
+    # NOT a serve-path module: the rule does not apply
+    off_path = """
+        import threading
+
+        def f(self, tracer):
+            with tracer.span("x"):
+                with self._lock:
+                    pass
+    """
+    assert _live(_run(off_path), "lock-discipline") == []
+
+    # a reviewed suppression still works
+    suppressed = """
+        # pathway: serve-path
+        import threading
+
+        def f(self, tracer):
+            with tracer.span("x"):  # pathway: allow(lock-discipline): measured lock is uncontended by construction
+                with self._lock:
+                    pass
+    """
+    findings = _run(suppressed)
+    assert _live(findings, "lock-discipline") == []
+    assert any(f.rule == "lock-discipline" and f.suppressed for f in findings)
+
+
 def test_get_or_compute_inflight_ownership_stays_off_global_lock():
     """The sanctioned get_or_compute shape (persistence/object_cache.py):
     the global lock guards only the in-flight owner dict; compute and

@@ -269,11 +269,18 @@ _SAMPLE_RE = re.compile(
     r" (NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)$"
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# OpenMetrics exemplar suffix on a histogram bucket sample:
+#   ... <value> # {trace_id="abc"} <exemplar_value> <unix_ts>
+_EXEMPLAR_RE = re.compile(
+    r'^\{trace_id="[0-9a-f]+"\} '
+    r"[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)? [0-9]+\.[0-9]+$"
+)
 
 
 def _parse_exposition(body: str):
-    """Parse Prometheus text format; returns (samples, types).  Raises
-    AssertionError on any malformed line — the validator core."""
+    """Parse Prometheus text format (+ OpenMetrics bucket exemplars);
+    returns (samples, types).  Raises AssertionError on any malformed
+    line — the validator core."""
     samples = []  # (name, frozenset(labels), float)
     types = {}
     for raw in body.split("\n"):
@@ -287,6 +294,14 @@ def _parse_exposition(body: str):
             continue
         if line.startswith("#"):
             continue
+        if " # " in line:
+            line, exemplar = line.split(" # ", 1)
+            assert _EXEMPLAR_RE.match(exemplar), (
+                f"malformed exemplar suffix: {raw!r}"
+            )
+            assert "_bucket" in line.split()[0], (
+                f"exemplar on a non-bucket sample: {raw!r}"
+            )
         m = _SAMPLE_RE.match(line)
         assert m, f"unparseable sample line: {raw!r}"
         name, labelblob, value = m.group(1), m.group(2), m.group(3)
@@ -453,6 +468,192 @@ def _ivf_samples(ivf):
     ]
 
 
+def test_ring_health_families_render(serve_stack):
+    """ISSUE 9 satellite: the bounded rings' drop counts (tracked since
+    PR 3 but never rendered) and capacities are on the scrape surface."""
+    body = "\n".join(observe.render_prometheus())
+    samples, types = _parse_exposition(body)
+    names = {s[0] for s in samples}
+    assert "pathway_observe_events_dropped_total" in names
+    assert "pathway_observe_ring_capacity" in names
+    assert types["pathway_observe_events_dropped_total"] == "counter"
+    assert types["pathway_observe_ring_capacity"] == "gauge"
+    rings = {
+        dict(labels)["ring"]
+        for name, labels, _v in samples
+        if name == "pathway_observe_ring_capacity"
+    }
+    assert {"serve_events", "trace_kept", "trace_pending"} <= rings
+    # with a dispatch counter installed, its bounded event buffer joins
+    from pathway_tpu.ops import dispatch_counter
+
+    with dispatch_counter.DispatchCounter(max_events=4):
+        for _ in range(10):
+            dispatch_counter.record_dispatch("ring_test")
+        body = "\n".join(observe.render_prometheus())
+        samples, _types = _parse_exposition(body)
+        dropped = {
+            dict(labels)["ring"]: v
+            for name, labels, v in samples
+            if name == "pathway_observe_events_dropped_total"
+        }
+    assert dropped.get("dispatch_counter") == 6
+    # /serve_stats mirrors the same rows as JSON
+    stats = observe.snapshot()
+    assert "serve_events" in stats["rings"]
+    assert stats["rings"]["serve_events"]["capacity"] >= 1
+
+
+def test_traces_endpoint_serves_kept_span_trees(serve_stack):
+    import pathway_tpu as pw
+    from pathway_tpu.internals.metrics import MetricsServer
+    from pathway_tpu.observe import trace
+    from pathway_tpu.robust import inject
+    from pathway_tpu.serve import ServeScheduler
+
+    _enc, _ce, _ivf, pipe = serve_stack
+    trace.reset()
+    with ServeScheduler(pipe, window_us=1000, result_cache=None) as sched:
+        with inject.armed("rerank.dispatch", "raise"):
+            got = sched.serve(QUERIES)
+    assert got.degraded == ("rerank_skipped",)
+    server = MetricsServer(pw.G.engine_graph, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        doc = json.loads(
+            urllib.request.urlopen(f"{base}/traces", timeout=10).read()
+        )
+        limited = json.loads(
+            urllib.request.urlopen(f"{base}/traces?limit=1", timeout=10).read()
+        )
+        # exemplars are negotiated: classic scrape stays version=0.0.4
+        # with NO exemplar tokens; an OpenMetrics Accept header gets the
+        # exemplar-bearing exposition with its terminating # EOF
+        classic = urllib.request.urlopen(f"{base}/metrics", timeout=10)
+        assert "version=0.0.4" in classic.headers["Content-Type"]
+        assert " # {" not in classic.read().decode()
+        req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        om = urllib.request.urlopen(req, timeout=10)
+        assert "openmetrics-text" in om.headers["Content-Type"]
+        om_body = om.read().decode()
+        assert om_body.rstrip().endswith("# EOF")
+        assert " # {trace_id=" in om_body  # kept-trace exemplars render
+        _parse_exposition(om_body.replace("# EOF", ""))
+        # the REFERENCE OpenMetrics parser must accept the negotiated
+        # body whole (counter families without the _total suffix, # EOF,
+        # exemplars on buckets) — a strict scraper fails the entire
+        # scrape otherwise
+        om_parser = pytest.importorskip(
+            "prometheus_client.openmetrics.parser"
+        )
+        families = list(om_parser.text_string_to_metric_families(om_body))
+        assert families
+        assert any(
+            s.exemplar for f in families for s in f.samples
+        ), "no exemplar survived the reference OpenMetrics parser"
+    finally:
+        server.stop()
+    assert doc["enabled"] is True and doc["export_failed"] is False
+    riders = [t for t in doc["traces"] if t["kind"] == "request"]
+    assert riders and riders[0]["keep_reason"] == "degraded"
+    assert riders[0]["root"]["name"] == "serve.request"
+    assert riders[0]["root"]["children"], "rider tree has no spans"
+    assert len(limited["traces"]) == 1
+
+
+def test_concurrent_scrape_vs_serve_bit_identical(serve_stack):
+    """ISSUE 9 satellite: hammer /metrics + /serve_stats + /traces from
+    4 threads while the scheduler serves — every scrape parses with no
+    duplicate families, and the serve results are bit-identical to a
+    quiet serve of the same composition."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.metrics import MetricsServer
+    from pathway_tpu.serve import ServeScheduler
+
+    _enc, _ce, _ivf, pipe = serve_stack
+    reference = pipe(sorted(QUERIES))  # quiet serve, sorted composition
+    server = MetricsServer(pw.G.engine_graph, port=0).start()
+    stop = threading.Event()
+    scrape_errors: list = []
+
+    def scraper(path):
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            while not stop.is_set():
+                body = urllib.request.urlopen(
+                    f"{base}{path}", timeout=10
+                ).read().decode()
+                if path == "/metrics":
+                    samples, _types = _parse_exposition(body)
+                    seen = set()
+                    for name, labels, _v in samples:
+                        key = (name, labels)
+                        assert key not in seen, f"duplicate: {name}"
+                        seen.add(key)
+                else:
+                    json.loads(body)
+        except Exception as exc:  # surfaces in the main assert
+            scrape_errors.append(f"{path}: {exc!r}")
+
+    scrapers = [
+        threading.Thread(target=scraper, args=(p,))
+        for p in ("/metrics", "/metrics", "/serve_stats", "/traces")
+    ]
+    for t in scrapers:
+        t.start()
+    serve_errors: list = []
+    results: dict = {}
+    try:
+        with ServeScheduler(pipe, window_us=50_000, result_cache=None) as sched:
+            barrier = threading.Barrier(len(QUERIES))
+
+            def worker(q):
+                try:
+                    barrier.wait(timeout=10)
+                    rows = []
+                    for _ in range(3):
+                        rows.append(sched.serve([q])[0])
+                    results[q] = rows
+                except Exception as exc:
+                    serve_errors.append(repr(exc))
+
+            workers = [
+                threading.Thread(target=worker, args=(q,)) for q in QUERIES
+            ]
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join(timeout=120)
+    finally:
+        stop.set()
+        for t in scrapers:
+            t.join(timeout=30)
+        server.stop()
+    assert not serve_errors, serve_errors
+    assert not scrape_errors, scrape_errors
+    order = sorted(QUERIES)
+    for q in QUERIES:
+        want = reference[order.index(q)]
+        for rows in results[q]:
+            assert rows == want  # floats: bit-identical under scrape load
+
+
+def test_trace_chaos_sites_never_fail_the_scrape(serve_stack):
+    """trace.export armed: /traces degrades to a flagged empty payload,
+    never a 500."""
+    from pathway_tpu.observe import trace
+    from pathway_tpu.robust import inject
+
+    with inject.armed("trace.export", "raise"):
+        doc = trace.snapshot_traces()
+    assert doc["export_failed"] is True and doc["traces"] == []
+    doc = trace.snapshot_traces()
+    assert doc["export_failed"] is False
+
+
 def test_metrics_uptime_stamped_at_server_start():
     import pathway_tpu as pw
     from pathway_tpu.internals import metrics as m
@@ -504,6 +705,10 @@ _INSTRUMENTED = [
     "pathway_tpu/models/generator.py",
     "pathway_tpu/parallel/exchange.py",
     "pathway_tpu/internals/metrics.py",
+    # ISSUE 9: the tracing layer's propagation surface
+    "pathway_tpu/serve/scheduler.py",
+    "pathway_tpu/cache",
+    "pathway_tpu/parallel/shards.py",
 ]
 
 _BASELINE_SUPPRESSIONS = sorted(

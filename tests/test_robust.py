@@ -10,9 +10,12 @@ Sites covered: serve.dispatch, serve.fetch, ivf.dispatch,
 ivf.tail_upload, ivf.absorb, ivf.retrain, rerank.dispatch,
 cross_encoder.dispatch, cross_encoder.fetch, encoder.dispatch,
 generator.dispatch, generator.chat, clip.dispatch, exchange.send,
-qa.rerank, forward.absorb, forward.upload, forward.gather, and the
+qa.rerank, forward.absorb, forward.upload, forward.gather, the
 serve-cache pair cache.get / cache.put (ISSUE 8: a faulted or corrupt
-cache degrades to recompute — a MISS — never a failed or wrong serve).
+cache degrades to recompute — a MISS — never a failed or wrong serve),
+and the tracing pair trace.record / trace.export (ISSUE 9: a faulted
+tracing path degrades to dropped spans / a flagged-empty /traces
+payload — never a failed, wrong, or stalled serve).
 
 Plus: Deadline / RetryPolicy / CircuitBreaker / ServeResult units,
 ``PATHWAY_FAULTS`` parsing, the missing-doc response-metadata
@@ -645,6 +648,71 @@ def test_generator_kv_cache_chaos_never_changes_tokens():
         # lookup faulted: cold prefill, same tokens
         assert gen.generate([prompt], max_new_tokens=4) == clean
     assert gen.generate([prompt], max_new_tokens=4) == clean  # warm path
+
+
+# -- chaos: tracing path (ISSUE 9) -------------------------------------------
+
+
+def test_trace_record_chaos_triple_drops_spans_never_the_serve(stack):
+    """``trace.record`` armed raise, delay, and hang: every fault in the
+    tracing path degrades to DROPPED spans (counted on
+    ``pathway_trace_spans_dropped_total``) — the serve completes clean,
+    bit-identical, and is never stalled (the tracing layer fires the
+    site under an already-spent deadline, so even a 30 s hang releases
+    immediately)."""
+    from pathway_tpu.observe import trace
+    from pathway_tpu.serve import ServeScheduler
+
+    enc, ce, index = stack
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=5, candidates=16,
+    )
+    sched = ServeScheduler(pipe, window_us=0, result_cache=None)
+    try:
+        clean = sched.serve([QUERIES[0]])
+        assert observe.enabled() and trace.sample_rate() == 1.0
+        for mode, kwargs in (
+            ("raise", {}),
+            ("delay", {"delay_s": 5.0}),   # clamped to ~10 ms by the
+            ("hang", {"hang_s": 30.0}),    # spent-deadline fire
+        ):
+            dropped0 = trace.stats()["spans_dropped"]
+            t0 = time.monotonic()
+            with inject.armed("trace.record", mode, **kwargs):
+                got = sched.serve([QUERIES[0]])
+            elapsed = time.monotonic() - t0
+            assert got.degraded == (), mode
+            assert list(got) == list(clean), mode
+            assert trace.stats()["spans_dropped"] > dropped0, mode
+            # the serve was never stalled by its own observability: far
+            # below the armed 5 s delay / 30 s hang
+            assert elapsed < 3.0, (mode, elapsed)
+    finally:
+        sched.stop()
+
+
+def test_trace_export_chaos_triple_degrades_to_flagged_empty(stack):
+    """``trace.export`` armed raise/delay/hang: the /traces payload
+    degrades to a flagged empty document — never an exception, never a
+    hung scrape."""
+    from pathway_tpu.observe import trace
+
+    failures0 = observe.counter("pathway_trace_export_failures_total").value
+    for mode, kwargs in (
+        ("raise", {}),
+        ("delay", {"delay_s": 5.0}),
+        ("hang", {"hang_s": 30.0}),
+    ):
+        t0 = time.monotonic()
+        with inject.armed("trace.export", mode, **kwargs):
+            doc = trace.snapshot_traces()
+        assert doc["export_failed"] is True and doc["traces"] == [], mode
+        assert time.monotonic() - t0 < 3.0, mode
+    assert (
+        observe.counter("pathway_trace_export_failures_total").value
+        == failures0 + 3
+    )
+    assert trace.snapshot_traces()["export_failed"] is False  # recovered
 
 
 # -- chaos: exchange plane ---------------------------------------------------
