@@ -1478,6 +1478,217 @@ def phase_serve_cache(backend: str, extras: dict) -> float:
     return round(speedup, 3)
 
 
+def phase_continuous_decode(backend: str, extras: dict) -> float:
+    """Continuous token-level batching for generator decode (ISSUE 10,
+    pathway_tpu/serve/decode.py): aggregate tokens/s and p99
+    time-to-last-token at concurrency {1, 4, 16} for the slotted
+    continuous engine vs CALL-level batching (each request a solo
+    ``generate()`` — the KV-cache decode, the strongest per-call
+    baseline), over a mixed workload: short EOS-heavy requests (each
+    prompt's own early greedy token used as its EOS, so it genuinely
+    finishes at ~4 of its 32-token budget) + long answers, half the
+    prompts sharing a rerank-style prefix (the PrefixKVCache warms both
+    arms equally).  Outputs are token-identical across arms, so the
+    tokens/s ratio IS the wall-clock ratio.  Also reports average slot
+    occupancy per step chunk and the bounded compile census.  Phase
+    value: tokens/s speedup at concurrency 16 (acceptance: >= 2x)."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu.cache import PrefixKVCache
+    from pathway_tpu.models.generator import TextGenerator
+    from pathway_tpu.serve import ContinuousDecoder
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    kv = PrefixKVCache(block=16)
+    gen = TextGenerator(
+        dimension=256 if on_tpu else 64,
+        n_layers=4 if on_tpu else 2,
+        n_heads=4,
+        max_length=192,
+        vocab_size=4096,
+        kv_cache=kv,
+    )
+    shared = (
+        "rerank the following passages for the query about incremental "
+        "dataflow serving latency and freshness guarantees "
+    )
+    topics = [
+        "vector index maintenance", "stream joins", "exactly once",
+        "window aggregation", "kafka offsets", "snapshot replay",
+        "sharded state", "commit ticks", "mesh collectives",
+        "tokenizer ingest", "cross encoders", "packing rows",
+    ]
+    n_prompts = 16
+    prompts = [
+        (shared if i % 2 == 0 else "standalone question about ")
+        + topics[i % len(topics)]
+        + f" variant {i}"
+        for i in range(n_prompts)
+    ]
+    budget = 32
+    # EOS-heavy short half: each short prompt's own 4th greedy token is
+    # its EOS, so rerun with that EOS finishes honestly at ~4 tokens
+    eos_of: dict = {}
+    for i, p in enumerate(prompts):
+        out = gen.generate([p], max_new_tokens=budget)[0]
+        toks = [int(t.strip("<>")) for t in out.split()]
+        if i % 2 == 0 and len(toks) > 4:
+            eos_of[i] = toks[3]
+
+    def requests(n: int):
+        return [
+            (prompts[j % n_prompts], eos_of.get(j % n_prompts))
+            for j in range(n)
+        ]
+
+    def drive_call_level(conc: int, n_req: int):
+        lats: list = [None] * n_req
+        outs: list = [None] * n_req
+        reqs = requests(n_req)
+        barrier = threading.Barrier(conc)
+        errors: list = []
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    p, eos = reqs[i]
+                    t0 = time.perf_counter()
+                    outs[i] = gen.generate(
+                        [p], max_new_tokens=budget, eos_id=eos
+                    )[0]
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        t_all = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_all
+        if errors:
+            raise RuntimeError(f"call-level arm failed: {errors[:3]}")
+        return wall, lats, outs
+
+    def drive_continuous(conc: int, n_req: int, eng):
+        lats: list = [None] * n_req
+        outs: list = [None] * n_req
+        reqs = requests(n_req)
+        barrier = threading.Barrier(conc)
+        errors: list = []
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    p, eos = reqs[i]
+                    t0 = time.perf_counter()
+                    outs[i] = eng.submit(
+                        p, max_new_tokens=budget, eos_id=eos
+                    )()
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        t_all = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_all
+        if errors:
+            raise RuntimeError(f"continuous arm failed: {errors[:3]}")
+        return wall, lats, outs
+
+    def tokens_of(outs) -> int:
+        return sum(len(str(o).split()) for o in outs)
+
+    speedup_c16 = 0.0
+    # ONE engine for every concurrency level: slot count and chunk are
+    # compile-shape dimensions, so reusing the pool keeps the step loop
+    # at one compiled program across the whole phase
+    eng = ContinuousDecoder(
+        # kv_width: the workload is known-short (prompt+budget <= 64
+        # tokens), so the pool attends 96 wide instead of max_len=192 —
+        # tokens are width-invariant, step cost is not
+        gen, slots=16, step_bucket=32, name="bench-decode", kv_width=96,
+    )
+    try:
+        # warm BOTH arms' compile shapes (and the prefix cache) off the
+        # clock: every prompt at its measured eos/budget, both paths —
+        # then two concurrent warm drives so the BATCHED join-prefill
+        # shapes (cohort buckets) compile before anything is timed
+        for p, eos in requests(n_prompts):
+            gen.generate([p], max_new_tokens=budget, eos_id=eos)
+            eng.submit(p, max_new_tokens=budget, eos_id=eos)()
+        for _ in range(2):
+            drive_continuous(16, 64, eng)
+        for conc in (1, 4, 16):
+            n_req = conc * (8 if conc >= 16 else 4)
+            # the headline c16 cell takes the best of three rounds PER ARM
+            # (both arms equally): the engine's single loop thread is
+            # sensitive to scheduler noise on a shared CPU host, and one
+            # descheduled quantum should not masquerade as throughput
+            rounds = 3 if conc >= 16 else 1
+            w_call, l_call, o_call = drive_call_level(conc, n_req)
+            for _ in range(rounds - 1):
+                w2, l2, o2 = drive_call_level(conc, n_req)
+                if w2 < w_call:
+                    w_call, l_call, o_call = w2, l2, o2
+            chunks0 = eng.pool_stats["chunks"]
+            occ0 = eng.pool_stats["occupancy_sum"]
+            fin0 = eng.pool_stats["finished"]
+            w_cont, l_cont, o_cont = drive_continuous(conc, n_req, eng)
+            for _ in range(rounds - 1):
+                w2, l2, o2 = drive_continuous(conc, n_req, eng)
+                if w2 < w_cont:
+                    w_cont, l_cont, o_cont = w2, l2, o2
+            # token identity across arms — the speedup is not bought
+            # with different (or truncated) outputs
+            assert [str(o) for o in o_call] == [str(o) for o in o_cont]
+            tok = tokens_of(o_cont)
+            tps_call = tok / max(w_call, 1e-9)
+            tps_cont = tok / max(w_cont, 1e-9)
+            extras[f"decode_tokens_per_s_call_c{conc}"] = round(tps_call, 1)
+            extras[f"decode_tokens_per_s_cont_c{conc}"] = round(tps_cont, 1)
+            extras[f"decode_p99_ttlt_call_c{conc}_ms"] = round(
+                float(np.percentile(np.asarray(l_call), 99)), 2
+            )
+            extras[f"decode_p99_ttlt_cont_c{conc}_ms"] = round(
+                float(np.percentile(np.asarray(l_cont), 99)), 2
+            )
+            if conc == 16:
+                speedup_c16 = tps_cont / max(tps_call, 1e-9)
+                chunks = eng.pool_stats["chunks"] - chunks0
+                occ = eng.pool_stats["occupancy_sum"] - occ0
+                extras["decode_slot_occupancy_avg_c16"] = round(
+                    occ / max(chunks, 1), 2
+                )
+                extras["decode_requests_finished_c16"] = (
+                    eng.pool_stats["finished"] - fin0
+                )
+    finally:
+        eng.stop()
+    extras["decode_compile_signatures"] = gen._tripwire.signatures
+    extras["decode_prefill_reused_fraction"] = round(
+        kv.stats_tokens["reused"]
+        / max(kv.stats_tokens["reused"] + kv.stats_tokens["computed"], 1),
+        3,
+    )
+    extras["continuous_decode_speedup_c16"] = round(speedup_c16, 3)
+    extras["continuous_decode_speedup_ok"] = bool(speedup_c16 >= 2.0)
+    return round(speedup_c16, 3)
+
+
 def phase_ingest(backend: str, extras: dict) -> float:
     """Streaming embed+index ingest rate on a REALISTIC variable-length
     corpus: docs/sec end to end with LENGTH-BUCKETED batching, and MFU
@@ -2090,6 +2301,7 @@ _PHASES = {
     "concurrent_serve": (phase_concurrent_serve, 600),
     "sharded_serve": (phase_sharded_serve, 600),
     "serve_cache": (phase_serve_cache, 450),
+    "continuous_decode": (phase_continuous_decode, 450),
     "ingest": (phase_ingest, 900),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
@@ -2248,6 +2460,7 @@ def main() -> None:
         ("concurrent_serve", lambda: device_phase("concurrent_serve")),
         ("sharded_serve", lambda: device_phase("sharded_serve")),
         ("serve_cache", lambda: device_phase("serve_cache")),
+        ("continuous_decode", lambda: device_phase("continuous_decode")),
         ("ingest", lambda: device_phase("ingest")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)
@@ -2277,6 +2490,8 @@ def main() -> None:
             extras["serve_coalesce_speedup_c16"] = round(value, 3)
         elif name == "sharded_serve" and value is not None:
             extras["sharded_merge_share_pct"] = round(value, 2)
+        elif name == "continuous_decode" and value is not None:
+            extras["continuous_decode_speedup_c16"] = round(value, 3)
         elif name == "ingest" and value is not None:
             extras["ingest_docs_per_sec"] = round(value, 1)
         elif name == "wordcount" and value is not None:

@@ -80,6 +80,20 @@ class PrefixKVCache:
             n_blocks -= 1
         return n_blocks
 
+    def bucket_tokens(self, n_matched: int) -> int:
+        """Round a matched-prefix token count DOWN to a power-of-two
+        block multiple.  The prefix split is a compile-shape dimension
+        in every decode path (the batch KV decode's ``P`` and the
+        continuous engine's per-join prefill) — bucketing keeps it at
+        O(log) distinct values, so a mix of prompt families cannot
+        compile one program per prefix length."""
+        bucket = 0
+        step = self.block
+        while step <= int(n_matched):
+            bucket = step
+            step *= 2
+        return bucket
+
     def match(
         self, ids_row: np.ndarray, n_real: int, deadline=None
     ) -> Tuple[int, List[Any], List[bytes]]:

@@ -82,51 +82,70 @@ def render_metrics(
         f"pathway_operators {len(operators)}",
         "# TYPE pathway_resident_rows gauge",
         f"pathway_resident_rows {total_rows}",
-        "# TYPE pathway_operator_rows_in_total counter",
-        "# TYPE pathway_operator_rows_out_total counter",
-        "# TYPE pathway_operator_process_seconds_total counter",
-        "# TYPE pathway_operator_last_tick_seconds gauge",
     ]
+    # every family's samples stay CONTIGUOUS under its TYPE line: a
+    # strict OpenMetrics parser treats a family's sample appearing after
+    # another family opened as a clashing duplicate and fails the whole
+    # scrape (this bit in practice whenever a GC-lingering connector
+    # monitor put samples under the old interleaved block layout)
+    op_in: list = []
+    op_out: list = []
+    op_proc: list = []
+    op_tick: list = []
     for name, op_id, rows_in, rows_out, process_ns, last_tick_ns in op_stats:
         label = f'operator="{name}",id="{op_id}"'
-        lines.append(f"pathway_operator_rows_in_total{{{label}}} {rows_in}")
-        lines.append(f"pathway_operator_rows_out_total{{{label}}} {rows_out}")
-        lines.append(
+        op_in.append(f"pathway_operator_rows_in_total{{{label}}} {rows_in}")
+        op_out.append(f"pathway_operator_rows_out_total{{{label}}} {rows_out}")
+        op_proc.append(
             f"pathway_operator_process_seconds_total{{{label}}} "
             f"{process_ns / 1e9:.6f}"
         )
-        lines.append(
+        op_tick.append(
             f"pathway_operator_last_tick_seconds{{{label}}} "
             f"{last_tick_ns / 1e9:.6f}"
         )
+    lines.append("# TYPE pathway_operator_rows_in_total counter")
+    lines.extend(op_in)
+    lines.append("# TYPE pathway_operator_rows_out_total counter")
+    lines.extend(op_out)
+    lines.append("# TYPE pathway_operator_process_seconds_total counter")
+    lines.extend(op_proc)
+    lines.append("# TYPE pathway_operator_last_tick_seconds gauge")
+    lines.extend(op_tick)
     # per-connector ingestion/lag stats (reference: ConnectorMonitor,
     # src/connectors/monitoring.rs:237 scraped by http_server.rs)
     from ..io._offsets import connector_monitors
 
-    lines.append("# TYPE pathway_connector_rows_total counter")
-    lines.append("# TYPE pathway_connector_lag_seconds gauge")
-    lines.append("# TYPE pathway_connector_partitions gauge")
+    conn_rows: list = []
+    conn_lag: list = []
+    conn_parts: list = []
     for mon in connector_monitors():
         stats = mon.stats()
         # id uniquifies the series: two sources may share a display name, and
         # duplicate label sets would fail the whole Prometheus scrape
         label = f'connector="{_sanitize(stats["name"])}",id="{mon.id}"'
-        lines.append(
+        conn_rows.append(
             f"pathway_connector_rows_total{{{label},kind=\"insert\"}} "
             f"{stats['rows_inserted']}"
         )
-        lines.append(
+        conn_rows.append(
             f"pathway_connector_rows_total{{{label},kind=\"delete\"}} "
             f"{stats['rows_deleted']}"
         )
         if stats["lag_seconds"] is not None:
-            lines.append(
+            conn_lag.append(
                 f"pathway_connector_lag_seconds{{{label}}} "
                 f"{stats['lag_seconds']:.3f}"
             )
-        lines.append(
+        conn_parts.append(
             f"pathway_connector_partitions{{{label}}} {stats['partitions']}"
         )
+    lines.append("# TYPE pathway_connector_rows_total counter")
+    lines.extend(conn_rows)
+    lines.append("# TYPE pathway_connector_lag_seconds gauge")
+    lines.extend(conn_lag)
+    lines.append("# TYPE pathway_connector_partitions gauge")
+    lines.extend(conn_parts)
     # serve-path flight recorder (pathway_tpu/observe): stage histograms,
     # IVF/recompile/exchange series — the same scrape covers engine,
     # connectors, and the ML hot path

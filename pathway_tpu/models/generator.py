@@ -48,16 +48,45 @@ from ._params import unbox as _unbox
 from .tokenizer import HashTokenizer
 from .transformer import (
     KVTransformerDecoder,
+    SlotKVDecoder,
     TransformerConfig,
     TransformerEncoder,
     resolve_heads,
 )
 
-__all__ = ["TextGenerator"]
+__all__ = ["TextGenerator", "decode_step_bucket", "eos_id_from_env"]
 
 # flight recorder: submit→ready latency of a full decode (dispatch
 # through host fetch) + batch occupancy per dispatch
 _H_READY = observe.histogram("pathway_serve_model_seconds", model="generator")
+
+# sentinel: "use the instance default" for per-call eos_id overrides
+_UNSET = object()
+
+
+def decode_step_bucket() -> int:
+    """Decode-step chunk size from ``PATHWAY_DECODE_STEP_BUCKET``
+    (default 8): how many single-token decode steps one compiled chunk
+    dispatch advances.  Shared by the legacy EOS-chunked decode and the
+    continuous engine (serve/decode.py) — ONE knob, one compile shape."""
+    try:
+        c = int(os.environ.get("PATHWAY_DECODE_STEP_BUCKET", "8") or 8)
+    except ValueError:
+        c = 8
+    return max(1, c)
+
+
+def eos_id_from_env() -> Optional[int]:
+    """``PATHWAY_GENERATOR_EOS`` (a token id, e.g. 2 for the tokenizer's
+    SEP) — unset/empty means no EOS handling, byte-for-byte the
+    pre-EOS decode behavior."""
+    raw = os.environ.get("PATHWAY_GENERATOR_EOS", "").strip()
+    if not raw or raw in ("0", "none", "off"):
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 class TextGenerator:
@@ -73,6 +102,7 @@ class TextGenerator:
         checkpoint_path: Optional[str] = None,
         dtype=jnp.bfloat16,
         kv_cache: Any = "env",
+        eos_id: Any = "env",
     ):
         self.config = TransformerConfig(
             vocab_size=vocab_size,
@@ -88,6 +118,21 @@ class TextGenerator:
         self.tokenizer = HashTokenizer(vocab_size=vocab_size, max_length=max_length)
         self.module = TransformerEncoder(self.config)
         self._kv_module = KVTransformerDecoder(self.config)
+        self._slot_module = SlotKVDecoder(self.config)
+        # EOS handling: a row that emits this token is FINISHED — further
+        # sampling work is masked to PAD and the legacy decode returns as
+        # soon as every row has finished (chunked dispatch).  None (the
+        # env default when PATHWAY_GENERATOR_EOS is unset) preserves the
+        # single-dispatch always-`steps` decode exactly.
+        if eos_id == "env":
+            eos_id = eos_id_from_env()
+        if eos_id is not None and int(eos_id) == self.tokenizer.PAD:
+            raise ValueError("eos_id must differ from the PAD token id")
+        self.eos_id = None if eos_id is None else int(eos_id)
+        # decode steps actually executed by the last generate() call —
+        # the EOS early-exit regression hook (a batch of short answers
+        # must not pay the full `steps` budget)
+        self.last_decode_steps = 0
         self._lock = threading.Lock()
         self._fns: Dict[tuple, Any] = {}
         # recompile tripwire (ops/recompile_guard.py): decode shapes are
@@ -115,17 +160,29 @@ class TextGenerator:
 
     # -- legacy full re-attend decode (parity reference / fallback) ----------
     def _decode_fn(self, B: int, L: int, steps: int):
+        """Compiled decode CHUNK of ``steps`` single-token iterations:
+        ``(params, ids, mask, pos, temperature, rng, finished, eos) ->
+        (tokens [B, steps], ids, mask, pos, rng, finished)``.  The carry
+        is explicit so ``generate`` can thread it across chunk dispatches
+        and return as soon as every row has finished; with EOS disabled
+        (``eos = -1``) one chunk of the full budget reproduces the
+        original single-dispatch decode token-for-token.  Per-row
+        ``finished`` masks every write/advance (the row is bit-frozen)
+        and an all-finished batch skips the forward pass entirely via
+        ``lax.cond`` — post-EOS sampling work is zeroed, not just
+        discarded."""
         key = (B, L, steps)
         fn = self._fns.get(key)
         if fn is None:
             self._tripwire.observe(key)
             module = self.module
+            PAD = self.tokenizer.PAD
 
-            def decode(params, ids, mask, temperature, rng):
+            def decode(params, ids, mask, pos, temperature, rng, finished, eos):
                 emb = params["tok_embed"]["embedding"]
 
-                def step(carry, _):
-                    ids_c, mask_c, pos, rng_c = carry
+                def live(carry):
+                    ids_c, mask_c, pos, rng_c, fin = carry
                     hidden = module.apply({"params": params}, ids_c, mask_c)
                     logits = jnp.einsum(
                         "bld,vd->blv", hidden.astype(jnp.float32), emb.astype(jnp.float32)
@@ -138,19 +195,36 @@ class TextGenerator:
                     greedy = jnp.argmax(last, axis=-1)
                     sampled = jax.random.categorical(sub, last / jnp.maximum(temperature, 1e-4))
                     nxt = jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+                    nxt = jnp.where(fin, PAD, nxt)
                     ids_c = jnp.take_along_axis(
                         ids_c, jnp.arange(ids_c.shape[1])[None, :], axis=1
                     )
-                    ids_c = jax.vmap(lambda row, p, t: row.at[p].set(t))(
+                    ids_w = jax.vmap(lambda row, p, t: row.at[p].set(t))(
                         ids_c, pos, nxt
                     )
-                    mask_c = jax.vmap(lambda row, p: row.at[p].set(1))(mask_c, pos)
-                    return (ids_c, mask_c, pos + 1, rng_c), nxt
+                    mask_w = jax.vmap(lambda row, p: row.at[p].set(1))(mask_c, pos)
+                    # finished rows are frozen: no ids/mask write, no
+                    # position advance — their history stays exactly the
+                    # prefix that ended in EOS.  The row emitting EOS
+                    # THIS step still writes and advances (the original
+                    # unconditional behavior), then freezes.
+                    keep = fin[:, None]
+                    ids_c = jnp.where(keep, ids_c, ids_w)
+                    mask_c = jnp.where(keep, mask_c, mask_w)
+                    pos = jnp.where(fin, pos, pos + 1)
+                    fin = fin | (nxt == eos)
+                    return (ids_c, mask_c, pos, rng_c, fin), nxt
 
-                (ids_f, _, _, _), toks = jax.lax.scan(
-                    step, (ids, mask, jnp.sum(mask, axis=1), rng), None, length=steps
+                def dead(carry):
+                    return carry, jnp.full((B,), PAD, jnp.int32)
+
+                def step(carry, _):
+                    return jax.lax.cond(jnp.all(carry[4]), dead, live, carry)
+
+                (ids_f, mask_f, pos_f, rng_f, fin_f), toks = jax.lax.scan(
+                    step, (ids, mask, pos, rng, finished), None, length=steps
                 )
-                return toks.T  # [B, steps]
+                return toks.T, ids_f, mask_f, pos_f, rng_f, fin_f
 
             fn = jax.jit(decode)
             self._fns[key] = fn
@@ -174,11 +248,15 @@ class TextGenerator:
         self._tripwire.observe(key)
         cfg = self.config
         decoder = self._kv_module
+        PAD = self.tokenizer.PAD
         H = cfg.n_heads
         hd = cfg.d_model // H
         T = P + L_sfx + steps
 
-        def run(params, suffix_ids, n_lens, prefix_k, prefix_v, temperature, rng):
+        def run(
+            params, suffix_ids, n_lens, prefix_k, prefix_v, temperature,
+            rng, eos, fin0,
+        ):
             emb = params["tok_embed"]["embedding"]
             kbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
             vbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
@@ -212,28 +290,57 @@ class TextGenerator:
             )[:, 0, :]
 
             def step(carry, _):
-                kbuf_c, vbuf_c, last, pos, rng_c = carry
-                rng_c, sub = jax.random.split(rng_c)
+                kbuf_c, vbuf_c, last, pos, rng_c, fin = carry
                 greedy = jnp.argmax(last, axis=-1)
-                sampled = jax.random.categorical(
-                    sub, last / jnp.maximum(temperature, 1e-4)
+
+                def sample(rng_c):
+                    rng2, sub = jax.random.split(rng_c)
+                    return rng2, jax.random.categorical(
+                        sub, last / jnp.maximum(temperature, 1e-4)
+                    )
+
+                def greedy_only(rng_c):
+                    # temperature 0: the B×V gumbel draw would be
+                    # discarded by the where below — skip it
+                    return rng_c, greedy
+
+                rng_c, sampled = jax.lax.cond(
+                    temperature <= 0.0, greedy_only, sample, rng_c
                 )
                 nxt = jnp.where(temperature <= 0.0, greedy, sampled).astype(
                     jnp.int32
                 )
-                h1, kbuf_c, vbuf_c = decoder.apply(
-                    {"params": params}, nxt[:, None], pos[:, None],
-                    kbuf_c, vbuf_c, pos, pos[:, None],
-                )
-                logits1 = jnp.einsum(
-                    "bld,vd->blv",
-                    h1.astype(jnp.float32),
-                    emb.astype(jnp.float32),
-                )[:, 0, :]
-                return (kbuf_c, vbuf_c, logits1, pos + 1, rng_c), nxt
+                # per-row finished mask: a row that emitted EOS samples
+                # PAD from here on; once EVERY row is done the forward
+                # pass is skipped outright (lax.cond) — further work is
+                # zeroed inside the single decode dispatch
+                nxt = jnp.where(fin, PAD, nxt)
+                fin_next = fin | (nxt == eos)
 
-            (kbuf, vbuf, _, _, _), toks = jax.lax.scan(
-                step, (kbuf, vbuf, last0, n_lens, rng), None, length=steps
+                def fwd(args):
+                    kbuf_c, vbuf_c, nxt, pos = args
+                    h1, kbuf_n, vbuf_n = decoder.apply(
+                        {"params": params}, nxt[:, None], pos[:, None],
+                        kbuf_c, vbuf_c, pos, pos[:, None],
+                    )
+                    return kbuf_n, vbuf_n, jnp.einsum(
+                        "bld,vd->blv",
+                        h1.astype(jnp.float32),
+                        emb.astype(jnp.float32),
+                    )[:, 0, :]
+
+                def skip(args):
+                    kbuf_c, vbuf_c, _nxt, _pos = args
+                    return kbuf_c, vbuf_c, last
+
+                kbuf_c, vbuf_c, logits1 = jax.lax.cond(
+                    jnp.all(fin_next), skip, fwd, (kbuf_c, vbuf_c, nxt, pos)
+                )
+                pos = jnp.where(fin, pos, pos + 1)
+                return (kbuf_c, vbuf_c, logits1, pos, rng_c, fin_next), nxt
+
+            (kbuf, vbuf, _, _, _, _), toks = jax.lax.scan(
+                step, (kbuf, vbuf, last0, n_lens, rng, fin0), None, length=steps
             )
             return toks.T, kbuf, vbuf  # toks [B, steps]
 
@@ -246,22 +353,180 @@ class TextGenerator:
         block chain, batched at the row MINIMUM (the static split point
         every row shares — the RAG shape is many prompts over one
         system+chunks prefix, where the minimum IS the shared prefix),
-        then rounded DOWN to a power-of-two block multiple so the split
-        point (a compile-shape dimension) takes O(log) values instead of
-        one per distinct prefix length — a mix of prompt families must
-        not compile one decode program each.  Returns ``(P, matches)``;
+        then rounded DOWN to a power-of-two block multiple
+        (``PrefixKVCache.bucket_tokens``) so the split point (a
+        compile-shape dimension) takes O(log) values instead of one per
+        distinct prefix length — a mix of prompt families must not
+        compile one decode program each.  Returns ``(P, matches)``;
         pure host + cache work, no dispatch."""
         matches = [
             self.kv_cache.match(ids[i], int(n_lens[i])) for i in range(n)
         ]
         P = min((m[0] for m in matches), default=0)
-        blk = self.kv_cache.block
-        bucket = 0
-        step = blk
-        while step <= P:
-            bucket = step
-            step *= 2
-        return bucket, matches
+        return self.kv_cache.bucket_tokens(P), matches
+
+    # -- continuous-decode slot pool (serve/decode.py) -----------------------
+    def _slot_prefill_fn(self, S: int, T: int, B: int, L_sfx: int, P: int):
+        """Compiled JOIN batch for ``B`` slots of a ``[S, L, H, T, d]``
+        K/V pool: ``(params, pool_k, pool_v, slots [B], suffix_ids
+        [B, L_sfx], n_len [B], prefix_k, prefix_v, rngs [B, 2],
+        temps [B]) -> (pool_k, pool_v, first_tokens [B], rngs')``.
+        Prefills each row's prompt suffix (cached prefix blocks land at
+        positions [0, P)) into fresh width-``T`` buffers, samples each
+        row's FIRST generated token from its last real prompt position —
+        per-row rng chains, consuming each request's first split, the
+        same chain position the solo decode uses — and scatters every
+        row into the pool at its slot, wiping the previous occupants.
+        Joins arriving together batch into ONE dispatch (``B`` bucketed
+        to powers of two; pad rows scatter to an out-of-bounds slot
+        index and are dropped).  ``T`` is the POOL width:
+        masked attention is width-invariant (extra key slots carry
+        exact-zero probability), which is what keeps a pooled decode
+        bit-identical to a solo one whose buffer is exactly
+        prompt+steps wide."""
+        key = ("slot_prefill", S, T, B, L_sfx, P)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+        cfg = self.config
+        decoder = self._kv_module
+        H = cfg.n_heads
+        hd = cfg.d_model // H
+
+        def prefill(
+            params, pool_k, pool_v, slots, suffix_ids, n_len,
+            prefix_k, prefix_v, rngs, temps,
+        ):
+            emb = params["tok_embed"]["embedding"]
+            kbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
+            vbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
+            if P:
+                kbuf = jax.lax.dynamic_update_slice(
+                    kbuf, prefix_k.astype(cfg.dtype), (0, 0, 0, 0, 0)
+                )
+                vbuf = jax.lax.dynamic_update_slice(
+                    vbuf, prefix_v.astype(cfg.dtype), (0, 0, 0, 0, 0)
+                )
+            positions = jnp.broadcast_to(
+                (P + jnp.arange(L_sfx, dtype=jnp.int32))[None, :], (B, L_sfx)
+            )
+            write_pos = jnp.full((B,), P, jnp.int32)
+            hidden, kbuf, vbuf = decoder.apply(
+                {"params": params}, suffix_ids, positions, kbuf, vbuf,
+                write_pos, positions,
+            )
+            logits = jnp.einsum(
+                "bld,vd->blv", hidden.astype(jnp.float32), emb.astype(jnp.float32)
+            )
+            last0 = jnp.take_along_axis(
+                logits,
+                jnp.maximum(n_len - 1 - P, 0)[:, None, None],
+                axis=1,
+            )[:, 0, :]
+            greedy = jnp.argmax(last0, axis=-1)
+
+            def sample(rngs):
+                pairs = jax.vmap(jax.random.split)(rngs)
+                drawn = jax.vmap(jax.random.categorical)(
+                    pairs[:, 1], last0 / jnp.maximum(temps, 1e-4)[:, None]
+                )
+                return pairs[:, 0], jnp.where(temps <= 0.0, greedy, drawn)
+
+            def greedy_only(rngs):
+                return rngs, greedy
+
+            rngs, toks = jax.lax.cond(
+                jnp.all(temps <= 0.0), greedy_only, sample, rngs
+            )
+            # ONE scatter per buffer: row i lands at pool slot
+            # ``slots[i]``; pad rows carry an out-of-bounds index and
+            # are DROPPED by the scatter (jax's default out-of-bounds
+            # scatter semantics), so padding can never clobber a slot
+            pool_k = pool_k.at[slots].set(kbuf)
+            pool_v = pool_v.at[slots].set(vbuf)
+            return pool_k, pool_v, toks.astype(jnp.int32), rngs
+
+        fn = jax.jit(prefill)
+        self._fns[key] = fn
+        return fn
+
+    def _slot_step_fn(self, S: int, T: int, chunk: int):
+        """Compiled decode-step CHUNK over the whole slot pool:
+        ``(params, pool_k, pool_v, tok [S], pos [S], active [S],
+        left [S], rngs [S, 2], temps [S], eos [S]) -> (pool_k, pool_v,
+        rngs, emitted [chunk, S])``.  Each of the ``chunk`` scan
+        iterations forwards every slot's current token one position
+        (``SlotKVDecoder`` — inactive slots' K/V bit-frozen), samples
+        the next token PER SLOT with that slot's own rng chain (the solo
+        chain: requests are batch-composition-independent), emits ``-1``
+        for inactive lanes, and retires lanes that emit their EOS or
+        exhaust their budget.  ONE compile signature per engine — the
+        shapes are (S, T, chunk), all static per pool."""
+        key = ("slot_step", S, T, chunk)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+        decoder = self._slot_module
+
+        def run(params, pool_k, pool_v, tok, pos, active, left, rngs, temps, eos):
+            emb = params["tok_embed"]["embedding"]
+
+            def one(carry, _):
+                pool_k, pool_v, tok, pos, act, left, rngs = carry
+                live = act & (left > 0)
+                h, pool_k, pool_v = decoder.apply(
+                    {"params": params}, tok[:, None], pos[:, None],
+                    pool_k, pool_v, pos, pos[:, None], live,
+                )
+                logits = jnp.einsum(
+                    "bld,vd->blv", h.astype(jnp.float32), emb.astype(jnp.float32)
+                )[:, 0, :]
+                greedy = jnp.argmax(logits, axis=-1)
+
+                def sample(rngs):
+                    # sampling lanes: one split per step per lane (the
+                    # solo chain), per-lane categorical over [V]
+                    pairs = jax.vmap(jax.random.split)(rngs)
+                    subs = pairs[:, 1]
+                    drawn = jax.vmap(jax.random.categorical)(
+                        subs, logits / jnp.maximum(temps, 1e-4)[:, None]
+                    )
+                    return pairs[:, 0], jnp.where(
+                        temps <= 0.0, greedy, drawn
+                    )
+
+                def greedy_only(rngs):
+                    # all-greedy pool: tokens are rng-independent, so
+                    # the S×V gumbel draw (the dominant per-step cost at
+                    # small models) is skipped outright
+                    return rngs, greedy
+
+                rngs2, nxt = jax.lax.cond(
+                    jnp.all(temps <= 0.0), greedy_only, sample, rngs
+                )
+                nxt = nxt.astype(jnp.int32)
+                emitted = jnp.where(live, nxt, -1)
+                act2 = live & (nxt != eos)
+                pos2 = jnp.where(live, pos + 1, pos)
+                left2 = jnp.where(live, left - 1, left)
+                tok2 = jnp.where(live, nxt, tok)
+                # rng chains advance only for live lanes: a finished
+                # lane's chain state is frozen where the solo decode's
+                # chain was when it emitted that request's last token
+                rngs3 = jnp.where(live[:, None], rngs2, rngs)
+                return (pool_k, pool_v, tok2, pos2, act2, left2, rngs3), emitted
+
+            (pool_k, pool_v, _, _, _, _, rngs), em = jax.lax.scan(
+                one, (pool_k, pool_v, tok, pos, active, left, rngs),
+                None, length=chunk,
+            )
+            return pool_k, pool_v, rngs, em
+
+        fn = jax.jit(run)
+        self._fns[key] = fn
+        return fn
 
     def _generate_kv(
         self,
@@ -269,6 +534,7 @@ class TextGenerator:
         max_new_tokens: int,
         temperature: float,
         seed: int,
+        eos: Optional[int] = None,
     ) -> List[str]:
         cfg = self.config
         n = len(prompts)
@@ -327,8 +593,15 @@ class TextGenerator:
             prefix_v,
             jnp.float32(temperature),
             jax.random.PRNGKey(seed),
+            jnp.int32(-1 if eos is None else eos),
+            # padding rows start finished (output discarded) so the
+            # in-scan all-finished compute skip can fire on real batches
+            jnp.asarray(np.arange(b) >= n)
+            if eos is not None
+            else jnp.zeros((b,), bool),
         )
         toks = np.asarray(toks)[:n]
+        self.last_decode_steps = max_new_tokens
         _H_READY.observe_ns(time.perf_counter_ns() - t0)
         # capture: admit the prompt's uncached full blocks as async
         # device slices of the returned buffers (prompt region only —
@@ -349,11 +622,16 @@ class TextGenerator:
                 self.kv_cache.note_prefill(
                     reused=P, computed=int(n_lens[i]) - P
                 )
-        # hashing tokenizer is not invertible; render token ids
-        return [
-            " ".join(f"<{int(t)}>" for t in row if t != self.tokenizer.PAD)
-            for row in toks
-        ]
+        return [self.render_tokens(row) for row in toks]
+
+    def render_tokens(self, row: Sequence[int]) -> str:
+        """Canonical token-id rendering (the hashing tokenizer is not
+        invertible) — shared by every decode path, including the
+        continuous engine (serve/decode.py), so per-request token
+        identity is comparable as plain strings."""
+        return " ".join(
+            f"<{int(t)}>" for t in row if int(t) != self.tokenizer.PAD
+        )
 
     def generate(
         self,
@@ -362,16 +640,26 @@ class TextGenerator:
         temperature: float = 0.0,
         seed: int = 0,
         use_kv: Optional[bool] = None,
+        eos_id: Any = _UNSET,
     ) -> List[str]:
-        """Generate ``max_new_tokens`` per prompt.  ``use_kv`` overrides
-        the decode path (None = the ``PATHWAY_GENERATOR_KV`` default):
-        the KV path and the legacy full re-attend emit identical tokens
-        — the legacy path survives as the parity oracle and fallback."""
+        """Generate up to ``max_new_tokens`` per prompt.  ``use_kv``
+        overrides the decode path (None = the ``PATHWAY_GENERATOR_KV``
+        default): the KV path and the legacy full re-attend emit
+        identical tokens — the legacy path survives as the parity oracle
+        and fallback.  ``eos_id`` (default: the instance's
+        ``PATHWAY_GENERATOR_EOS`` setting) marks rows finished when they
+        emit it: post-EOS sampling is masked to PAD on both paths, and
+        the legacy path runs its decode in ``PATHWAY_DECODE_STEP_BUCKET``
+        chunks so the call RETURNS as soon as every row has finished
+        instead of paying the full ``steps`` budget."""
         if not prompts:
             return []
+        eos = self.eos_id if eos_id is _UNSET else eos_id
+        if eos is not None and int(eos) == self.tokenizer.PAD:
+            raise ValueError("eos_id must differ from the PAD token id")
         if use_kv if use_kv is not None else self._use_kv:
             return self._generate_kv(
-                prompts, max_new_tokens, temperature, seed
+                prompts, max_new_tokens, temperature, seed, eos=eos
             )
         with self._lock:
             n = len(prompts)
@@ -384,29 +672,63 @@ class TextGenerator:
             pad = np.zeros((ids.shape[0], max_new_tokens), np.int32)
             ids = np.concatenate([ids, pad], axis=1)
             mask_full = np.concatenate([mask, pad], axis=1)
-            fn = self._decode_fn(ids.shape[0], ids.shape[1], max_new_tokens)
+            # without EOS the whole budget is ONE chunk (the original
+            # single-dispatch decode, unchanged); with EOS the budget is
+            # split into step-bucket chunks so the host can stop as soon
+            # as the finished mask covers every row
+            chunk = (
+                max_new_tokens if eos is None
+                else min(max_new_tokens, decode_step_bucket())
+            )
         # dispatch + fetch OFF the lock (lock-discipline: holding it across
         # the decode round trip serialized concurrent generates for the
         # full device latency); the lock only guards tokenization and the
         # compiled-fn cache
         t0 = time.perf_counter_ns()
         observe.record_occupancy("generator", n, b)
-        toks = retry_call(
-            "generator.dispatch",
-            fn,
-            self.params,
-            jnp.asarray(ids),
-            jnp.asarray(mask_full),
-            jnp.float32(temperature),
-            jax.random.PRNGKey(seed),
-        )
-        toks = np.asarray(toks)[:n]
+        ids_d = jnp.asarray(ids)
+        mask_d = jnp.asarray(mask_full)
+        pos_d = jnp.asarray(mask.sum(axis=1).astype(np.int32))
+        rng = jax.random.PRNGKey(seed)
+        # bucket-padding rows start FINISHED: their output is discarded,
+        # and leaving them live would keep the all-finished early exit
+        # from ever firing on a real EOS-heavy batch
+        fin = jnp.asarray(np.arange(ids.shape[0]) >= n) if eos is not None \
+            else jnp.zeros((ids.shape[0],), bool)
+        eos_t = jnp.int32(-1 if eos is None else eos)
+        temp_t = jnp.float32(temperature)
+        out_chunks: List[np.ndarray] = []
+        steps_run = 0
+        while steps_run < max_new_tokens:
+            # the tail chunk is sized EXACTLY to the remaining budget
+            # (one extra compile signature per distinct remainder, both
+            # bounded by the step bucket) — the decode never runs, nor
+            # reports, more steps than max_new_tokens
+            c = min(chunk, max_new_tokens - steps_run)
+            with self._lock:
+                fn = self._decode_fn(ids.shape[0], ids.shape[1], c)
+            toks_c, ids_d, mask_d, pos_d, rng, fin = retry_call(
+                "generator.dispatch",
+                fn,
+                self.params,
+                ids_d,
+                mask_d,
+                pos_d,
+                temp_t,
+                rng,
+                fin,
+                eos_t,
+            )
+            out_chunks.append(np.asarray(toks_c))
+            steps_run += c
+            # EOS early-exit: every row finished — the remaining budget
+            # would be all-PAD no-op iterations, so return now
+            if eos is not None and bool(np.asarray(fin).all()):
+                break
+        self.last_decode_steps = steps_run
+        toks = np.concatenate(out_chunks, axis=1)[:n, :max_new_tokens]
         _H_READY.observe_ns(time.perf_counter_ns() - t0)
-        # hashing tokenizer is not invertible; render token ids
-        return [
-            " ".join(f"<{int(t)}>" for t in row if t != self.tokenizer.PAD)
-            for row in toks
-        ]
+        return [self.render_tokens(row) for row in toks]
 
     def __call__(self, prompts: Sequence[str], **kwargs) -> List[str]:
         return self.generate(prompts, **kwargs)

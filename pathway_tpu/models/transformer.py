@@ -17,6 +17,7 @@ import numpy as np
 
 __all__ = [
     "KVTransformerDecoder",
+    "SlotKVDecoder",
     "TransformerConfig",
     "TransformerEncoder",
     "normalized_token_states",
@@ -307,6 +308,153 @@ class KVTransformerDecoder(nn.Module):
         for i in range(cfg.n_layers):
             x, ki, vi = KVEncoderBlock(cfg, name=f"block_{i}")(
                 x, k_caches[:, i], v_caches[:, i], write_pos, q_pos
+            )
+            new_k.append(ki)
+            new_v.append(vi)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_ln")(x)
+        return x, jnp.stack(new_k, axis=1), jnp.stack(new_v, axis=1)
+
+
+class SlotSelfAttention(nn.Module):
+    """Params-compatible slot-pool twin of ``KVSelfAttention``: the
+    batch dimension is a pool of persistent SLOTS and only ACTIVE lanes
+    may move their K/V.  The freeze is applied at the WRITE, not with a
+    post-hoc full-buffer select: the inserted value is the new token's
+    K/V for active lanes and the buffer's EXISTING value for inactive
+    ones — a single [S, Ln, H, hd] mask instead of two [S, T, H, hd]
+    copies per layer per step, which keeps the per-step scatter
+    in-place-friendly for XLA's loop optimizer.  For active lanes the
+    inserted values (and therefore scores, probs, outputs) are
+    line-for-line ``KVSelfAttention``'s — the twin relation the
+    token-identity tests pin down."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, k_cache, v_cache, write_pos, q_pos, active):
+        cfg = self.config
+        B, Ln, D = x.shape
+        T = k_cache.shape[1]
+        head_dim = cfg.d_model // cfg.n_heads
+
+        def proj(name, logical):
+            return nn.Dense(
+                cfg.d_model,
+                dtype=cfg.dtype,
+                name=name,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.xavier_uniform(), logical
+                ),
+            )
+
+        q = proj("query", ("embed", "heads"))(x)
+        k_new = proj("key", ("embed", "heads"))(x)
+        v_new = proj("value", ("embed", "heads"))(x)
+        q = q.reshape(B, Ln, cfg.n_heads, head_dim)
+        k_new = k_new.reshape(B, Ln, cfg.n_heads, head_dim)
+        v_new = v_new.reshape(B, Ln, cfg.n_heads, head_dim)
+        # masked write: inactive lanes re-insert what the buffer already
+        # holds at their write position — their K/V is bit-frozen
+        read = jax.vmap(
+            lambda buf, p: jax.lax.dynamic_slice(
+                buf, (p, 0, 0), (Ln, cfg.n_heads, head_dim)
+            )
+        )
+        sel = active[:, None, None, None]
+        k_ins = jnp.where(sel, k_new, read(k_cache, write_pos))
+        v_ins = jnp.where(sel, v_new, read(v_cache, write_pos))
+        insert = jax.vmap(
+            lambda buf, new, p: jax.lax.dynamic_update_slice(
+                buf, new, (p, 0, 0)
+            )
+        )
+        k_cache = insert(k_cache, k_ins, write_pos)
+        v_cache = insert(v_cache, v_ins, write_pos)
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k_cache) / np.sqrt(head_dim)
+        big_neg = jnp.finfo(jnp.float32).min
+        key_pos = jnp.arange(T, dtype=jnp.int32)
+        attn_mask = key_pos[None, None, :] <= q_pos[:, :, None]
+        scores = jnp.where(attn_mask[:, None, :, :], scores, big_neg)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhlm,bmhd->blhd", probs, v_cache).reshape(
+            B, Ln, cfg.d_model
+        )
+        return proj("out", ("heads", "embed"))(out), k_cache, v_cache
+
+
+class SlotEncoderBlock(nn.Module):
+    """Slot-pool twin of ``KVEncoderBlock`` — explicit submodule names
+    pin the param tree to the trunk's layout."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, k_cache, v_cache, write_pos, q_pos, active):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_0")(x)
+        attn, k_cache, v_cache = SlotSelfAttention(
+            cfg, name="SelfAttention_0"
+        )(h, k_cache, v_cache, write_pos, q_pos, active)
+        x = x + attn
+        h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_1")(x)
+        x = x + MlpBlock(cfg, name="MlpBlock_0")(h)
+        return x, k_cache, v_cache
+
+
+class SlotKVDecoder(nn.Module):
+    """Slot-indexed twin of ``KVTransformerDecoder`` for the continuous
+    decode engine (serve/decode.py): the batch dimension is a pool of
+    ``S`` persistent SLOTS whose K/V buffers ``[S, n_layers, T, H, hd]``
+    outlive any one request, and the step advances only ACTIVE slots.
+
+    Requests JOIN a slot mid-flight (prefill writes their prompt K/V)
+    and LEAVE at EOS; the pool buffers are then reused by the next
+    request.  Two properties make the in-flight mixing safe:
+
+    - **inactive slots do not move**: each layer's K/V write is masked
+      per lane (``SlotSelfAttention`` re-inserts the existing value for
+      inactive lanes), so an idle or finished slot's K/V is bit-frozen
+      no matter what garbage its lane computed.  For active slots the
+      buffers and hidden states are exactly what
+      ``KVTransformerDecoder`` would have produced — the twin relation
+      the token-identity tests pin down;
+    - **stale K/V cannot leak**: the attention masks every key slot
+      past a row's ``q_pos`` to exact-zero probability, and a joining
+      request's prefill (re)writes every position it will ever attend —
+      so a reused slot can never see its previous occupant.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self, ids_new, positions, k_pool, v_pool, write_pos, q_pos, active
+    ):
+        cfg = self.config
+        tok = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="tok_embed",
+        )(ids_new)
+        pos = nn.Embed(
+            cfg.max_len,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("pos", "embed")
+            ),
+            name="pos_embed",
+        )(positions)
+        x = tok + pos
+        new_k = []
+        new_v = []
+        for i in range(cfg.n_layers):
+            x, ki, vi = SlotEncoderBlock(cfg, name=f"block_{i}")(
+                x, k_pool[:, i], v_pool[:, i], write_pos, q_pos, active
             )
             new_k.append(ki)
             new_v.append(vi)

@@ -506,6 +506,11 @@ def snapshot() -> Dict[str, Any]:
     # /serve_stats readers get hit/miss/evict/bytes per tier without
     # parsing Prometheus label strings
     caches: Dict[str, Dict[str, float]] = {}
+    # the generator column: samples labeled generator=... (the
+    # continuous-decode engines, serve/decode.py) grouped per engine —
+    # slot occupancy, prefill/decode token counters, finished/evicted
+    # requests, quarantined slots, per engine name
+    generators: Dict[str, Dict[str, float]] = {}
     for kind, name, key, value in _provider_samples():
         target = counters if kind == "counter" else gauges
         target[series_name(name, key)] = value
@@ -520,6 +525,10 @@ def snapshot() -> Dict[str, Any]:
         if tier is not None:
             rest = tuple((lk, lv) for lk, lv in key if lk != "tier")
             caches.setdefault(tier, {})[series_name(name, rest)] = value
+        gen = labels.get("generator")
+        if gen is not None:
+            rest = tuple((lk, lv) for lk, lv in key if lk != "generator")
+            generators.setdefault(gen, {})[series_name(name, rest)] = value
     events, total = _ring.snapshot()
     return {
         "enabled": _state.enabled,
@@ -532,6 +541,7 @@ def snapshot() -> Dict[str, Any]:
         "gauges": gauges,
         "shards": {k: shards[k] for k in sorted(shards, key=_shard_sort_key)},
         "caches": {k: caches[k] for k in sorted(caches)},
+        "generators": {k: generators[k] for k in sorted(generators)},
         "events": [
             {
                 "ts": e[0],

@@ -13,7 +13,15 @@ sharded-serve family ``shard.dispatch`` / ``shard.merge`` /
 group), the serve-cache pair ``cache.get`` / ``cache.put``
 (pathway_tpu/cache — a faulted lookup degrades to a recompute MISS and
 a faulted store drops the entry; the serve result is never wrong and
-never fails, proven by the chaos triple in tests/test_robust.py), and
+never fails, proven by the chaos triple in tests/test_robust.py), the
+continuous-decode triple ``generator.prefill`` / ``generator.step`` /
+``generator.slot_free`` (serve/decode.py — a prefill fault degrades
+that request to an empty flagged result the QA ladder's
+``extractive_answer`` rung absorbs, a persistent step fault resolves
+every in-flight request with its tokens emitted so far, flagged, and a
+slot-free fault QUARANTINES the slot; the step loop never stalls and no
+other slot's K/V is touched — ``slot_free`` even fires under an
+already-spent deadline so an armed hang releases immediately), and
 the tracing pair ``trace.record`` / ``trace.export``
 (pathway_tpu/observe/trace.py — ANY armed fault in the tracing path,
 raise/delay/hang alike, degrades to dropped spans counted on

@@ -8,13 +8,23 @@ device batches (one 2+2 budget amortized across every rider) and
 double-buffers them so stage-2 rerank of batch N overlaps stage-1
 encode/search of batch N+1; ``SharedBatcher`` is the same engine for
 flat scoring calls (e.g. the QA layer's cross-encoder rerank).
+
+``ContinuousDecoder`` (decode.py) extends the same admission machinery
+to GENERATOR decode at token granularity: a persistent slotted K/V pool
+where requests join after a (prefix-cached) prefill and leave at EOS,
+freeing their slot mid-flight — the throughput substrate for the
+cascade's listwise LLM rerank stage and the chat/QA path.
 """
 
+from .decode import ContinuousDecoder, DecodeResult, decode_slots
 from .scheduler import ServeScheduler, SharedBatcher, coalesce_window_s, max_batch_queries
 
 __all__ = [
+    "ContinuousDecoder",
+    "DecodeResult",
     "ServeScheduler",
     "SharedBatcher",
     "coalesce_window_s",
+    "decode_slots",
     "max_batch_queries",
 ]

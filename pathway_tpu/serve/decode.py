@@ -1,0 +1,842 @@
+"""Continuous token-level batching for generator decode.
+
+The serve tier made retrieval fast; generation is the next bottleneck
+("Accelerating Retrieval-Augmented Generation", arxiv 2412.15246:
+once retrieval is cached and batched, the LLM decode dominates
+end-to-end latency), and the listwise-rerank workload the cascade's LLM
+stage will issue (RankLLM, arxiv 2505.19284) is many SHORT, shared-
+prefix generations — exactly what call-granular batching wastes:
+concurrent ``generate()`` calls serialize into separate decode scans,
+and every prompt in a batch pays the full ``steps`` budget even after
+emitting EOS.
+
+``ContinuousDecoder`` batches at TOKEN granularity instead:
+
+- a persistent device-resident **slot pool** — per-layer K/V buffers
+  ``[slots, L, H, T, d]`` plus per-slot rng chains — outlives any one
+  request (``models/transformer.py SlotKVDecoder``, the params-
+  compatible twin whose step advances only active slots);
+- requests **JOIN** the step loop after a bucketed prefill
+  (``TextGenerator._slot_prefill_fn``; shared-prefix prompts ride
+  ``PrefixKVCache`` blocks and prefill only their tails) and **LEAVE**
+  at EOS or budget exhaustion, freeing their slot for the next queued
+  request mid-flight;
+- the loop advances every active slot together in
+  ``PATHWAY_DECODE_STEP_BUCKET``-step chunks — ONE compiled dispatch
+  per chunk regardless of how many requests ride it (ONE compile
+  signature per engine: the step shapes are (slots, T, chunk), all
+  static).
+
+**Token identity.**  Every request decoded through the pool yields
+exactly the tokens of a solo ``generate([prompt])`` at the same seed —
+regardless of join order, batch-mates, or which slot it lands in:
+
+- each slot samples with its OWN rng chain (``PRNGKey(seed)``, one
+  split per emitted token — the solo chain; a batch-level chain would
+  make tokens depend on batch composition);
+- masked K/V attention is width-invariant: key slots past a row's
+  frontier carry exact-zero probability, so the pool's fixed buffer
+  width ``T`` reproduces the solo decode's prompt+steps-wide buffer
+  bit-for-bit;
+- a reused slot cannot alias its previous occupant: a joining prefill
+  (re)writes every position the request will ever attend, and inactive
+  lanes' buffers are bit-frozen by ``SlotKVDecoder``'s select.
+
+Admission reuses the coalescing machinery from ``scheduler.py``
+(``_CoalescerBase``): queue + tickets + deadline-preemption (a request
+too tight for any queueing serves SOLO through the legacy path on its
+caller's thread) + stop-drain.  Faults (``generator.prefill`` /
+``generator.step`` / ``generator.slot_free`` chaos sites) degrade the
+AFFECTED request — to an empty flagged result the QA layer's
+``extractive_answer`` rung absorbs, or to its tokens emitted so far,
+flagged — and never stall the step loop or touch another slot's K/V.
+
+The decode loop's per-chunk dispatch+fetch is intentional (token-level
+scheduling IS a host round trip per chunk — amortized over every
+active slot), so this module is not marked serve-path for the
+hidden-sync budget rules; lock discipline still applies and the pool
+lock covers ONLY slot allocation, never a dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import observe
+from ..observe import trace
+from ..robust import (
+    Deadline,
+    EXTRACTIVE_ANSWER,
+    inject,
+    log_once,
+    record_degraded,
+    retry_call,
+)
+from .scheduler import _Batch, _CoalescerBase, _Ticket
+
+__all__ = ["ContinuousDecoder", "DecodeResult", "decode_slots"]
+
+
+def decode_slots() -> int:
+    """Slot-pool size from ``PATHWAY_DECODE_SLOTS`` (default 8): the
+    max number of requests decoding concurrently in one step dispatch.
+    More slots = more sharing per chunk but a larger resident pool
+    (``slots × n_layers × max_len × d_model`` K/V elements × 2)."""
+    try:
+        n = int(os.environ.get("PATHWAY_DECODE_SLOTS", "8") or 8)
+    except ValueError:
+        n = 8
+    return max(1, n)
+
+
+# queue wait (enqueue → slot join) + per-phase device round trips
+_H_QUEUE_WAIT = observe.histogram("pathway_generator_queue_wait_seconds")
+_H_PREFILL = observe.histogram("pathway_generator_phase_seconds", phase="prefill")
+_H_STEP = observe.histogram("pathway_generator_phase_seconds", phase="step")
+
+
+class DecodeResult(str):
+    """One request's generated text plus ladder metadata — a ``str``
+    subclass so every existing caller that treats generator output as a
+    string keeps working; ``.degraded`` / ``.meta`` follow the
+    ``ServeResult`` convention (tuple of rung flags, JSON-able extras)."""
+
+    def __new__(
+        cls,
+        text: str = "",
+        degraded: Sequence[str] = (),
+        meta: Optional[Dict[str, Any]] = None,
+    ):
+        self = super().__new__(cls, text)
+        deduped: List[str] = []
+        for flag in degraded:
+            if flag not in deduped:
+                deduped.append(flag)
+        self.degraded = tuple(deduped)
+        self.meta = dict(meta or {})
+        if self.degraded and "degraded_reasons" not in self.meta:
+            self.meta["degraded_reasons"] = list(self.degraded)
+        return self
+
+    @property
+    def ok(self) -> bool:
+        return not self.degraded
+
+
+class _SlotState:
+    """Host bookkeeping for one occupied slot (the authoritative K/V
+    and rng state live device-side in the pool arrays)."""
+
+    __slots__ = (
+        "req", "budget", "temperature", "seed", "eos", "tokens", "pos",
+        "left", "t_join_ns",
+    )
+
+    def __init__(self, req, budget: int, temperature: float, seed: int, eos: int):
+        self.req = req
+        self.budget = budget
+        self.temperature = temperature
+        self.seed = seed
+        self.eos = eos
+        self.tokens: List[int] = []
+        self.pos = 0     # next K/V write position (= current length)
+        self.left = 0    # decode-step tokens still allowed
+        self.t_join_ns = time.perf_counter_ns()
+
+
+def _spent_deadline() -> Deadline:
+    """An already-expired deadline: armed ``hang`` faults on bookkeeping
+    sites release immediately instead of wedging the step loop (the
+    same contract the tracing layer uses for its chaos sites)."""
+    return Deadline(0.0)
+
+
+class ContinuousDecoder(_CoalescerBase):
+    """Continuous-batching decode engine over one ``TextGenerator``.
+
+    ``submit(prompt, max_new_tokens=, temperature=, seed=, deadline=)``
+    returns a ticket resolving to a :class:`DecodeResult` whose tokens
+    are identical to ``generator.generate([prompt], ...)`` solo at the
+    same seed.  ``generate(prompts, ...)`` is the blocking batch
+    convenience.  One scheduler thread owns the pool: it joins queued
+    requests into free slots (prefill), advances every active slot in
+    compiled step chunks, and resolves tickets as requests leave at
+    EOS/budget — slots free mid-flight, so a stream of short requests
+    rides alongside one long request instead of queueing behind it.
+    """
+
+    _degrade_empty = False
+    _metric_prefix = "pathway_generator_queue"
+
+    def __init__(
+        self,
+        generator,
+        slots: Optional[int] = None,
+        step_bucket: Optional[int] = None,
+        name: Optional[str] = None,
+        window_us: Optional[float] = None,
+        autostart: bool = True,
+        eos_id: Any = "inherit",
+        kv_width: Optional[int] = None,
+    ):
+        import jax.numpy as jnp
+
+        from ..models.generator import decode_step_bucket
+
+        self.generator = generator
+        cfg = generator.config
+        self.slots = max(1, int(slots or decode_slots()))
+        self.chunk = max(1, int(step_bucket or decode_step_bucket()))
+        self.eos_id = generator.eos_id if eos_id == "inherit" else eos_id
+        # pool buffer width: defaults to the position-embedding bound —
+        # any prompt + budget the generator accepts fits (prompts are
+        # tokenized to max_len - max_new_tokens), and masked attention
+        # makes the width numerically invisible.  ``kv_width`` (or
+        # ``PATHWAY_DECODE_KV_WIDTH``) narrows the pool when the served
+        # workload is known-short: attention cost and per-step buffer
+        # traffic scale with the width, and a request that does not fit
+        # (prompt + budget > width) simply serves solo
+        if kv_width is None:
+            try:
+                kv_width = int(
+                    os.environ.get("PATHWAY_DECODE_KV_WIDTH", "0") or 0
+                )
+            except ValueError:
+                kv_width = 0
+        self._T = min(cfg.max_len, kv_width) if kv_width else cfg.max_len
+        H = cfg.n_heads
+        hd = cfg.d_model // H
+        self._pk = jnp.zeros(
+            (self.slots, cfg.n_layers, self._T, H, hd), cfg.dtype
+        )
+        self._pv = jnp.zeros_like(self._pk)
+        self._rngs = jnp.zeros((self.slots, 2), jnp.uint32)
+        # slot allocation/free under the pool lock; dispatches NEVER
+        # hold it (the analyzer's slot-pool lock convention)
+        self._pool_lock = threading.Lock()
+        self._free: List[int] = list(range(self.slots))
+        self._active: Dict[int, _SlotState] = {}
+        self.pool_stats: Dict[str, int] = {
+            "tokens_prefill": 0,   # prompt tokens the prefill computed
+            "tokens_decode": 0,    # tokens emitted (prefill sample + steps)
+            "finished": 0,         # requests that left at EOS/budget
+            "evicted": 0,          # requests resolved degraded (fault/deadline)
+            "quarantined": 0,      # slots retired by slot_free faults
+            "chunks": 0,           # step-chunk dispatches
+            "steps": 0,            # decode steps executed (chunks × chunk)
+            "occupancy_sum": 0,    # Σ active slots per chunk (avg = /chunks)
+        }
+        super().__init__(
+            name=name or f"decode-{observe.next_id()}",
+            window_us=window_us,
+            max_batch=self.slots,
+            autostart=autostart,
+        )
+
+    # -- public surface ------------------------------------------------------
+    def submit(
+        self,
+        prompt: str,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+        deadline: Optional[Deadline] = None,
+        eos_id: Any = "inherit",
+    ) -> _Ticket:
+        if deadline is None:
+            deadline = Deadline.from_env()
+        eos = self.eos_id if eos_id == "inherit" else eos_id
+        ctx = trace.start_trace("generate.request", deadline=deadline)
+        item = (
+            str(prompt),
+            int(max_new_tokens),
+            float(temperature),
+            int(seed),
+            -1 if eos is None else int(eos),
+        )
+        return self._admit([item], None, deadline, trace_ctx=ctx)
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+        deadline: Optional[Deadline] = None,
+    ) -> List[str]:
+        tickets = [
+            self.submit(
+                p, max_new_tokens, temperature, seed, deadline=deadline
+            )
+            for p in prompts
+        ]
+        return [t() for t in tickets]
+
+    __call__ = generate
+
+    # -- scheduler thread: the continuous step loop --------------------------
+    def _run(self) -> None:
+        while True:
+            reqs: Optional[List[Any]] = None
+            try:
+                reqs = self._collect_joins()
+                if reqs is None:
+                    return
+                if reqs:
+                    self._join_group(reqs)
+                if self._active:
+                    self._step_chunk()
+            except Exception as exc:  # pragma: no cover - defensive
+                # the loop must outlive any one bad iteration: resolve
+                # every in-flight request with what it has, and any
+                # popped-but-not-joined request with the error — every
+                # admitted ticket resolves, no waiter hangs
+                log_once(
+                    f"decode.run:{type(exc).__name__}",
+                    "continuous decode iteration failed (%r); degrading "
+                    "in-flight requests and continuing",
+                    exc,
+                )
+                self._evict_all(exc)
+                for r in reqs or []:
+                    if not r.event.is_set():
+                        self._resolve_with_error(r, exc)
+
+    def _collect_joins(self) -> Optional[List[Any]]:
+        """Pop queued requests up to the free-slot count.  Blocks only
+        when the pool is idle; with active slots it returns immediately
+        so the step loop keeps advancing.  Returns None when stopped
+        AND fully drained (queue empty, pool empty)."""
+        with self._cond:
+            if not self._active:
+                while self._running and not self._queue:
+                    self._cond.wait(0.1)
+            if not self._queue and not self._active and not self._running:
+                return None
+            free = len(self._free)
+            # every slot quarantined and nothing in flight: fall back to
+            # per-request solo dispatches so admitted tickets still
+            # resolve (the engine degrades to call-level batching)
+            limit = free if (free or self._active) else len(self._queue)
+            take: List[Any] = []
+            while self._queue and len(take) < limit:
+                r = self._queue.popleft()
+                self._queued_items -= len(r.items)
+                take.append(r)
+            return take
+
+    # -- join ---------------------------------------------------------------
+    def _join_group(self, reqs: List[Any]) -> None:
+        """Admit a cohort of queued requests: host prep (tokenize +
+        prefix-cache walk) per request, then requests whose prefill
+        shares a compile shape (suffix length, prefix split) batch into
+        ONE prefill dispatch — the bucketed-join analog of the serve
+        scheduler's coalesced stage-1 batches."""
+        gen = self.generator
+        cfg = gen.config
+        ready: List[dict] = []
+        for req in reqs:
+            text, steps, temp, seed, eos = req.items[0]
+            _H_QUEUE_WAIT.observe_ns(
+                time.perf_counter_ns() - req.t_enqueue_ns
+            )
+            if req.deadline is not None and req.deadline.expired():
+                self.pool_stats["evicted"] += 1
+                record_degraded(EXTRACTIVE_ANSWER)
+                self._resolve(
+                    req,
+                    DecodeResult(
+                        "", degraded=(EXTRACTIVE_ANSWER,),
+                        meta={"reason": "deadline_before_join"},
+                    ),
+                )
+                continue
+            try:
+                # host prep — tokenize + prefix-cache walk — off every
+                # lock.  Per-request guard: a bad request (e.g. a budget
+                # larger than the model's max_len) must resolve ITS
+                # ticket degraded, never hang the cohort's
+                L_budget = cfg.max_len - steps
+                if L_budget <= 0:
+                    raise ValueError(
+                        f"max_new_tokens={steps} leaves no prompt budget "
+                        f"(max_len={cfg.max_len})"
+                    )
+                ids, mask = gen.tokenizer.encode_batch(
+                    [text], max_length=L_budget
+                )
+                ids = np.asarray(ids)
+                n = int(np.asarray(mask).sum())
+                if ids.shape[1] + steps > self._T:
+                    # narrowed pool (kv_width): this request does not fit
+                    # — serve it solo through the legacy path instead
+                    self._dispatch_batch([req], solo=True)
+                    continue
+                P, matches = 0, []
+                if gen.kv_cache is not None:
+                    P, matches = gen._cached_prefix(
+                        ids, np.asarray([n], np.int32), 1
+                    )
+            except Exception as exc:
+                log_once(
+                    f"decode.join:{type(exc).__name__}",
+                    "continuous-decode join prep failed (%r); degrading "
+                    "the request to an empty flagged result",
+                    exc,
+                )
+                self.pool_stats["evicted"] += 1
+                record_degraded(EXTRACTIVE_ANSWER)
+                self._resolve(
+                    req,
+                    DecodeResult(
+                        "", degraded=(EXTRACTIVE_ANSWER,),
+                        meta={"error": repr(exc)},
+                    ),
+                )
+                continue
+            ready.append(dict(
+                req=req, ids=ids, n=n, P=P,
+                match=matches[0] if matches else None,
+                L_sfx=ids.shape[1] - P, steps=steps, temp=temp,
+                seed=seed, eos=eos,
+            ))
+        with self._pool_lock:
+            free = len(self._free)
+        if len(ready) > free:
+            # more admitted than free slots (quarantine exhaustion):
+            # the overflow serves solo so every ticket still resolves
+            for rec in ready[free:]:
+                self._dispatch_batch([rec["req"]], solo=True)
+            ready = ready[:free]
+        # cohort grouping: one batched prefill per PREFIX split; members
+        # with shorter suffixes are right-padded to the group width (pad
+        # positions carry garbage K/V that the decode overwrites before
+        # it could ever be attended — causal masking + write-before-read)
+        groups: Dict[int, List[dict]] = {}
+        for rec in ready:
+            groups.setdefault(rec["P"], []).append(rec)
+        for P, grp in groups.items():
+            # quantize the cohort suffix width UP to a power-of-two ×16
+            # bucket (capped at the pool width) so the prefill shape
+            # lattice stays O(log²) — compile churn, not correctness,
+            # is the enemy here: pad positions are write-before-read
+            L = max(r["L_sfx"] for r in grp)
+            L_pad = 16
+            while L_pad < L:
+                L_pad *= 2
+            self._prefill_group(grp, min(L_pad, self._T - P), P)
+
+    def _prefill_group(self, grp: List[dict], L_sfx: int, P: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        gen = self.generator
+        cfg = gen.config
+        H = cfg.n_heads
+        hd = cfg.d_model // H
+        n_real = len(grp)
+        with self._pool_lock:
+            slots_real = [self._free.pop() for _ in range(n_real)]
+        # batch bucket: the model batch buckets (1, 4, 16, ...), so a
+        # burst of joins costs O(log) compile signatures per cohort size
+        B = 1
+        while B < n_real:
+            B *= 4
+        pad = B - n_real
+        try:
+            # real rows first; pad rows scatter to the out-of-bounds
+            # index ``slots`` (dropped by the scatter, never a clobber)
+            slot_arr = np.asarray(
+                slots_real + [self.slots] * pad, np.int32
+            )
+            suffix = np.zeros((B, L_sfx), np.int32)
+            n_len = np.zeros(B, np.int32)
+            temps = np.zeros(B, np.float32)
+            rng_rows: List[Any] = []
+            for j, rec in enumerate(grp):
+                row = rec["ids"][0, P:]
+                suffix[j, : row.shape[0]] = row
+                n_len[j] = rec["n"]
+                temps[j] = rec["temp"]
+                rng_rows.append(np.asarray(jax.random.PRNGKey(rec["seed"])))
+            rng_rows += [np.zeros(2, np.uint32)] * pad
+            if P:
+                blk = gen.kv_cache.block
+                zero = np.zeros((cfg.n_layers, P, H, hd), np.float32)
+                rows_k: List[Any] = []
+                rows_v: List[Any] = []
+                for rec in grp:
+                    blocks = rec["match"][1][: P // blk]
+                    rows_k.append(
+                        jnp.concatenate([b[0] for b in blocks], axis=1)
+                    )
+                    rows_v.append(
+                        jnp.concatenate([b[1] for b in blocks], axis=1)
+                    )
+                rows_k += [zero] * pad
+                rows_v += [zero] * pad
+                prefix_k = jnp.stack(
+                    [jnp.asarray(r, cfg.dtype) for r in rows_k]
+                )
+                prefix_v = jnp.stack(
+                    [jnp.asarray(r, cfg.dtype) for r in rows_v]
+                )
+            else:
+                prefix_k = jnp.zeros((B, cfg.n_layers, 0, H, hd), cfg.dtype)
+                prefix_v = jnp.zeros((B, cfg.n_layers, 0, H, hd), cfg.dtype)
+            with gen._lock:
+                fn = gen._slot_prefill_fn(self.slots, self._T, B, L_sfx, P)
+            deadline = self._batch_deadline([rec["req"] for rec in grp])
+            t0 = time.perf_counter_ns()
+            # pathway: allow(recompile-hazard): prefill shapes are bucketed upstream — the tokenizer pads suffix length to /16 multiples, the prefix split is a power-of-two block multiple (PrefixKVCache.bucket_tokens) and the join batch is a power-of-two bucket; the census test bounds the signature set
+            pk, pv, toks, rngs_out = retry_call(
+                "generator.prefill",
+                fn,
+                gen.params,
+                self._pk,
+                self._pv,
+                jnp.asarray(slot_arr),
+                jnp.asarray(suffix),
+                jnp.asarray(n_len),
+                prefix_k,
+                prefix_v,
+                jnp.asarray(np.stack(rng_rows)),
+                jnp.asarray(temps),
+                deadline=deadline,
+            )
+            firsts = np.asarray(toks)
+            t1 = time.perf_counter_ns()
+            _H_PREFILL.observe_ns(t1 - t0)
+        except Exception as exc:
+            for slot in slots_real:
+                self._free_slot(slot)
+            log_once(
+                f"decode.prefill:{type(exc).__name__}",
+                "continuous-decode prefill failed (%r); degrading the "
+                "affected request(s) to empty flagged results",
+                exc,
+            )
+            for rec in grp:
+                self.pool_stats["evicted"] += 1
+                record_degraded(EXTRACTIVE_ANSWER)
+                self._resolve(
+                    rec["req"],
+                    DecodeResult(
+                        "", degraded=(EXTRACTIVE_ANSWER,),
+                        meta={"error": repr(exc)},
+                    ),
+                )
+            return
+        self._pk, self._pv = pk, pv
+        self._rngs = self._rngs.at[jnp.asarray(slots_real)].set(
+            rngs_out[:n_real]
+        )
+        pk_now, pv_now = self._pk, self._pv
+        for j, rec in enumerate(grp):
+            req = rec["req"]
+            slot = slots_real[j]
+            first = int(firsts[j])
+            # prefix capture: admit the prompt's uncached full blocks as
+            # async device slices of THIS pool version (functional
+            # arrays — later steps never mutate them)
+            if gen.kv_cache is not None:
+                blk = gen.kv_cache.block
+                matched, _blocks, chain = rec["match"]
+                gen.kv_cache.admit(
+                    chain,
+                    matched // blk,
+                    lambda jb, _s=slot: (
+                        pk_now[_s, :, jb * blk : (jb + 1) * blk],
+                        pv_now[_s, :, jb * blk : (jb + 1) * blk],
+                    ),
+                )
+                gen.kv_cache.note_prefill(reused=P, computed=rec["n"] - P)
+            self.pool_stats["tokens_prefill"] += rec["n"] - P
+            self.pool_stats["tokens_decode"] += 1
+            if req.trace is not None:
+                req.trace.add_span(
+                    "decode.prefill", t0, t1,
+                    slot=slot, prefix_tokens=P, suffix_tokens=L_sfx,
+                    join_batch=n_real,
+                )
+            state = _SlotState(
+                req, rec["steps"], rec["temp"], rec["seed"], rec["eos"]
+            )
+            state.tokens = [first]
+            state.pos = rec["n"]
+            state.left = rec["steps"] - 1
+            self._active[slot] = state
+            if (rec["eos"] >= 0 and first == rec["eos"]) or state.left <= 0:
+                self._leave(slot, state)
+
+    # -- decode step chunk ---------------------------------------------------
+    def _step_chunk(self) -> None:
+        import jax.numpy as jnp
+
+        gen = self.generator
+        S = self.slots
+        tok = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        act = np.zeros(S, bool)
+        left = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        eos = np.full(S, -1, np.int32)
+        for s, st in self._active.items():
+            tok[s] = st.tokens[-1]
+            pos[s] = st.pos
+            act[s] = True
+            left[s] = st.left
+            temps[s] = st.temperature
+            eos[s] = st.eos
+        with gen._lock:
+            fn = gen._slot_step_fn(S, self._T, self.chunk)
+        deadline = self._batch_deadline(
+            [st.req for st in self._active.values()]
+        )
+        riders = [
+            st for st in self._active.values() if st.req.trace is not None
+        ]
+        bctx = None
+        if riders:
+            # ONE batch trace per step chunk, linked from every traced
+            # rider — the decode-loop analog of the coalescing
+            # scheduler's batch/link-span pattern
+            bctx = trace.start_trace(
+                "decode.batch", deadline=deadline, kind="batch", sample=False
+            )
+            if bctx is not None:
+                bctx.annotate(
+                    engine=self.name, slots=len(self._active),
+                    chunk=self.chunk,
+                )
+        t0 = time.perf_counter_ns()
+        try:  # pathway: allow(recompile-hazard): every per-slot array here is a fixed [slots]-shaped row of the static pool — one compile signature per engine, asserted by the census test
+            args = (
+                gen.params, self._pk, self._pv, jnp.asarray(tok),
+                jnp.asarray(pos), jnp.asarray(act), jnp.asarray(left),
+                self._rngs, jnp.asarray(temps), jnp.asarray(eos),
+            )
+            if bctx is not None:
+                with trace.use(bctx):
+                    pk, pv, rngs, em = retry_call(
+                        "generator.step", fn, *args, deadline=deadline
+                    )
+            else:
+                pk, pv, rngs, em = retry_call(
+                    "generator.step", fn, *args, deadline=deadline
+                )
+            em = np.asarray(em)  # [chunk, S]: the per-chunk host fetch
+        except Exception as exc:
+            if bctx is not None:
+                trace.finish(bctx, statuses=("error",))
+            log_once(
+                f"decode.step:{type(exc).__name__}",
+                "continuous-decode step chunk failed (%r); resolving "
+                "in-flight requests with their tokens so far",
+                exc,
+            )
+            self._evict_all(exc)
+            return
+        t1 = time.perf_counter_ns()
+        _H_STEP.observe_ns(t1 - t0)
+        self._pk, self._pv, self._rngs = pk, pv, rngs
+        self.pool_stats["chunks"] += 1
+        self.pool_stats["steps"] += self.chunk
+        self.pool_stats["occupancy_sum"] += len(self._active)
+        if bctx is not None:
+            trace.finish(bctx)
+            for st in riders:
+                rt = st.req.trace
+                rt.add_link(bctx.trace_id)
+                rt.add_span(
+                    "decode.step", t0, t1,
+                    linked_trace=bctx.trace_id, slots=len(self._active),
+                )
+        # replay the chunk per slot — the EXACT mask rules the kernel
+        # applied: a lane emits until EOS or budget, then freezes
+        leaves: List[Tuple[int, _SlotState, Tuple[str, ...]]] = []
+        for s, st in list(self._active.items()):
+            flags: Tuple[str, ...] = ()
+            finished = False
+            for i in range(self.chunk):
+                t = int(em[i, s])
+                st.tokens.append(t)
+                st.pos += 1
+                st.left -= 1
+                self.pool_stats["tokens_decode"] += 1
+                if (st.eos >= 0 and t == st.eos) or st.left <= 0:
+                    finished = True
+                    break
+            if not finished and (
+                st.req.deadline is not None and st.req.deadline.expired()
+            ):
+                # mid-decode deadline: the request leaves with its
+                # tokens so far, flagged — its slot frees for the queue
+                finished = True
+                flags = (EXTRACTIVE_ANSWER,)
+            if finished:
+                leaves.append((s, st, flags))
+        for s, st, flags in leaves:
+            self._leave(s, st, flags=flags)
+
+    # -- leave / resolve -----------------------------------------------------
+    def _leave(
+        self, slot: int, st: _SlotState, flags: Tuple[str, ...] = ()
+    ) -> None:
+        gen = self.generator
+        meta: Dict[str, Any] = {"tokens": len(st.tokens), "slot": slot}
+        if flags:
+            self.pool_stats["evicted"] += 1
+            meta["partial"] = True
+            for f in flags:
+                record_degraded(f)
+        else:
+            self.pool_stats["finished"] += 1
+        if st.req.trace is not None:
+            st.req.trace.add_span(
+                "decode", st.t_join_ns, time.perf_counter_ns(),
+                tokens=len(st.tokens), slot=slot,
+            )
+        # free BEFORE resolving: the waiter may act on the result the
+        # instant the ticket fires, and the slot hand-off (including its
+        # chaos site) must already be settled by then
+        self._active.pop(slot, None)
+        self._free_slot(slot)
+        self._resolve(
+            st.req,
+            DecodeResult(
+                gen.render_tokens(st.tokens), degraded=flags, meta=meta
+            ),
+        )
+
+    def _evict_all(self, exc: BaseException) -> None:
+        """Persistent step failure: every in-flight request resolves
+        with its tokens emitted so far, flagged — the step loop itself
+        survives and keeps serving the queue."""
+        gen = self.generator
+        for s, st in list(self._active.items()):
+            self.pool_stats["evicted"] += 1
+            record_degraded(EXTRACTIVE_ANSWER)
+            self._active.pop(s, None)
+            self._free_slot(s)
+            self._resolve(
+                st.req,
+                DecodeResult(
+                    gen.render_tokens(st.tokens),
+                    degraded=(EXTRACTIVE_ANSWER,),
+                    meta={
+                        "partial": True,
+                        "tokens": len(st.tokens),
+                        "error": repr(exc),
+                    },
+                ),
+            )
+
+    def _free_slot(self, slot: int) -> None:
+        """Return a slot to the free list.  A ``generator.slot_free``
+        fault quarantines the slot (capacity shrinks by one, counted)
+        instead of risking a corrupt hand-off — and fires under an
+        already-spent deadline so even an armed hang releases
+        immediately and the step loop never stalls."""
+        try:
+            inject.fire("generator.slot_free", deadline=_spent_deadline())
+        except Exception as exc:
+            log_once(
+                f"decode.slot_free:{type(exc).__name__}",
+                "slot free failed (%r); quarantining slot instead of "
+                "reusing it",
+                exc,
+            )
+            self.pool_stats["quarantined"] += 1
+            return
+        with self._pool_lock:
+            self._free.append(slot)
+
+    def _resolve(self, req, result: DecodeResult) -> None:
+        req.slots = [0]
+        req.batch = _Batch(
+            lambda _r=result: [_r], 1, 1, self._degrade_empty
+        )
+        req.event.set()
+        if req.trace is not None:
+            trace.finish(req.trace, statuses=tuple(result.degraded))
+
+    # -- solo fallback (deadline preemption, stop-drain, quarantine) ---------
+    def _launch(self, items: List[Any], reqs: List[Any]):
+        gen = self.generator
+
+        def run(_items=tuple(items)):
+            out = []
+            for text, steps, temp, seed, eos in _items:
+                rows = gen.generate(
+                    [text],
+                    max_new_tokens=steps,
+                    temperature=temp,
+                    seed=seed,
+                    eos_id=None if eos < 0 else eos,
+                )
+                out.append(DecodeResult(rows[0]))
+            return out
+
+        return run
+
+    def _demux(self, req, batch_result) -> DecodeResult:
+        out = []
+        for slot in req.slots:
+            if 0 <= slot < len(batch_result):
+                out.append(batch_result[slot])
+            else:  # pragma: no cover - defensive
+                out.append(
+                    DecodeResult("", degraded=(EXTRACTIVE_ANSWER,))
+                )
+        result = out[0]
+        if req.trace is not None:
+            # solo-path requests (deadline preemption, stop-drain,
+            # quarantine/kv_width fallback) resolve through here without
+            # passing _resolve — finish their trace so tail sampling
+            # sees them (idempotent for pool-path requests)
+            trace.finish(
+                req.trace, statuses=tuple(getattr(result, "degraded", ()))
+            )
+        return result
+
+    # -- flight-recorder provider -------------------------------------------
+    def observe_metrics(self):
+        yield from super().observe_metrics()
+        labels = {"generator": self.name}
+        yield ("gauge", "pathway_generator_slots", labels, self.slots)
+        yield (
+            "gauge", "pathway_generator_slots_active", labels,
+            len(self._active),
+        )
+        yield (
+            "gauge", "pathway_generator_slots_quarantined", labels,
+            self.pool_stats["quarantined"],
+        )
+        for phase in ("prefill", "decode"):
+            yield (
+                "counter",
+                "pathway_generator_tokens_total",
+                {**labels, "phase": phase},
+                self.pool_stats[f"tokens_{phase}"],
+            )
+        for outcome in ("finished", "evicted"):
+            yield (
+                "counter",
+                "pathway_generator_requests_total",
+                {**labels, "outcome": outcome},
+                self.pool_stats[outcome],
+            )
+        yield (
+            "counter", "pathway_generator_steps_total", labels,
+            self.pool_stats["steps"],
+        )
+        yield (
+            "counter", "pathway_generator_chunks_total", labels,
+            self.pool_stats["chunks"],
+        )

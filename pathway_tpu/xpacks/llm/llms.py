@@ -48,7 +48,16 @@ class BaseChat(UDF):
 
 class TpuChat(BaseChat):
     """Local generation on the flax causal LM (batched decode under one jit)
-    — the TPU-native slot for the reference's HFPipelineChat."""
+    — the TPU-native slot for the reference's HFPipelineChat.
+
+    ``continuous=True`` (or ``PATHWAY_CHAT_CONTINUOUS=1``) routes every
+    prompt through the shared :class:`~pathway_tpu.serve.ContinuousDecoder`
+    slot pool instead of call-granular decode: concurrent chat rows —
+    and anything else submitted to the same engine, e.g. the cascade's
+    listwise LLM rerank prompts — share one token-level step loop, with
+    per-prompt EOS leave freeing slots mid-flight.  Tokens are identical
+    either way (the engine is solo-``generate``-token-identical per
+    request)."""
 
     def __init__(
         self,
@@ -57,8 +66,12 @@ class TpuChat(BaseChat):
         temperature: float = 0.0,
         checkpoint_path: Optional[str] = None,
         generator=None,
+        continuous: Optional[bool] = None,
+        decoder=None,
         **kwargs,
     ):
+        import os
+
         from ...models.generator import TextGenerator
 
         self.model = model
@@ -66,14 +79,51 @@ class TpuChat(BaseChat):
             model=model, checkpoint_path=checkpoint_path
         )
         gen = self._generator
+        if continuous is None:
+            continuous = os.environ.get(
+                "PATHWAY_CHAT_CONTINUOUS", "0"
+            ) not in ("0", "", "false", "off")
+        self._decoder = decoder
+        if decoder is None and continuous:
+            from ...serve import ContinuousDecoder
+
+            self._decoder = ContinuousDecoder(gen)
+        engine = self._decoder
 
         def chat(messages) -> str:
             prompts = [_messages_to_prompt(m) for m in messages]
-            outs = gen.generate(
-                prompts, max_new_tokens=max_new_tokens, temperature=temperature
-            )
             import numpy as np
 
+            if engine is not None:
+                # submit-then-gather: every row joins the shared slot
+                # pool, so concurrent micro-batches coalesce at token
+                # granularity instead of serializing whole decodes
+                tickets = [
+                    engine.submit(
+                        p,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature,
+                    )
+                    for p in prompts
+                ]
+                results = [t() for t in tickets]
+                for r in results:
+                    if getattr(r, "degraded", ()) and not str(r):
+                        # an empty degraded decode (generator down at
+                        # prefill) must surface as a chat FAILURE so the
+                        # QA layer's extractive_answer rung takes over;
+                        # partial flagged results still serve their text
+                        raise RuntimeError(
+                            "continuous decode degraded: "
+                            + ",".join(r.degraded)
+                        )
+                outs = [str(r) for r in results]
+            else:
+                outs = gen.generate(
+                    prompts,
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                )
             return np.array(outs, dtype=object)
 
         super().__init__(chat, batched=True, **kwargs)

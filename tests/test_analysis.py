@@ -396,6 +396,52 @@ def test_lock_discipline_knows_forward_index_cache_getters():
     assert _live(_run(good), "lock-discipline") == []
 
 
+def test_lock_discipline_knows_slot_pool_getters():
+    """ISSUE 10: the continuous-decode compiled-fn getters
+    (``_slot_prefill_fn`` / ``_slot_step_fn``) are registered cache-
+    getter conventions, and the slot-pool LOCK convention holds: slot
+    allocation under the pool lock is fine, a dispatch under it is a
+    lock-discipline finding (the step loop would stall every
+    admitter/metrics reader for a device round trip)."""
+    bad = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._pool_lock = threading.Lock()
+
+            def step(self, tok, pos):
+                with self._pool_lock:
+                    fn = self._slot_step_fn(8, 64, 4)
+                    return fn(self._pk, self._pv, tok, pos)
+
+            def join(self, ids):
+                with self._pool_lock:
+                    fn = self._slot_prefill_fn(8, 64, 16, 0)
+                    out = fn(self._pk, self._pv, ids)
+                return out
+    """
+    live = _live(_run(bad), "lock-discipline")
+    assert len(live) == 2, "\n".join(f.message for f in live)
+    assert all("jitted dispatch" in f.message for f in live)
+    good = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._pool_lock = threading.Lock()
+
+            def join(self, ids):
+                # slot ALLOCATION under the pool lock is the sanctioned
+                # shape; the dispatch happens after release
+                with self._pool_lock:
+                    slot = self._free.pop()
+                fn = self._slot_prefill_fn(8, 64, 16, 0)
+                return slot, fn(self._pk, self._pv, ids)
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
 def test_lock_discipline_knows_sharded_cache_getters():
     """ISSUE 7: the sharded-serve compiled-fn getters (``_encode_fn``,
     ``_shard_search_fn`` — tuple-returning, ``_merge_fn``, ``_table_fn``,

@@ -786,3 +786,25 @@ def test_analysis_cli_format_json(tmp_path, capsys):
     assert main(["--format", "json", str(good)]) == 0
     doc = json.loads(capsys.readouterr().out)
     assert doc == {"findings": [], "live": 0, "suppressed": 0}
+
+
+def test_om_scrape_families_stay_contiguous_with_live_connector_monitor():
+    """Regression (tier-1 flake): a GC-lingering connector monitor used
+    to put `pathway_connector_*` samples AFTER all three connector TYPE
+    lines — a strict OpenMetrics parser rejects a family's sample
+    appearing once another family has opened ("Clashing name") and fails
+    the whole scrape.  Families must render with their samples
+    contiguous under their own TYPE line, operators included."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.metrics import render_metrics
+    from pathway_tpu.io._offsets import ConnectorMonitor
+
+    om_parser = pytest.importorskip("prometheus_client.openmetrics.parser")
+    mon = ConnectorMonitor("rest_")  # keep a strong ref: stays scraped
+    mon.on_insert(4)
+    mon.on_delete(1)
+    body = render_metrics(pw.G.engine_graph, openmetrics=True)
+    families = list(om_parser.text_string_to_metric_families(body))
+    by_name = {f.name for f in families}
+    assert "pathway_connector_rows" in by_name
+    assert "pathway_connector_partitions" in by_name

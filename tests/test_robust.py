@@ -41,6 +41,7 @@ from pathway_tpu.ops.knn import DeviceKnnIndex
 from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
 from pathway_tpu.ops.serving import FusedEncodeSearch
 from pathway_tpu.robust import (
+    EXTRACTIVE_ANSWER,
     CircuitBreaker,
     CircuitOpen,
     Deadline,
@@ -648,6 +649,131 @@ def test_generator_kv_cache_chaos_never_changes_tokens():
         # lookup faulted: cold prefill, same tokens
         assert gen.generate([prompt], max_new_tokens=4) == clean
     assert gen.generate([prompt], max_new_tokens=4) == clean  # warm path
+
+
+# -- chaos: continuous decode (ISSUE 10) -------------------------------------
+
+
+def _decode_stack():
+    from pathway_tpu.models.generator import TextGenerator
+    from pathway_tpu.serve import ContinuousDecoder
+
+    gen = TextGenerator(
+        dimension=32, n_layers=1, n_heads=4, max_length=64, vocab_size=512,
+        kv_cache=None,
+    )
+    return gen, ContinuousDecoder(gen, slots=2, step_bucket=4, name=None)
+
+
+def test_decode_prefill_transient_fault_retries_token_identical():
+    gen, eng = _decode_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=6, use_kv=False)[0]
+        with inject.armed("generator.prefill", "raise", times=1):
+            got = eng.submit("hello world", max_new_tokens=6)()
+        assert got == solo and not got.degraded
+    finally:
+        eng.stop()
+
+
+def test_decode_prefill_persistent_fault_degrades_loop_survives():
+    """A request whose prefill stays down resolves as an empty flagged
+    result (the QA ladder's extractive_answer rung absorbs it) — and the
+    NEXT request decodes clean: the step loop survives the fault."""
+    gen, eng = _decode_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=6, use_kv=False)[0]
+        before = _degraded(EXTRACTIVE_ANSWER)
+        with inject.armed("generator.prefill", "raise"):
+            got = eng.submit("hello world", max_new_tokens=6)()
+        assert got == "" and EXTRACTIVE_ANSWER in got.degraded
+        assert _degraded(EXTRACTIVE_ANSWER) == before + 1
+        assert eng.submit("hello world", max_new_tokens=6)() == solo
+    finally:
+        eng.stop()
+
+
+def test_decode_step_fault_mid_decode_returns_partial_never_corrupts():
+    """A persistent step fault mid-decode resolves the in-flight request
+    with its tokens emitted SO FAR, flagged — those tokens are a prefix
+    of the solo decode (no corruption) — and a fresh request afterwards
+    is token-identical: no slot carries damage across the fault."""
+    gen, eng = _decode_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=8, use_kv=False)[0]
+        with inject.armed("generator.step", "raise"):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert EXTRACTIVE_ANSWER in got.degraded
+        assert got.meta.get("partial") and got.meta["tokens"] >= 1
+        assert solo.startswith(str(got))  # tokens-so-far, uncorrupted
+        after = eng.submit("hello world", max_new_tokens=8)()
+        assert after == solo and not after.degraded
+    finally:
+        eng.stop()
+
+
+def test_decode_step_delay_and_hang_never_stall_the_loop():
+    gen, eng = _decode_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=6, use_kv=False)[0]
+        # delay: the chunk completes late but clean
+        with inject.armed("generator.step", "delay", delay_s=0.05, times=1):
+            got = eng.submit("hello world", max_new_tokens=6)()
+        assert got == solo
+        # hang: bounded by the hang cap, the request degrades to its
+        # tokens so far and the loop keeps serving
+        with inject.armed("generator.step", "hang", hang_s=0.2):
+            got = eng.submit("hello world", max_new_tokens=6)()
+        assert EXTRACTIVE_ANSWER in got.degraded
+        assert eng.submit("hello world", max_new_tokens=6)() == solo
+    finally:
+        eng.stop()
+
+
+def test_decode_slot_free_fault_quarantines_slot_only():
+    """A slot_free fault retires THAT slot (capacity-1, counted) — the
+    request it served still resolves clean and the engine keeps
+    decoding on the remaining slots; with every slot quarantined it
+    degrades to solo call-level dispatches, never a stall."""
+    gen, eng = _decode_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=4, use_kv=False)[0]
+        with inject.armed("generator.slot_free", "raise", times=1):
+            got = eng.submit("hello world", max_new_tokens=4)()
+        assert got == solo and not got.degraded  # the request was done
+        assert eng.pool_stats["quarantined"] == 1
+        assert eng.submit("hello world", max_new_tokens=4)() == solo
+        # hang flavor releases immediately (spent-deadline contract)
+        t0 = time.perf_counter()
+        with inject.armed("generator.slot_free", "hang", hang_s=30):
+            got = eng.submit("hello world", max_new_tokens=4)()
+        assert got == solo
+        assert time.perf_counter() - t0 < 5.0  # never waited the hang out
+        assert eng.pool_stats["quarantined"] == 2
+        # ALL slots quarantined: the engine falls back to solo legacy
+        # dispatches — admitted tickets still resolve token-identical
+        assert eng.submit("hello world", max_new_tokens=4)() == solo
+    finally:
+        eng.stop()
+
+
+def test_decode_fault_on_one_slot_never_touches_another():
+    """Concurrent requests: a transient prefill fault on the joining
+    request leaves the ALREADY-DECODING slot's tokens bit-identical."""
+    gen, eng = _decode_stack()
+    try:
+        a = "the quick brown fox jumps over"
+        b = "hello world"
+        solo_a = gen.generate([a], max_new_tokens=10, use_kv=False)[0]
+        solo_b = gen.generate([b], max_new_tokens=4, use_kv=False)[0]
+        ta = eng.submit(a, max_new_tokens=10)
+        time.sleep(0.02)  # a is mid-decode when b's prefill faults
+        with inject.armed("generator.prefill", "raise", times=1):
+            tb = eng.submit(b, max_new_tokens=4)
+        assert ta() == solo_a
+        assert tb() == solo_b
+    finally:
+        eng.stop()
 
 
 # -- chaos: tracing path (ISSUE 9) -------------------------------------------
