@@ -41,6 +41,7 @@ import sys
 import threading
 import time
 import traceback
+from typing import Optional
 
 import numpy as np
 
@@ -820,6 +821,211 @@ def phase_tracing_overhead(backend: str, extras: dict) -> float:
     max_pct = float(os.environ.get("BENCH_TRACE_MAX_OVERHEAD_PCT", "3.0"))
     assert overhead_pct < max_pct, (
         f"tracing overhead {overhead_pct:.2f}% exceeds the {max_pct}% "
+        f"budget (p50 on {p50_on:.3f} ms vs off {p50_off:.3f} ms)"
+    )
+    return round(overhead_pct, 3)
+
+
+def phase_profiling_overhead(backend: str, extras: dict) -> float:
+    """Price of the attribution layer (ISSUE 12: device-time profiler +
+    HBM ledger + SLO engine): the SAME coalescing serve stack driven by
+    16 concurrent callers with ALL THREE on (profiler sampling every
+    call, a 10 Hz scraper thread pulling the ledger + SLO document —
+    harsher than any real scrape cadence) vs all off, paired-ratio A/B.
+    The phase value is the added p50 latency in percent; the acceptance
+    budget is < 3% (BENCH_PROF_MAX_OVERHEAD_PCT overrides).  Also
+    asserts the per-batch 2+2 dispatch budget with stride-1 sampling
+    (attribution never adds a round trip), checks the HBM ledger total
+    against the backend's own byte accounting (within
+    BENCH_HBM_TOLERANCE, default 10%), and records the per-callable
+    device-second attribution the profiler produced."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu import observe
+    from pathway_tpu.observe import hbm, profile
+    from pathway_tpu.observe import slo as slo_mod
+    from pathway_tpu.ops import dispatch_counter
+    from pathway_tpu.serve import ServeScheduler
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_PROF_DOCS", "20000" if on_tpu else "1000"))
+    k, candidates = 10, 32
+    pipe, _cross, docs, _queries = _build_rr_pipeline(
+        n_docs, 16, k, candidates, small=not on_tpu
+    )
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(32)
+    ]
+    for q in pool:
+        pipe([q], k)
+    for b in range(2, 17):
+        pipe(sorted(set(pool))[:b], k)
+
+    conc = 16
+    env_enabled = observe.enabled()
+    observe.set_enabled(True)
+    stride0 = profile.sample_stride()
+    shed0 = slo_mod.shed_advisory_enabled()
+    window_us = float(os.environ.get("BENCH_PROF_WINDOW_US", "5000"))
+    max_batch = int(
+        os.environ.get("BENCH_PROF_MAX_BATCH", "16" if on_tpu else "4")
+    )
+
+    # HBM cross-check at a quiesced point: the ledger total (params,
+    # index, caches, pools) vs the backend's own resident accounting
+    import gc
+
+    gc.collect()
+    ledger = hbm.sample()
+    device_b = ledger["device_bytes"]
+    extras["hbm_ledger_bytes"] = ledger["total_bytes"]
+    extras["hbm_device_bytes"] = device_b
+    extras["hbm_watermark_bytes"] = ledger["watermark_bytes"]
+    extras["hbm_subsystems"] = {
+        sub: sum(parts.values())
+        for sub, parts in ledger["subsystems"].items()
+    }
+    tol = float(os.environ.get("BENCH_HBM_TOLERANCE", "0.10"))
+    if device_b:
+        agreement = abs(device_b - ledger["total_bytes"]) / max(device_b, 1)
+        extras["hbm_agreement_pct"] = round(agreement * 100.0, 2)
+        assert agreement < tol, (
+            f"HBM ledger {ledger['total_bytes']} vs device {device_b} "
+            f"disagree by {agreement:.1%} (> {tol:.0%}) — a consumer is "
+            "off the books"
+        )
+
+    def drive(arm_on: bool, n_req: int):
+        lats: list = [None] * n_req
+        errs: list = []
+        sched = ServeScheduler(
+            pipe, window_us=window_us, max_batch=max_batch, result_cache=None
+        )
+        stop_scrape = threading.Event()
+        scraper = None
+        if arm_on:
+            profile.set_sample(1.0)
+            slo_mod.set_shed_advisory(True)
+
+            def scrape_loop():
+                while not stop_scrape.is_set():
+                    hbm.sample()
+                    slo_mod.evaluate(max_age_s=0.0)
+                    profile.profile_stats()
+                    stop_scrape.wait(0.1)
+
+            scraper = threading.Thread(target=scrape_loop, daemon=True)
+            scraper.start()
+        else:
+            profile.set_sample(0.0)
+            slo_mod.set_shed_advisory(False)
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    t0 = time.perf_counter()
+                    rows = sched.serve([pool[(i * 7) % len(pool)]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    assert rows and rows[0]
+            except Exception as exc:
+                errs.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sched.stop()
+        stop_scrape.set()
+        if scraper is not None:
+            scraper.join(timeout=5)
+        if errs:
+            raise RuntimeError(f"profiling_overhead c{conc} failed: {errs[:3]}")
+        return np.asarray([l for l in lats if l is not None])
+
+    try:
+        # per-batch 2+2 with stride-1 sampling: attribution must never
+        # add a device round trip
+        profile.set_sample(1.0)
+        with ServeScheduler(
+            pipe, window_us=200_000, result_cache=None
+        ) as sched:
+            with dispatch_counter.DispatchCounter() as counter:
+                res, errs = [], []
+                barrier = threading.Barrier(8)
+
+                def w(q):
+                    try:
+                        barrier.wait(timeout=30)
+                        res.append(sched.serve([q], k))
+                    except Exception as exc:
+                        errs.append(repr(exc))
+
+                threads = [
+                    threading.Thread(target=w, args=(q,)) for q in pool[:8]
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert not errs, errs[:3]
+            batches = max(1, sched.stats["batches"] + sched.stats["solo"])
+        extras["profile_dispatches_per_batch"] = round(
+            counter.dispatches / batches, 2
+        )
+        assert counter.dispatches <= 2 * batches, (counter.events, batches)
+        assert counter.fetches <= 2 * batches, (counter.events, batches)
+
+        # paired A/B: per-round on/off p50 ratios, arm order alternated
+        rounds = int(os.environ.get("BENCH_PROF_ROUNDS", "5"))
+        n_req = int(os.environ.get("BENCH_PROF_REQUESTS", str(conc * 8)))
+        lat = {True: [], False: []}
+        ratios = []
+        for r in range(rounds):
+            order = (True, False) if r % 2 == 0 else (False, True)
+            round_p50 = {}
+            for mode in order:
+                drive(mode, 2 * conc)  # settle after the flip
+                arm = drive(mode, n_req)
+                lat[mode].append(arm)
+                round_p50[mode] = float(np.percentile(arm, 50))
+            ratios.append(round_p50[True] / max(round_p50[False], 1e-9))
+    finally:
+        profile.set_sample(1.0 / stride0 if stride0 else 0.0)
+        slo_mod.set_shed_advisory(shed0)
+        observe.set_enabled(env_enabled)
+    p50_on = float(np.percentile(np.concatenate(lat[True]), 50))
+    p50_off = float(np.percentile(np.concatenate(lat[False]), 50))
+    overhead_pct = (float(np.median(ratios)) - 1.0) * 100.0
+    extras["profile_p50_on_ms"] = round(p50_on, 3)
+    extras["profile_p50_off_ms"] = round(p50_off, 3)
+    extras["profile_round_ratios"] = [round(x, 4) for x in ratios]
+    extras["profiling_overhead_pct"] = round(overhead_pct, 3)
+    # the attribution the layer exists for: per-callable device seconds
+    profile.drain()
+    stats = profile.profile_stats()
+    extras["profile_attribution"] = {
+        name: {
+            "device_s": round(row["device_s"], 4),
+            "share_of_wall": round(row["share_of_wall"], 4),
+            "samples": int(row["samples"]),
+        }
+        for name, row in sorted(stats.items())
+        if row["samples"]
+    }
+    doc = slo_mod.evaluate(max_age_s=0.0)
+    extras["slo_states"] = {
+        name: row["state"] for name, row in doc["slos"].items()
+    }
+    max_pct = float(os.environ.get("BENCH_PROF_MAX_OVERHEAD_PCT", "3.0"))
+    assert overhead_pct < max_pct, (
+        f"profiling overhead {overhead_pct:.2f}% exceeds the {max_pct}% "
         f"budget (p50 on {p50_on:.3f} ms vs off {p50_off:.3f} ms)"
     )
     return round(overhead_pct, 3)
@@ -2297,6 +2503,7 @@ _PHASES = {
     "late_interaction": (phase_late_interaction, 900),
     "observe_overhead": (phase_observe_overhead, 450),
     "tracing_overhead": (phase_tracing_overhead, 450),
+    "profiling_overhead": (phase_profiling_overhead, 450),
     "fault_tolerance": (phase_fault_tolerance, 450),
     "concurrent_serve": (phase_concurrent_serve, 600),
     "sharded_serve": (phase_sharded_serve, 600),
@@ -2408,6 +2615,74 @@ def build_record(state: dict, extras: dict, errors: dict, backends: dict, backen
     return record
 
 
+_trajectory_target: "Optional[tuple]" = None  # (path, round) once resolved
+
+
+def _resolve_trajectory_target() -> tuple:
+    """(path, round) for this RUN's trajectory record, resolved ONCE:
+    ``BENCH_ROUND`` pins the round explicitly; otherwise the next free
+    round after the highest existing ``BENCH_<n>.json`` — a later
+    session's run must never silently overwrite an earlier round's
+    baseline (every streamed emit within one run still rewrites the
+    same file)."""
+    global _trajectory_target
+    if _trajectory_target is not None:
+        return _trajectory_target
+    here = os.path.dirname(os.path.abspath(__file__))
+    round_raw = os.environ.get("BENCH_ROUND")
+    if round_raw:
+        round_no: object = (
+            int(round_raw) if round_raw.isdigit() else round_raw
+        )
+    else:
+        import glob
+        import re as _re
+
+        existing = [
+            int(m.group(1))
+            for p in glob.glob(os.path.join(here, "BENCH_*.json"))
+            for m in [_re.fullmatch(r"BENCH_(\d+)\.json", os.path.basename(p))]
+            if m
+        ]
+        round_no = max(existing) + 1 if existing else 12
+    path = os.environ.get("BENCH_RECORD_FILE") or os.path.join(
+        here, f"BENCH_{round_no}.json"
+    )
+    _trajectory_target = (path, round_no)
+    return _trajectory_target
+
+
+def write_trajectory_record(record: dict, state: dict) -> Optional[str]:
+    """Persist the versioned trajectory record ``BENCH_<round>.json``
+    (ISSUE 12: the bench-trajectory bootstrap).  ``BENCH_ROUND`` pins
+    the round (auto: next free round number); ``BENCH_RECORD_FILE``
+    overrides the path; ``BENCH_RECORD=0`` disables.  Overwritten on
+    every streamed emit so a driver timeout still leaves the latest
+    partial record — ``python -m pathway_tpu.bench_compare
+    BENCH_*.json`` diffs records across rounds and flags >10%
+    regressions."""
+    if os.environ.get("BENCH_RECORD", "1") in ("0", "false", "off"):
+        return None
+    path, round_no = _resolve_trajectory_target()
+    doc = {
+        "schema": 1,
+        "round": round_no,
+        "created_unix": round(time.time(), 1),
+        "phases_measured": sorted(
+            name for name, value in state.items() if value is not None
+        ),
+        **record,
+    }
+    try:
+        with open(path, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    except OSError as exc:  # the record is best-effort, the run is not
+        print(f"[bench] trajectory record write failed: {exc}", file=sys.stderr)
+        return None
+    return path
+
+
 def main() -> None:
     phase = os.environ.get("BENCH_PHASE")
     if phase:
@@ -2434,6 +2709,7 @@ def main() -> None:
         if partial:
             record["partial"] = True
             record["elapsed_s"] = round(time.monotonic() - t_start, 1)
+        write_trajectory_record(record, state)
         print(json.dumps(record), flush=True)
 
     def device_phase(name: str):
@@ -2456,6 +2732,7 @@ def main() -> None:
         ("late_interaction", lambda: device_phase("late_interaction")),
         ("observe_overhead", lambda: device_phase("observe_overhead")),
         ("tracing_overhead", lambda: device_phase("tracing_overhead")),
+        ("profiling_overhead", lambda: device_phase("profiling_overhead")),
         ("fault_tolerance", lambda: device_phase("fault_tolerance")),
         ("concurrent_serve", lambda: device_phase("concurrent_serve")),
         ("sharded_serve", lambda: device_phase("sharded_serve")),
@@ -2468,7 +2745,13 @@ def main() -> None:
         ("rag_eval", lambda: run_phase("rag_eval", "cpu", extras, errors)),
         ("scaling", lambda: device_phase("scaling")),
     ]
+    # BENCH_PHASES=a,b,c runs a subset (trajectory seeding, quick local
+    # A/Bs) — unlisted phases are skipped without an error entry
+    only_raw = os.environ.get("BENCH_PHASES", "").strip()
+    only = {p.strip() for p in only_raw.split(",") if p.strip()} or None
     for name, run in plan:
+        if only is not None and name not in only:
+            continue
         if wall_budget and time.monotonic() - t_start > wall_budget:
             errors[name] = f"skipped: wall budget {wall_budget:.0f}s exhausted"
             continue
@@ -2484,6 +2767,8 @@ def main() -> None:
             extras["observe_overhead_pct"] = round(value, 3)
         elif name == "tracing_overhead" and value is not None:
             extras["tracing_overhead_pct"] = round(value, 3)
+        elif name == "profiling_overhead" and value is not None:
+            extras["profiling_overhead_pct"] = round(value, 3)
         elif name == "fault_tolerance" and value is not None:
             extras["fault_overhead_pct"] = round(value, 3)
         elif name == "concurrent_serve" and value is not None:
@@ -2499,6 +2784,7 @@ def main() -> None:
         emit(partial=True)
 
     record = build_record(state, extras, errors, backends, backend)
+    write_trajectory_record(record, state)
     for k, v in errors.items():
         print(f"[bench] {k} FAILED: {v}", file=sys.stderr)
     print(f"[bench] {record}", file=sys.stderr)

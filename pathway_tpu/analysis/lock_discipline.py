@@ -68,6 +68,7 @@ from .registry import (
     is_handle_fetch,
     is_jit_call,
     is_lock_context,
+    is_observability_callback,
     scope_handle_vars,
     scope_jit_and_device_vars,
     walk_scope,
@@ -254,6 +255,7 @@ class LockDisciplineRule(Rule):
             else:
                 handle = is_handle_fetch(node, handle_vars)
                 cache = is_cache_access(node)
+                obs = is_observability_callback(node)
                 if handle is not None:
                     ctx.report(
                         self.name, node,
@@ -271,4 +273,15 @@ class LockDisciplineRule(Rule):
                         "the cache.get/cache.put chaos sites (delay/hang);"
                         " keep lookups off the serve locks so a cache "
                         "fault wedges only its own request",
+                    )
+                elif obs is not None:
+                    ctx.report(
+                        self.name, node,
+                        f"observability callback `{obs}(...)` under lock "
+                        "— profiler/ledger/SLO sampling is pull-based by "
+                        "design (walks weak registries, fires the "
+                        "profile.sample/hbm.ledger/slo.evaluate chaos "
+                        "sites, may delay or hang); it belongs on "
+                        "scrape/bench threads, never inside a serve-path "
+                        "lock where the walk stalls every admitter",
                     )

@@ -43,6 +43,7 @@ __all__ = [
     "is_cache_wrapper",
     "is_handle_fetch",
     "is_lock_context",
+    "is_observability_callback",
     "scope_handle_vars",
     "scope_jit_and_device_vars",
     "walk_scope",
@@ -77,6 +78,27 @@ _JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
 # hidden-sync fetch/budget checks), or wrapping a launch in a retry
 # would silently launder it out of both rules
 _RETRY_WRAPPERS = {"retry_call"}
+
+# the profiler's instrumentation wrapper (observe/profile.py):
+# ``fn = profile.wrap("site", jitted)`` returns a TRANSPARENT wrapper —
+# calling it IS the dispatch, its result IS a device value.  The
+# compiled-fn caches store their kernels through it, so an assignment
+# from ``profile.wrap(...)`` whose function argument is jitted must bind
+# the target as a jitted callable, or wrapping a kernel for attribution
+# would silently launder it out of every rule (the retry_call lesson).
+_PROFILE_WRAP_RE = re.compile(r"(^|\.)profile\.wrap$|^wrap$")
+
+# observability CALLBACKS (profiler flush/stats, HBM ledger sample, SLO
+# evaluation): pull-based by design — they walk registries, may fire
+# the profile.sample / hbm.ledger / slo.evaluate chaos sites
+# (delay/hang), and belong on scrape/bench threads, NEVER under a
+# serve-path lock where the fault (or just the walk) stalls every
+# admitter.  Matched as <receiver spelled like the observability
+# modules>.<sampling method>.
+_OBS_CALLBACK_METHOD_RE = re.compile(
+    r"^(sample|evaluate|should_shed|profile_stats|ledger_stats|drain)$"
+)
+_OBS_RECEIVER_RE = re.compile(r"(^|_)(profile|hbm|ledger|slo)(_\w+)?$")
 
 # the cache-wrapper convention (pathway_tpu/cache): a function named
 # ``_cached_*`` / ``get_or_*`` wraps a device dispatch behind a cache
@@ -219,6 +241,10 @@ def scope_jit_and_device_vars(
                     # tuple getters return (fn, extras...): only the first
                     # element is the callable
                     jit_fns.add(names[0])
+                elif _is_profile_wrap(value, jit_fns):
+                    # fn = profile.wrap("site", jitted) — the attribution
+                    # wrapper IS the jitted callable for every rule
+                    jit_fns.update(names)
                 elif leaf in jit_fns or callee in jit_fns:
                     device_vars.update(names)
                 elif _is_retry_wrapped_dispatch(value, jit_fns):
@@ -242,6 +268,44 @@ def _is_retry_wrapped_dispatch(call: ast.Call, jit_fns: Set[str]) -> bool:
         if name in jit_fns or name.rsplit(".", 1)[-1] in jit_fns:
             return True
     return False
+
+
+def _is_profile_wrap(call: ast.Call, jit_fns: Set[str]) -> bool:
+    """``profile.wrap("site", fn, ...)`` (or a direct ``jax.jit(...)`` /
+    cache-getter argument) — the profiler's transparent wrapper over a
+    jitted callable."""
+    callee = dotted_name(call.func)
+    if callee is None or not _PROFILE_WRAP_RE.search(callee):
+        return False
+    for arg in call.args:
+        if isinstance(arg, ast.Call) and _is_jit_expr(arg):
+            return True
+        name = dotted_name(arg)
+        if name is None:
+            continue
+        if name in jit_fns or name.rsplit(".", 1)[-1] in jit_fns:
+            return True
+    return False
+
+
+def is_observability_callback(call: ast.Call) -> Optional[str]:
+    """A pull-style observability callback — ``<profile|hbm|slo|
+    ledger>.sample/evaluate/...`` — returns the dotted spelling for the
+    diagnostic, or None.  These walk registries and fire the
+    profile.sample / hbm.ledger / slo.evaluate chaos sites (delay/
+    hang): legal on scrape/bench threads, a lock-discipline finding
+    under any serve-path lock."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if not _OBS_CALLBACK_METHOD_RE.match(func.attr):
+        return None
+    receiver = dotted_name(func.value)
+    if receiver is None:
+        return None
+    if _OBS_RECEIVER_RE.search(receiver.rsplit(".", 1)[-1]):
+        return f"{receiver}.{func.attr}"
+    return None
 
 
 def is_cache_wrapper(scope_name: str) -> bool:

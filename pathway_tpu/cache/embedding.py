@@ -57,6 +57,20 @@ class EmbeddingCache:
             ttl_s=ttl_s,
             max_entries=max_entries,
         )
+        # HBM ledger (observe/hbm.py): the cached rows are DEVICE
+        # arrays, so the tier's byte accounting IS resident HBM; the
+        # byte budget doubles as the exhaustion-ETA capacity
+        from ..observe import hbm
+
+        hbm.track(
+            "cache", self, lambda c: {"embedding_rows": c._tier.bytes}
+        )
+        hbm.track_resource(
+            "embedding_cache_bytes",
+            self,
+            lambda c: c._tier.bytes,
+            lambda c: c._tier.max_bytes,
+        )
 
     @property
     def stats(self):

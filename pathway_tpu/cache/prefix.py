@@ -58,6 +58,20 @@ class PrefixKVCache:
         from .. import observe
 
         observe.register_provider(self)
+        # HBM ledger (observe/hbm.py): prefill K/V blocks are device
+        # arrays — the tier's byte accounting is resident HBM, and the
+        # byte budget is the exhaustion-ETA capacity
+        from ..observe import hbm
+
+        hbm.track(
+            "cache", self, lambda c: {"prefill_blocks": c._tier.bytes}
+        )
+        hbm.track_resource(
+            "prefill_cache_bytes",
+            self,
+            lambda c: c._tier.bytes,
+            lambda c: c._tier.max_bytes,
+        )
 
     @property
     def stats(self):

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..observe import hbm, profile
 from ..ops.dispatch_counter import record_dispatch, record_fetch
 from ..ops.maxsim import (
     build_maxsim_kernel,
@@ -187,6 +188,15 @@ class ForwardIndex:
         }
         self._observe_id = observe.next_id()
         observe.register_provider(self)
+        # HBM ledger (observe/hbm.py): the row buckets' allocated bytes,
+        # plus capacity-exhaustion tracking from the observed absorb rate
+        hbm.track("forward", self, lambda ix: {"rows": ix.hbm_bytes()})
+        hbm.track_resource(
+            "forward_rows",
+            self,
+            lambda ix: len(ix),
+            lambda ix: ix._tok.shape[0] if ix._tok is not None else 0,
+        )
 
     # -- introspection ------------------------------------------------------
     def __len__(self) -> int:
@@ -271,6 +281,7 @@ class ForwardIndex:
                 q = pooled
             return q, scales, nvalid, pooled
 
+        fn = profile.wrap("forward.pool", fn)
         self._fns[key] = fn
         return fn
 
@@ -314,6 +325,7 @@ class ForwardIndex:
             diff = jnp.where(both, jnp.abs(sf - sq), 0.0)
             return jnp.sum(diff) / jnp.maximum(jnp.sum(both), 1)
 
+        fn = profile.wrap("forward.audit", fn)
         self._fns[key] = fn
         return fn
 
@@ -329,6 +341,8 @@ class ForwardIndex:
         fn = build_maxsim_kernel(
             B, Lq, Kc, self.tokens_per_doc, k_out, self.quant == "int8"
         )
+        # device-time attribution (observe/profile.py)
+        fn = profile.wrap("forward.maxsim", fn)
         self._fns[key] = fn
         return fn
 
@@ -839,8 +853,11 @@ class ShardedForwardIndex:
             fn = self._fns.get(key)
             if fn is None:
                 self._tripwire.observe(key)
-                fn = self._fns[key] = build_maxsim_table_kernel(
-                    B, Lq, Kc, self.tokens_per_doc, self.quant == "int8"
+                fn = self._fns[key] = profile.wrap(
+                    "forward.table",
+                    build_maxsim_table_kernel(
+                        B, Lq, Kc, self.tokens_per_doc, self.quant == "int8"
+                    ),
                 )
             return fn
 
@@ -850,7 +867,10 @@ class ShardedForwardIndex:
             fn = self._fns.get(key)
             if fn is None:
                 self._tripwire.observe(key)
-                fn = self._fns[key] = build_table_merge_kernel(S, B, Kc, k_out)
+                fn = self._fns[key] = profile.wrap(
+                    "forward.table_merge",
+                    build_table_merge_kernel(S, B, Kc, k_out),
+                )
             return fn
 
     # -- serve-path gather --------------------------------------------------

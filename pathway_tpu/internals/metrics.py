@@ -13,9 +13,12 @@ This is the ONE metrics surface: alongside the engine/connector series,
 ``pathway_ivf_*`` index gauges, ``pathway_recompile_*`` compile census,
 ``pathway_exchange_*`` plane counters), ``/serve_stats`` serves the
 same recorder as a JSON summary (histogram quantile estimates + the
-recent-event ring), and ``/traces`` serves the tail-sampled per-request
+recent-event ring), ``/traces`` serves the tail-sampled per-request
 span trees (``pathway_tpu/observe/trace.py``) that the histogram
-exemplars on ``/metrics`` link to (``?limit=N`` caps the payload).
+exemplars on ``/metrics`` link to (``?limit=N`` caps the payload), and
+``/slo`` serves the burn-rate document from the declarative SLO engine
+(``pathway_tpu/observe/slo.py`` — per-objective fast/slow-window burn
+rates, alert state, and the advisory shed verdict).
 
 Scrape consistency: the engine graph's operator/table collections are
 snapshotted (and each operator's counters read once) BEFORE any line is
@@ -221,6 +224,15 @@ class MetricsServer:
                     from .. import observe
 
                     body = json.dumps(observe.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/slo"):
+                    # declarative SLO burn-rate document (observe/slo.py):
+                    # per-objective multi-window burn rates + alert state.
+                    # The chaos contract inside evaluate() makes this
+                    # stale-on-fault, never a 500.
+                    from ..observe import slo as _slo
+
+                    body = json.dumps(_slo.evaluate()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/traces"):
                     # kept (tail-sampled) per-request span trees — the

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
-from ..observe import trace
+from ..observe import hbm, profile, trace
 from ..ops.recompile_guard import RecompileTripwire
 from ..robust import Deadline, inject, retry_call
 from ._params import unbox as _unbox
@@ -102,6 +102,8 @@ class CrossEncoderModel:
         mask = jnp.ones((1, 16), jnp.int32)
         self.params = self.module.init(jax.random.PRNGKey(seed), ids, mask)["params"]
         self.params = _unbox(self.params)
+        # HBM ledger (observe/hbm.py): parameter tree bytes
+        hbm.track_params("cross_encoder", self)
 
     def _forward_fn(self, shape):
         fn = self._fns.get(shape)
@@ -119,6 +121,8 @@ class CrossEncoderModel:
                         {"params": params}, ids, mask
                     )
                 )
+            # device-time attribution (observe/profile.py)
+            fn = profile.wrap("cross_encoder.forward", fn)
             self._fns[shape] = fn
         return fn
 
@@ -265,6 +269,7 @@ class CrossEncoderModel:
                     n_segments=S,
                 )  # [R, S] per-segment pair scores
 
+            fn = profile.wrap("cross_encoder.packed", fn)
             self._fns[key] = fn
         return self._fns[key]
 

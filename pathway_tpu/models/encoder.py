@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
-from ..observe import trace
+from ..observe import hbm, profile, trace
 from ..ops.recompile_guard import RecompileTripwire
 from ..robust import retry_call
 from ._params import unbox as _unbox
@@ -114,6 +114,10 @@ class SentenceEncoder:
             self.params = jax.device_put(
                 self.params, NamedSharding(mesh, P())
             )
+        # HBM ledger (observe/hbm.py): the parameter tree is usually the
+        # single largest resident allocation — without it the ledger's
+        # device cross-check cannot balance
+        hbm.track_params("encoder", self)
 
     def _load_checkpoint(self, path: str):
         import orbax.checkpoint as ocp
@@ -164,6 +168,8 @@ class SentenceEncoder:
                         )
                     return out
 
+            # device-time attribution (observe/profile.py)
+            fn = profile.wrap("encoder.forward", fn)
             self._fns[key] = fn
         return self._fns[key]
 
@@ -373,6 +379,8 @@ class SentenceEncoder:
                 hidden = trunk.apply({"params": params}, ids, mask)
                 return normalized_token_states(hidden, mask)
 
+            # device-time attribution (observe/profile.py)
+            fn = profile.wrap("encoder.token_states", fn)
             self._fns[key] = fn
         return self._fns[key]
 
@@ -429,6 +437,7 @@ class SentenceEncoder:
                     )
                 return out
 
+            fn = profile.wrap("encoder.packed", fn)
             self._fns[key] = fn
         return self._fns[key]
 

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
+from ..observe import hbm, profile
 from ..robust import retry_call
 from ._params import unbox as _unbox
 
@@ -157,6 +158,8 @@ class TextGenerator:
         self._use_kv = os.environ.get("PATHWAY_GENERATOR_KV", "1") not in (
             "0", "false", "off",
         )
+        # HBM ledger (observe/hbm.py): parameter tree bytes
+        hbm.track_params("generator", self)
 
     # -- legacy full re-attend decode (parity reference / fallback) ----------
     def _decode_fn(self, B: int, L: int, steps: int):
@@ -226,7 +229,8 @@ class TextGenerator:
                 )
                 return toks.T, ids_f, mask_f, pos_f, rng_f, fin_f
 
-            fn = jax.jit(decode)
+            # device-time attribution (observe/profile.py)
+            fn = profile.wrap("generator.decode", jax.jit(decode))
             self._fns[key] = fn
         return fn
 
@@ -344,7 +348,7 @@ class TextGenerator:
             )
             return toks.T, kbuf, vbuf  # toks [B, steps]
 
-        fn = jax.jit(run)
+        fn = profile.wrap("generator.kv_decode", jax.jit(run))
         self._fns[key] = fn
         return fn
 
@@ -447,7 +451,7 @@ class TextGenerator:
             pool_v = pool_v.at[slots].set(vbuf)
             return pool_k, pool_v, toks.astype(jnp.int32), rngs
 
-        fn = jax.jit(prefill)
+        fn = profile.wrap("generator.slot_prefill", jax.jit(prefill))
         self._fns[key] = fn
         return fn
 
@@ -524,7 +528,7 @@ class TextGenerator:
             )
             return pool_k, pool_v, rngs, em
 
-        fn = jax.jit(run)
+        fn = profile.wrap("generator.slot_step", jax.jit(run))
         self._fns[key] = fn
         return fn
 

@@ -40,10 +40,20 @@ style span trees across the coalescing scheduler, shards, cascade
 stages and cache tiers, tail-sampled into a bounded kept store served
 on ``GET /traces``, with kept-trace exemplars stamped onto the
 histogram buckets above.
+
+``profile`` / ``hbm`` / ``slo`` (round 15) are the attribution layer:
+sampled submit→ready device time per compiled callable
+(``pathway_profile_*``), a pull-based HBM ledger cross-checked against
+the backend's own byte accounting (``pathway_hbm_*``), and declarative
+SLOs evaluated with multi-window burn-rate math (``pathway_slo_*`` +
+``GET /slo`` + the scheduler's advisory ``should_shed`` probe).
 """
 
 from .histogram import EventRing, LatencyHistogram, N_BUCKETS, bucket_bounds_s
 from . import trace
+from . import profile
+from . import hbm
+from . import slo
 from .recorder import (
     Counter,
     Gauge,
@@ -75,14 +85,17 @@ __all__ = [
     "emit_span",
     "enabled",
     "gauge",
+    "hbm",
     "histogram",
     "next_id",
+    "profile",
     "record_event",
     "record_occupancy",
     "register_provider",
     "render_prometheus",
     "reset",
     "set_enabled",
+    "slo",
     "snapshot",
     "trace",
 ]

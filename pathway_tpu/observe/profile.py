@@ -1,0 +1,372 @@
+"""Per-callable device-time attribution — which compiled kernel owns
+our device seconds.
+
+The stage histograms (recorder.py) answer "where does a REQUEST spend
+time"; the traces (trace.py) answer it per request.  Neither answers
+the capacity-planning question: across everything the process runs,
+which COMPILED CALLABLE owns the device, and what share of wall time
+is it?  That attribution is the prerequisite for every kernel-level
+optimization (speculative decode, quantized KV slots — the "Accelerating
+RAG" observation that e2e cost concentrates in a few stages) and the
+input the SLO engine's capacity math wants.
+
+Design, in the package's cost order:
+
+- **Sampling at the wrapper, timing at the fetch.**  Every compiled-fn
+  cache in the serve stack stores its jitted callable through
+  ``profile.wrap(site, fn)``.  The wrapper is transparent: it calls the
+  underlying function and returns its (async, un-fetched) result.  On a
+  SAMPLED call it stamps submit time, hands the first output leaf to a
+  background completer thread, and returns immediately — the completer
+  blocks on ``block_until_ready`` OFF the serve path, so the measured
+  interval is submit→ready (device queue + execution) without ever
+  adding a sync to a dispatch.  The 2+2 budget and the off-lock launch
+  discipline are untouched by construction: nothing is fetched on the
+  calling thread.
+- **Zero-alloc when off.**  Disabled (``PATHWAY_OBSERVE=0``) or sampled
+  out, the wrapper is one flag check + one modulo on a pre-resolved
+  per-site record — no allocation, no clock read.
+  ``PATHWAY_PROFILE_SAMPLE`` (default 0.25) sets the sampled fraction;
+  sampling is a deterministic 1-in-N stride, so overhead is flat and
+  replayable.
+- **Degrade, never fail.**  The ``profile.sample`` chaos site
+  (robust/inject.py) fires on the sampling path under an already-spent
+  deadline: ANY armed fault — raise, delay, hang — drops that sample
+  (counted on ``pathway_profile_samples_dropped_total``) and the serve
+  proceeds untouched.  A full pending queue, a deleted/donated buffer,
+  a completer error: same contract, drop + count.
+
+Rendered under ``pathway_profile_*``: per-callable device-seconds
+histograms (``pathway_profile_device_seconds{callable=...}``, whose
+``_sum`` IS the attributed device seconds), sampled-call counters, and
+share-of-wall gauges (``pathway_profile_device_share`` = attributed
+device seconds / wall seconds since the window started, corrected for
+the sampling fraction).  ``/serve_stats`` carries the same attribution
+as a ``profile`` column.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import _state
+from .histogram import LatencyHistogram
+from .recorder import counter, histogram
+
+__all__ = [
+    "profile_stats",
+    "reset",
+    "sample_stride",
+    "set_sample",
+    "wrap",
+]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)) or default)
+    except ValueError:
+        return default
+
+
+def _stride_of(fraction: float) -> int:
+    """Sampled fraction -> deterministic 1-in-N stride (0 = off)."""
+    if fraction <= 0.0:
+        return 0
+    if fraction >= 1.0:
+        return 1
+    return max(1, int(round(1.0 / fraction)))
+
+
+_stride = _stride_of(
+    min(1.0, max(0.0, _env_float("PATHWAY_PROFILE_SAMPLE", 0.25)))
+)
+
+_C_DROPPED = counter("pathway_profile_samples_dropped_total")
+
+# pending submit→ready samples awaiting the completer: a small bounded
+# buffer — device work is serialized per stream, so a handful of
+# in-flight samples covers any realistic pipeline depth; past capacity
+# we drop (counted) rather than grow or block
+_PENDING_CAP = 64
+# (site, t0_ns, output leaf, stride in effect when sampled)
+_pending: List[Tuple["_Site", int, Any, int]] = []
+_pending_cv = threading.Condition()
+_inflight = 0  # popped by the completer, not yet recorded (drain() waits)
+_completer: Optional[threading.Thread] = None
+
+# wall-clock anchor for the share-of-wall gauges (perf_counter_ns so it
+# shares the clock the samples use); reset() re-anchors
+_wall_t0_ns = time.perf_counter_ns()
+
+_sites_lock = threading.Lock()
+_sites: Dict[str, "_Site"] = {}
+
+# lazy robust import (robust/ imports the observe package)
+_inject_mod: Any = None
+
+
+def _inject():
+    global _inject_mod
+    if _inject_mod is None:
+        try:
+            from ..robust import inject as mod
+        except Exception:  # pragma: no cover - partial teardown
+            return None
+        _inject_mod = mod
+    return _inject_mod
+
+
+def _sample_allowed() -> bool:
+    """Chaos gate for the sampling path (site ``profile.sample``): True
+    = sample normally.  Fired under an already-spent deadline so an
+    armed hang releases immediately and an armed delay is clamped to
+    ~10 ms — the serve is never slowed by its own profiler."""
+    inj = _inject()
+    if inj is None or not inj.any_armed():
+        return True
+    try:
+        from ..robust.deadline import Deadline
+
+        before = inj.fired_count("profile.sample")
+        inj.fire("profile.sample", deadline=Deadline.after_ms(0.0))
+        return inj.fired_count("profile.sample") == before
+    except Exception:
+        return False
+
+
+class _Site:
+    """Per-callable attribution record, resolved once at wrap time so
+    the per-call cost is attribute reads on this object."""
+
+    __slots__ = (
+        "name", "calls", "device_ns", "weighted_ns", "hist", "sampled",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0  # plain int bump (GIL-atomic enough for a stride)
+        self.device_ns = 0  # accumulated submit→ready ns (sampled calls)
+        # stride-weighted accumulator for share-of-wall: each sample
+        # adds dt × (the stride IN EFFECT when it was taken), so the
+        # estimate stays right across set_sample() flips (the bench A/B
+        # restores the env stride before reading the attribution)
+        self.weighted_ns = 0
+        self.hist: LatencyHistogram = histogram(
+            "pathway_profile_device_seconds", callable=name
+        )
+        self.sampled = counter("pathway_profile_samples_total", callable=name)
+
+
+def _site(name: str) -> _Site:
+    with _sites_lock:
+        st = _sites.get(name)
+        if st is None:
+            st = _sites[name] = _Site(name)
+        return st
+
+
+def _first_leaf(out: Any) -> Any:
+    """First array-like leaf of a jitted call's output (the object the
+    completer blocks on — one output of a dispatch is ready iff the
+    whole dispatch is)."""
+    seen = 0
+    stack = [out]
+    while stack and seen < 16:
+        x = stack.pop()
+        seen += 1
+        if hasattr(x, "block_until_ready"):
+            return x
+        if isinstance(x, (tuple, list)):
+            stack.extend(reversed(x))
+        elif isinstance(x, dict):
+            stack.extend(reversed(list(x.values())))
+    return None
+
+
+def _completer_loop() -> None:  # pragma: no cover - exercised via wrap()
+    global _inflight
+    while True:
+        with _pending_cv:
+            while not _pending:
+                _pending_cv.wait()
+            st, t0_ns, leaf, stride = _pending.pop(0)
+            _inflight += 1
+        try:
+            try:
+                leaf.block_until_ready()
+            except Exception:
+                # deleted/donated buffer, backend teardown: the sample
+                # is unrecoverable — drop it, never surface the error
+                _C_DROPPED.inc()
+                continue
+            dt = time.perf_counter_ns() - t0_ns
+            st.hist.observe_ns(dt)
+            st.device_ns += dt
+            st.weighted_ns += dt * max(1, stride)
+            st.sampled.inc()
+        finally:
+            with _pending_cv:
+                _inflight -= 1
+                _pending_cv.notify_all()
+
+
+def _enqueue(st: _Site, t0_ns: int, out: Any, stride: int) -> None:
+    """Queue one sampled call for completion; every failure mode drops
+    the sample (counted) and returns — the caller's serve result is
+    already in hand and is never touched."""
+    global _completer
+    try:
+        if not _sample_allowed():
+            _C_DROPPED.inc()
+            return
+        leaf = _first_leaf(out)
+        if leaf is None:
+            _C_DROPPED.inc()
+            return
+        with _pending_cv:
+            if len(_pending) >= _PENDING_CAP:
+                _C_DROPPED.inc()
+                return
+            if _completer is None or not _completer.is_alive():
+                _completer = threading.Thread(
+                    target=_completer_loop, daemon=True, name="pw-profile"
+                )
+                _completer.start()
+            _pending.append((st, t0_ns, leaf, stride))
+            _pending_cv.notify()
+    except Exception:
+        try:
+            _C_DROPPED.inc()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
+
+def wrap(site: str, fn: Callable) -> Callable:
+    """Instrument one compiled callable for device-time attribution.
+
+    Called at compiled-fn-cache creation time (the ``_fns[key] =
+    profile.wrap(site, fused)`` idiom), so steady-state calls pay only
+    the sampling check.  The wrapper is transparent — same args, same
+    (async) result — and the analyzer registry treats an assignment from
+    ``profile.wrap(site, jitted)`` as binding a jitted callable, so the
+    lock-discipline/hidden-sync rules see straight through it."""
+    st = _site(site)
+
+    def profiled(*args: Any, **kwargs: Any):
+        # one read of the module global: a concurrent set_sample(0)
+        # between a two-read guard and modulo would divide by zero INTO
+        # the serve path
+        stride = _stride
+        if not _state.enabled or stride == 0:
+            return fn(*args, **kwargs)
+        st.calls += 1
+        if st.calls % stride:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter_ns()
+        out = fn(*args, **kwargs)
+        _enqueue(st, t0, out, stride)
+        return out
+
+    profiled.__wrapped__ = fn
+    profiled.profile_site = site
+    return profiled
+
+
+def set_sample(fraction: float) -> None:
+    """Sampled fraction of calls (also ``PATHWAY_PROFILE_SAMPLE``):
+    1.0 = every call, 0.0 = profiler off (the bench A/B switch)."""
+    global _stride
+    _stride = _stride_of(min(1.0, max(0.0, float(fraction))))
+
+
+def sample_stride() -> int:
+    """Current 1-in-N sampling stride (0 = off) — tests/bench probe."""
+    return _stride
+
+
+def drain(timeout_s: float = 2.0) -> bool:
+    """Block until every enqueued sample has been RECORDED — queue empty
+    AND nothing popped-but-unfinished in the completer (tests/bench:
+    make every sample visible before reading stats)."""
+    deadline = time.monotonic() + timeout_s
+    with _pending_cv:
+        while _pending or _inflight:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            _pending_cv.wait(timeout=min(left, 0.05))
+    return True
+
+
+def profile_stats() -> Dict[str, Dict[str, float]]:
+    """Per-callable attribution snapshot — the ``/serve_stats``
+    ``profile`` column: sampled calls, attributed device seconds, and
+    the share-of-wall estimate (sampling-fraction corrected)."""
+    wall_s = max((time.perf_counter_ns() - _wall_t0_ns) * 1e-9, 1e-9)
+    with _sites_lock:
+        sites = list(_sites.values())
+    out: Dict[str, Dict[str, float]] = {}
+    for st in sites:
+        dev_s = st.device_ns * 1e-9
+        out[st.name] = {
+            "calls": st.calls,
+            "samples": st.hist.count,
+            "device_s": dev_s,
+            # weighted_ns already carries each sample's own stride, so
+            # the estimate survives set_sample() flips mid-window
+            "share_of_wall": min(1.0, st.weighted_ns * 1e-9 / wall_s),
+            "p50_s": st.hist.quantile_s(0.50) or 0.0,
+            "p99_s": st.hist.quantile_s(0.99) or 0.0,
+        }
+    return out
+
+
+class _Provider:
+    """Scrape-time gauges (flight-recorder provider): the histograms
+    and counters render through the registry already; the provider adds
+    the derived share-of-wall gauges."""
+
+    def observe_metrics(self):
+        for name, row in profile_stats().items():
+            labels = {"callable": name}
+            yield (
+                "gauge",
+                "pathway_profile_device_share",
+                labels,
+                row["share_of_wall"],
+            )
+            yield (
+                "gauge",
+                "pathway_profile_calls",
+                labels,
+                row["calls"],
+            )
+
+
+_provider = _Provider()  # module-global: stays alive for the weak registry
+
+
+def _register_provider() -> None:
+    from .recorder import register_provider
+
+    register_provider(_provider)
+
+
+_register_provider()
+
+
+def reset() -> None:
+    """Zero the attribution window: per-site accumulators and the wall
+    anchor (the registered histogram/counter series stay attached —
+    recorder.reset() zeroes those)."""
+    global _wall_t0_ns
+    with _sites_lock:
+        for st in _sites.values():
+            st.device_ns = 0
+            st.weighted_ns = 0
+            st.calls = 0
+    _wall_t0_ns = time.perf_counter_ns()

@@ -530,6 +530,25 @@ def snapshot() -> Dict[str, Any]:
             rest = tuple((lk, lv) for lk, lv in key if lk != "generator")
             generators.setdefault(gen, {})[series_name(name, rest)] = value
     events, total = _ring.snapshot()
+    # the profile column: per-callable device-time attribution from the
+    # sampling profiler (observe/profile.py — lazy import: profile
+    # resolves its series through this module).  hbm and slo ride along:
+    # the ledger sample and the current burn-rate document, so one
+    # /serve_stats read answers "who owns device time, who owns HBM,
+    # are we in budget" together
+    profile_col: Dict[str, Any] = {}
+    hbm_col: Dict[str, Any] = {}
+    slo_col: Dict[str, Any] = {}
+    try:
+        from . import hbm as _hbm
+        from . import profile as _profile
+        from . import slo as _slo
+
+        profile_col = _profile.profile_stats()
+        hbm_col = _hbm.ledger_stats()
+        slo_col = _slo.evaluate()
+    except Exception:  # pragma: no cover - partial teardown
+        pass
     return {
         "enabled": _state.enabled,
         "rings": {
@@ -542,6 +561,9 @@ def snapshot() -> Dict[str, Any]:
         "shards": {k: shards[k] for k in sorted(shards, key=_shard_sort_key)},
         "caches": {k: caches[k] for k in sorted(caches)},
         "generators": {k: generators[k] for k in sorted(generators)},
+        "profile": profile_col,
+        "hbm": hbm_col,
+        "slo": slo_col,
         "events": [
             {
                 "ts": e[0],

@@ -1,0 +1,469 @@
+"""Declarative SLOs with multi-window burn-rate evaluation — "are we
+inside our objectives RIGHT NOW", answered from the histograms and
+counters the serve stack already maintains.
+
+An ``SloSpec`` declares an objective over existing series — no new
+instrumentation, no second bookkeeping path:
+
+- ``kind="latency"``: fraction of events in a recorder histogram at or
+  under ``threshold_s`` (the threshold snaps UP to the histogram's
+  power-of-two bucket bounds; the effective value is reported).  The
+  default serve spec reads ``pathway_serve_request_seconds`` — the same
+  family the trace tail-sampler and the exemplars ride — and the decode
+  spec reads ``pathway_generator_ttlt_seconds``.
+- ``kind="availability"``: 1 − degraded fraction, with bad events from
+  a counter family (summed over label sets: every ladder rung counts)
+  and totals from a histogram family's event count.
+
+Evaluation is the standard SRE burn-rate construction: the error budget
+is ``1 − objective``; the burn rate over a window is the window's error
+ratio divided by the budget (burn 1.0 = spending exactly the budget);
+the alert fires when BOTH a fast and a slow window burn above the
+threshold — fast for responsiveness, slow so a transient blip can't
+page.  Windows are measured by snapshotting the cumulative
+(good, total) counts at each evaluation and differencing against the
+ring of past snapshots, so the engine needs no timers of its own: the
+scrape (or ``GET /slo``, or the scheduler's ``should_shed`` probe)
+drives it, throttled to at most one fresh evaluation per
+``PATHWAY_SLO_TICK_S``.
+
+Knobs: ``PATHWAY_SLO_LATENCY_MS`` / ``PATHWAY_SLO_LATENCY_OBJECTIVE``,
+``PATHWAY_SLO_AVAILABILITY``, ``PATHWAY_SLO_TTLT_MS``,
+``PATHWAY_SLO_FAST_WINDOW_S`` / ``PATHWAY_SLO_SLOW_WINDOW_S``,
+``PATHWAY_SLO_BURN`` (threshold, default 14.4 — the classic 2%-of-
+budget-in-an-hour page), ``PATHWAY_SLO_TICK_S``, ``PATHWAY_SLO=0`` to
+disable the scheduler's shed advisory.
+
+``should_shed()`` is the seam the scheduler consumes: True while any
+``shed=True`` spec is firing.  This PR wires it ADVISORY-ONLY (logged +
+counted, never acted on); ROADMAP item 2's backpressure/admission and
+item 3's failover take it from here.
+
+Degrade-never-fail: the ``slo.evaluate`` chaos site fires at the top of
+a fresh evaluation under a spent deadline — any armed fault serves the
+last-known (stale) document, counted on
+``pathway_slo_evaluations_dropped_total``; ``GET /slo`` never 500s and
+``should_shed`` never blocks a serve.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .histogram import N_BUCKETS, bucket_bounds_s
+from .recorder import counter, register_provider
+from . import recorder as _recorder
+
+__all__ = [
+    "SloSpec",
+    "default_specs",
+    "engine",
+    "evaluate",
+    "reset",
+    "should_shed",
+    "shed_advisory_enabled",
+]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)) or default)
+    except ValueError:
+        return default
+
+
+def _truthy(raw: Optional[str], default: bool = True) -> bool:
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+_C_EVALS = counter("pathway_slo_evaluations_total")
+_C_DROPPED = counter("pathway_slo_evaluations_dropped_total")
+_C_SHED_ADVISED = counter("pathway_slo_shed_advised_total")
+
+_inject_mod: Any = None
+
+
+def _inject():
+    global _inject_mod
+    if _inject_mod is None:
+        try:
+            from ..robust import inject as mod
+        except Exception:  # pragma: no cover - partial teardown
+            return None
+        _inject_mod = mod
+    return _inject_mod
+
+
+def _evaluate_allowed() -> bool:
+    inj = _inject()
+    if inj is None or not inj.any_armed():
+        return True
+    try:
+        from ..robust.deadline import Deadline
+
+        before = inj.fired_count("slo.evaluate")
+        inj.fire("slo.evaluate", deadline=Deadline.after_ms(0.0))
+        return inj.fired_count("slo.evaluate") == before
+    except Exception:
+        return False
+
+
+# -- reading the recorder's registry ----------------------------------------
+def _family_hist_counts(name: str) -> Tuple[List[int], int]:
+    """Merged per-bucket counts + total event count over every label set
+    of one recorder histogram family."""
+    with _recorder._registry_lock:
+        series = list(_recorder._hists.get(name, {}).values())
+    counts = [0] * N_BUCKETS
+    total = 0
+    for h in series:
+        c, _sum_ns, n = h.snapshot()
+        for i, v in enumerate(c):
+            counts[i] += v
+        total += n
+    return counts, total
+
+
+def _family_counter_total(name: str) -> int:
+    """Sum over every label set of one recorder counter family."""
+    with _recorder._registry_lock:
+        series = list(_recorder._counters.get(name, {}).values())
+    return sum(c.value for c in series)
+
+
+def _good_under_threshold(name: str, threshold_s: float) -> Tuple[int, int, float]:
+    """(good, total, effective_threshold_s) for a latency objective:
+    good = events whose bucket's upper bound is <= the snapped
+    threshold (snapped UP to the next power-of-two bound, so "under
+    500 ms" means "under 537 ms" on this histogram — reported, not
+    hidden)."""
+    bounds = bucket_bounds_s()
+    cut = len(bounds) - 1
+    for i, b in enumerate(bounds):
+        if b >= threshold_s:
+            cut = i
+            break
+    counts, total = _family_hist_counts(name)
+    good = sum(counts[: cut + 1])
+    return good, total, bounds[cut]
+
+
+class SloSpec:
+    """One declarative objective.  ``kind``:
+
+    - ``"latency"``: ``hist`` (family name) + ``threshold_s``; good =
+      events at or under the threshold.
+    - ``"availability"``: ``bad`` (counter family) + ``total_hist``
+      (histogram family whose count is the event total); good = total −
+      bad (clamped).
+    """
+
+    __slots__ = (
+        "name", "kind", "objective", "hist", "threshold_s", "bad",
+        "total_hist", "shed", "description",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        objective: float,
+        hist: Optional[str] = None,
+        threshold_s: Optional[float] = None,
+        bad: Optional[str] = None,
+        total_hist: Optional[str] = None,
+        shed: bool = False,
+        description: str = "",
+    ):
+        if kind not in ("latency", "availability"):
+            raise ValueError(f"unknown SLO kind {kind!r}")
+        if kind == "latency" and (hist is None or threshold_s is None):
+            raise ValueError("latency spec needs hist + threshold_s")
+        if kind == "availability" and (bad is None or total_hist is None):
+            raise ValueError("availability spec needs bad + total_hist")
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1): {objective}")
+        self.name = str(name)
+        self.kind = kind
+        self.objective = float(objective)
+        self.hist = hist
+        self.threshold_s = threshold_s
+        self.bad = bad
+        self.total_hist = total_hist
+        self.shed = bool(shed)
+        self.description = description
+
+    def counts(self) -> Tuple[int, int, Optional[float]]:
+        """Cumulative (good, total, effective_threshold_s | None)."""
+        if self.kind == "latency":
+            return _good_under_threshold(self.hist, float(self.threshold_s))
+        total = _family_hist_counts(self.total_hist)[1]
+        bad = min(_family_counter_total(self.bad), total)
+        return total - bad, total, None
+
+
+def default_specs() -> List[SloSpec]:
+    """The shipped objectives, env-tunable.  Serve latency and
+    availability carry ``shed=True`` — they are the admission seams
+    ROADMAP item 2 will act on; decode TTLT is observe-only."""
+    return [
+        SloSpec(
+            "serve_latency",
+            "latency",
+            objective=min(
+                0.9999,
+                max(0.5, _env_float("PATHWAY_SLO_LATENCY_OBJECTIVE", 0.99)),
+            ),
+            hist="pathway_serve_request_seconds",
+            threshold_s=_env_float("PATHWAY_SLO_LATENCY_MS", 500.0) * 1e-3,
+            shed=True,
+            description="serve requests at/under the latency threshold",
+        ),
+        SloSpec(
+            "serve_availability",
+            "availability",
+            objective=min(
+                0.9999,
+                max(0.5, _env_float("PATHWAY_SLO_AVAILABILITY", 0.999)),
+            ),
+            bad="pathway_serve_degraded_total",
+            total_hist="pathway_serve_request_seconds",
+            shed=True,
+            description="1 - degraded fraction (every ladder rung counts)",
+        ),
+        SloSpec(
+            "decode_ttlt",
+            "latency",
+            objective=0.99,
+            hist="pathway_generator_ttlt_seconds",
+            threshold_s=_env_float("PATHWAY_SLO_TTLT_MS", 2000.0) * 1e-3,
+            description="decode requests at/under the TTLT threshold",
+        ),
+    ]
+
+
+class SloEngine:
+    """Burn-rate evaluator over a spec list.  Each evaluation appends
+    one cumulative (t, good, total) snapshot per spec to a bounded ring
+    and differences against the oldest snapshot inside each window."""
+
+    _RING = 512
+
+    def __init__(self, specs: Optional[List[SloSpec]] = None):
+        self.specs = list(specs) if specs is not None else default_specs()
+        self.fast_window_s = max(
+            0.05, _env_float("PATHWAY_SLO_FAST_WINDOW_S", 300.0)
+        )
+        self.slow_window_s = max(
+            self.fast_window_s, _env_float("PATHWAY_SLO_SLOW_WINDOW_S", 3600.0)
+        )
+        self.burn_threshold = max(0.1, _env_float("PATHWAY_SLO_BURN", 14.4))
+        self.tick_s = max(0.0, _env_float("PATHWAY_SLO_TICK_S", 1.0))
+        self._lock = threading.Lock()
+        self._rings: Dict[str, List[Tuple[float, int, int]]] = {
+            s.name: [] for s in self.specs
+        }
+        self._last_doc: Optional[Dict[str, Any]] = None
+        self._last_eval_s = 0.0
+
+    # -- window math --------------------------------------------------------
+    def _window_ratio(
+        self, ring: List[Tuple[float, int, int]], now_s: float, window_s: float
+    ) -> Tuple[float, int]:
+        """(error_ratio, total_delta) over the window ending now.  The
+        baseline is the OLDEST snapshot inside the window (standard
+        burn-rate semantics: with history shorter than the window, the
+        available history stands in for it)."""
+        if not ring:
+            return 0.0, 0
+        t_now, good_now, total_now = ring[-1]
+        base = ring[0]
+        for snap in ring:
+            if snap[0] >= now_s - window_s:
+                base = snap
+                break
+        _t0, good0, total0 = base
+        total_delta = total_now - total0
+        if total_delta <= 0:
+            return 0.0, 0
+        bad_delta = max(0, (total_now - good_now) - (total0 - good0))
+        return min(1.0, bad_delta / total_delta), total_delta
+
+    def _evaluate_fresh(self, now_s: float) -> Dict[str, Any]:
+        _C_EVALS.inc()
+        slos: Dict[str, Any] = {}
+        any_firing = False
+        shed = False
+        for spec in self.specs:
+            good, total, eff_threshold = spec.counts()
+            ring = self._rings[spec.name]
+            ring.append((now_s, good, total))
+            if len(ring) > self._RING:
+                del ring[: len(ring) - self._RING]
+            budget = 1.0 - spec.objective
+            windows: Dict[str, Any] = {}
+            burns: Dict[str, float] = {}
+            for label, window_s in (
+                ("fast", self.fast_window_s),
+                ("slow", self.slow_window_s),
+            ):
+                ratio, events = self._window_ratio(ring, now_s, window_s)
+                burn = ratio / budget if budget > 0 else 0.0
+                burns[label] = burn
+                windows[label] = {
+                    "window_s": window_s,
+                    "error_ratio": round(ratio, 6),
+                    "burn_rate": round(burn, 3),
+                    "events": events,
+                }
+            firing = (
+                windows["fast"]["events"] > 0
+                and burns["fast"] >= self.burn_threshold
+                and burns["slow"] >= self.burn_threshold
+            )
+            any_firing = any_firing or firing
+            shed = shed or (firing and spec.shed)
+            row = {
+                "kind": spec.kind,
+                "objective": spec.objective,
+                "description": spec.description,
+                "good": good,
+                "total": total,
+                "compliance": round(good / total, 6) if total else None,
+                "windows": windows,
+                "state": "firing" if firing else "ok",
+                "shed": spec.shed,
+            }
+            if eff_threshold is not None:
+                row["threshold_s"] = spec.threshold_s
+                row["effective_threshold_s"] = eff_threshold
+            slos[spec.name] = row
+        return {
+            "ts": time.time(),
+            "stale": False,
+            "burn_threshold": self.burn_threshold,
+            "alerting": any_firing,
+            "should_shed": shed,
+            "slos": slos,
+        }
+
+    def evaluate(self, max_age_s: Optional[float] = None) -> Dict[str, Any]:
+        """The engine's one entry: a throttled (``max_age_s``, default
+        the tick) fresh evaluation, the cached document otherwise, and
+        the stale-on-fault chaos contract on the fresh path."""
+        age = self.tick_s if max_age_s is None else max_age_s
+        now_s = time.monotonic()
+        with self._lock:
+            if (
+                self._last_doc is not None
+                and now_s - self._last_eval_s < age
+            ):
+                return self._last_doc
+            if not _evaluate_allowed():
+                _C_DROPPED.inc()
+                if self._last_doc is not None:
+                    return {**self._last_doc, "stale": True}
+                return {
+                    "ts": time.time(), "stale": True, "alerting": False,
+                    "should_shed": False, "slos": {},
+                    "burn_threshold": self.burn_threshold,
+                }
+            doc = self._evaluate_fresh(now_s)
+            self._last_doc = doc
+            self._last_eval_s = now_s
+            return doc
+
+    def should_shed(self) -> bool:
+        return bool(self.evaluate().get("should_shed"))
+
+    # -- flight-recorder provider ------------------------------------------
+    def observe_metrics(self):
+        doc = self.evaluate()
+        for name, row in doc.get("slos", {}).items():
+            labels = {"slo": name}
+            yield ("gauge", "pathway_slo_objective", labels, row["objective"])
+            yield (
+                "gauge",
+                "pathway_slo_alert",
+                labels,
+                1.0 if row["state"] == "firing" else 0.0,
+            )
+            for label, w in row["windows"].items():
+                yield (
+                    "gauge",
+                    "pathway_slo_burn_rate",
+                    {**labels, "window": label},
+                    w["burn_rate"],
+                )
+
+
+_engine_lock = threading.Lock()
+_engine: Optional[SloEngine] = None
+_shed_on = _truthy(os.environ.get("PATHWAY_SLO"))
+
+
+def engine() -> SloEngine:
+    """The process-wide engine, built lazily from the env-derived
+    default specs and registered on the scrape surface."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = SloEngine()
+            register_provider(_engine)
+        return _engine
+
+
+def set_engine(specs: Optional[List[SloSpec]]) -> SloEngine:
+    """Install a fresh engine (tests/bench: custom specs or re-read env
+    knobs).  Passing None rebuilds the defaults."""
+    global _engine
+    with _engine_lock:
+        _engine = SloEngine(specs)
+        register_provider(_engine)
+        return _engine
+
+
+def evaluate(max_age_s: Optional[float] = None) -> Dict[str, Any]:
+    """Module-level convenience — the ``GET /slo`` payload."""
+    return engine().evaluate(max_age_s)
+
+
+def shed_advisory_enabled() -> bool:
+    return _shed_on
+
+
+def set_shed_advisory(flag: bool) -> None:
+    """The bench A/B switch for the scheduler's advisory probe (also
+    ``PATHWAY_SLO=0``)."""
+    global _shed_on
+    _shed_on = bool(flag)
+
+
+def should_shed() -> bool:
+    """The scheduler's admission probe: True while any ``shed=True``
+    objective is firing.  ADVISORY this PR — the scheduler logs and
+    counts (``pathway_slo_shed_advised_total``) but admits normally;
+    item 2's backpressure acts on it.  One throttled evaluation at most
+    per tick, so the steady-state cost is a clock read."""
+    if not _shed_on:
+        return False
+    try:
+        return engine().should_shed()
+    except Exception:
+        return False  # the advisory path may never fail an admission
+
+
+def record_shed_advised() -> None:
+    _C_SHED_ADVISED.inc()
+
+
+def reset() -> None:
+    """Drop the engine (tests: re-read env knobs, clear rings)."""
+    global _engine
+    with _engine_lock:
+        _engine = None

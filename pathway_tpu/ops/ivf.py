@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
-from ..observe import trace
+from ..observe import hbm, profile, trace
 from ..robust import (
     RetryPolicy,
     TAIL_SKIPPED,
@@ -274,6 +274,26 @@ class IvfKnnIndex:
         # only (zero serve-path cost); id uniquifies multiple indexes
         self._observe_id = observe.next_id()
         observe.register_provider(self)
+        # HBM ledger (observe/hbm.py): resident slabs/centroids + the
+        # cached tail upload, sampled at scrape time only (weakly held)
+        hbm.track("ivf", self)
+
+    def hbm_bytes(self) -> Dict[str, int]:
+        """Device-resident bytes by component: the built structure
+        (slabs + bias + centroids) and the cached exact-tail upload.
+        ``.nbytes`` is array metadata — reading it never syncs."""
+        resident = 0
+        for buf in (self._slabs, self._bias, self._centroids):
+            if buf is not None:
+                resident += int(getattr(buf, "nbytes", 0))
+        tail = 0
+        cache = self._tail_cache
+        if cache is not None:
+            _keys, dev_mat, dev_valid, _t_pad = cache
+            tail = int(getattr(dev_mat, "nbytes", 0)) + int(
+                getattr(dev_valid, "nbytes", 0)
+            )
+        return {"resident": resident, "tail": tail}
 
     def observe_metrics(self):
         """Scrape-time ``pathway_ivf_*`` samples (flight-recorder
@@ -1233,6 +1253,8 @@ class IvfKnnIndex:
                     t_i = jnp.zeros((B, 0), jnp.int32)
                 return s, slots, t_s, t_i
 
+            # device-time attribution (observe/profile.py)
+            fn = profile.wrap("ivf.search", fn)
             self._search_fns[key] = fn
         return self._search_fns[key]
 

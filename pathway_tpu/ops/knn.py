@@ -114,6 +114,23 @@ class DeviceKnnIndex:
         # change what a serve returns — the coalescing scheduler keys
         # its in-window dedup on (text, generation)
         self.generation = 0
+        # HBM ledger (observe/hbm.py): the dense matrix + validity/key
+        # planes, sampled at scrape time only (weakly held)
+        from ..observe import hbm
+
+        hbm.track("knn", self)
+
+    def hbm_bytes(self) -> Dict[str, int]:
+        """Device-resident bytes: the allocated-capacity matrix and the
+        slot metadata planes (``.nbytes`` is metadata, never a sync)."""
+        planes = sum(
+            int(getattr(buf, "nbytes", 0))
+            for buf in (self._valid, self._keys_hi, self._keys_lo)
+        )
+        return {
+            "matrix": int(getattr(self._matrix, "nbytes", 0)),
+            "planes": planes,
+        }
 
     # -- storage helpers ---------------------------------------------------
     def _round_capacity(self, cap: int) -> int:

@@ -61,7 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
-from ..observe import trace
+from ..observe import profile, trace
 from ..robust import (
     CircuitBreaker,
     CircuitOpen,
@@ -758,6 +758,8 @@ class RetrieveRerankPipeline:
                 s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
                 return jnp.concatenate([s_bits, perm.astype(jnp.int32)], axis=1)
 
+            # device-time attribution (observe/profile.py)
+            fused = profile.wrap("rerank.stage2", fused)
             self._fns[key] = fused
             return fused
 

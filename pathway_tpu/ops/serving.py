@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observe
-from ..observe import trace
+from ..observe import profile, trace
 from ..models.transformer import TransformerEncoder
 from ..robust import (
     CircuitOpen,
@@ -240,6 +240,11 @@ class FusedEncodeSearch:
                     )
                 return search(z, qtok, matrix, valid, keys_hi, keys_lo)
 
+        # device-time attribution (observe/profile.py): the compiled fn
+        # is stored wrapped, so every steady-state call is sampled
+        fused = profile.wrap(
+            "serve.exact_search" if from_z else "serve.fused_exact", fused
+        )
         self._fns[key] = fused
         return fused
 
@@ -344,6 +349,9 @@ class FusedEncodeSearch:
                     z, qtok, slabs, bias, centroids, tail_mat, tail_valid
                 )
 
+        fused = profile.wrap(
+            "serve.ivf_search" if from_z else "serve.fused_ivf", fused
+        )
         self._fns[shape_key] = fused
         return fused, k_main, k_tail
 
@@ -376,6 +384,7 @@ class FusedEncodeSearch:
                     return z, qtok
                 return z
 
+            fn = profile.wrap("serve.encode", fn)
             self._fns[key] = fn
             return fn
 
@@ -518,6 +527,7 @@ class FusedEncodeSearch:
                 s_bits = jax.lax.bitcast_convert_type(s_out, jnp.int32)
                 return jnp.concatenate([s_bits, i_out], axis=1)
 
+            fn = profile.wrap("serve.shard_search", fn)
             self._fns[key] = fn
             return fn, n_slotspace
 
@@ -553,6 +563,7 @@ class FusedEncodeSearch:
                 s_bits = jax.lax.bitcast_convert_type(s, jnp.int32)
                 return jnp.concatenate([s_bits, h, i], axis=1)
 
+            fn = profile.wrap("serve.shard_merge", fn)
             self._fns[key] = fn
             return fn
 

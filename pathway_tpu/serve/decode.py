@@ -68,7 +68,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import observe
-from ..observe import trace
+from ..observe import hbm, trace
 from ..robust import (
     Deadline,
     EXTRACTIVE_ANSWER,
@@ -98,6 +98,9 @@ def decode_slots() -> int:
 _H_QUEUE_WAIT = observe.histogram("pathway_generator_queue_wait_seconds")
 _H_PREFILL = observe.histogram("pathway_generator_phase_seconds", phase="prefill")
 _H_STEP = observe.histogram("pathway_generator_phase_seconds", phase="step")
+# time-to-last-token per request, admission → completion at the waiter —
+# the series the SLO engine's decode_ttlt objective reads
+_H_TTLT = observe.histogram("pathway_generator_ttlt_seconds")
 
 
 class DecodeResult(str):
@@ -236,6 +239,24 @@ class ContinuousDecoder(_CoalescerBase):
             window_us=window_us,
             max_batch=self.slots,
             autostart=autostart,
+        )
+        # HBM ledger (observe/hbm.py): the slot KV pool is the
+        # generator-side HBM owner; slot exhaustion-ETA derives from the
+        # observed join rate vs frees at sample time
+        hbm.track("decode", self, lambda d: {"kv_pool": d.hbm_bytes()})
+        hbm.track_resource(
+            "decode_slots",
+            self,
+            lambda d: d.slots - len(d._free),
+            lambda d: d.slots,
+        )
+
+    def hbm_bytes(self) -> int:
+        """Device bytes of the persistent slot pool (K + V buffers +
+        per-slot rng chains) — ``.nbytes`` metadata, never a sync."""
+        return sum(
+            int(getattr(buf, "nbytes", 0))
+            for buf in (self._pk, self._pv, self._rngs)
         )
 
     # -- public surface ------------------------------------------------------
@@ -786,6 +807,9 @@ class ContinuousDecoder(_CoalescerBase):
         return run
 
     def _demux(self, req, batch_result) -> DecodeResult:
+        # time-to-last-token, pool and solo paths alike (the waiter's
+        # completion is the client-visible "last token")
+        _H_TTLT.observe_ns(time.perf_counter_ns() - req.t_enqueue_ns)
         out = []
         for slot in req.slots:
             if 0 <= slot < len(batch_result):

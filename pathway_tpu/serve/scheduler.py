@@ -74,6 +74,7 @@ import numpy as np
 
 from .. import observe
 from ..cache import query_key, result_cache_from_env
+from ..observe import slo as slo_mod
 from ..observe import trace
 from ..robust import Deadline, RETRIEVAL_FAILED, ServeResult, log_once, record_degraded
 
@@ -738,6 +739,19 @@ class ServeScheduler(_CoalescerBase):
         if deadline is None:
             default = getattr(self.target, "_default_deadline", Deadline.from_env)
             deadline = default()
+        # SLO shed advisory (observe/slo.py): while a shed-enabled
+        # objective is burning past threshold, LOG + COUNT — admission
+        # is unchanged this round (ROADMAP item 2's backpressure acts on
+        # the same probe).  The probe is a throttled cached read; the
+        # advisory path may never fail or slow an admission.
+        if slo_mod.should_shed():
+            log_once(
+                "serve.slo_shed",
+                "SLO burn-rate alert firing: should_shed() advises "
+                "load shedding (advisory only — admission unchanged; "
+                "see GET /slo)",
+            )
+            slo_mod.record_shed_advised()
         # per-request trace root (observe/trace.py): admission → cache →
         # batch link → demux all hang off this context; None (one flag
         # check, no allocation) when tracing is off or sampled out

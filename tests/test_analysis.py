@@ -442,6 +442,92 @@ def test_lock_discipline_knows_slot_pool_getters():
     assert _live(_run(good), "lock-discipline") == []
 
 
+def test_lock_discipline_flags_observability_callback_under_lock():
+    """ISSUE 12: a profiler/ledger/SLO callback taken under a serve-path
+    lock is a lock-discipline finding — the pull-based samplers walk
+    weak registries and fire the profile.sample/hbm.ledger/slo.evaluate
+    chaos sites (delay/hang); they belong on scrape/bench threads."""
+    bad = """
+        import threading
+
+        from pathway_tpu.observe import hbm, slo
+
+        class Scheduler:
+            def __init__(self):
+                self._qlock = threading.Lock()
+
+            def admit(self, req):
+                with self._qlock:
+                    doc = slo.evaluate()
+                    usage = hbm.sample()
+                    self._queue.append(req)
+                return doc, usage
+    """
+    live = _live(_run(bad), "lock-discipline")
+    assert len(live) == 2, "\n".join(f.message for f in live)
+    assert all("observability callback" in f.message for f in live)
+    good = """
+        import threading
+
+        from pathway_tpu.observe import hbm, slo
+
+        class Scheduler:
+            def __init__(self):
+                self._qlock = threading.Lock()
+
+            def admit(self, req):
+                # the sanctioned shape: probe BEFORE taking the lock
+                doc = slo.evaluate()
+                usage = hbm.sample()
+                with self._qlock:
+                    self._queue.append(req)
+                return doc, usage
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
+def test_profile_wrap_binds_jitted_callable():
+    """ISSUE 12: the registry learns the profiler's wrapper —
+    ``fn = profile.wrap("site", jitted)`` binds a jitted callable, so a
+    call through it under a lock stays a lock-discipline finding (and
+    its result stays a device value) instead of being laundered out of
+    the rules by the attribution wrapper."""
+    bad = """
+        import threading
+
+        import jax
+
+        from pathway_tpu.observe import profile
+
+        @jax.jit
+        def _kernel(x):
+            return x * 2
+
+        def serve(lock, q):
+            with lock:
+                fn = profile.wrap("serve.kernel", _kernel)
+                return fn(q)
+    """
+    live = _live(_run(bad), "lock-discipline")
+    assert len(live) == 1, "\n".join(f.message for f in live)
+    assert "jitted dispatch" in live[0].message
+    good = """
+        import jax
+
+        from pathway_tpu.observe import profile
+
+        @jax.jit
+        def _kernel(x):
+            return x * 2
+
+        def serve(lock, q):
+            with lock:
+                fn = profile.wrap("serve.kernel", _kernel)
+            return fn(q)
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
 def test_lock_discipline_knows_sharded_cache_getters():
     """ISSUE 7: the sharded-serve compiled-fn getters (``_encode_fn``,
     ``_shard_search_fn`` — tuple-returning, ``_merge_fn``, ``_table_fn``,

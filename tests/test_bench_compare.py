@@ -1,0 +1,128 @@
+"""Bench-trajectory comparator (ISSUE 12 satellite): record flattening,
+direction inference, >10% regression flagging, CLI exit codes, and the
+``write_trajectory_record`` round-trip bench.py seeds the trajectory
+with."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pathway_tpu.bench_compare import (
+    compare_records,
+    direction_of,
+    flatten_metrics,
+    main,
+)
+
+
+def _record(round_no: int, **extras):
+    return {
+        "schema": 1,
+        "round": round_no,
+        "created_unix": 1700000000.0 + round_no,
+        "metric": "retrieval_p50_device_ms_1M",
+        "value": extras.pop("value", 10.0),
+        "unit": "ms",
+        "vs_baseline": 5.0,
+        "backend": "cpu",
+        "extras": extras,
+    }
+
+
+def test_direction_inference_follows_naming_convention():
+    assert direction_of("retrieval_p50_ms") == "lower"
+    assert direction_of("profiling_overhead_pct") == "lower"
+    assert direction_of("trace_p50_on_ms") == "lower"
+    assert direction_of("serve_p99_e2e_ms") == "lower"
+    assert direction_of("ingest_docs_per_sec") == "higher"
+    assert direction_of("serve_coalesce_speedup_c16") == "higher"
+    assert direction_of("rag_eval_accuracy") == "higher"
+    assert direction_of("stage2_flop_reduction_x") == "higher"
+    assert direction_of("vs_baseline") == "higher"
+    # informational: counts/configs never flag
+    assert direction_of("index_docs") is None
+    assert direction_of("hbm_ledger_bytes") is None
+
+
+def test_flatten_skips_bookkeeping_and_nested_numerics():
+    flat = flatten_metrics(
+        _record(12, qps=100.0, nested={"p99_ms": 5.0, "name": "x"})
+    )
+    assert flat["extras.qps"] == 100.0
+    assert flat["extras.nested.p99_ms"] == 5.0
+    assert "round" not in flat and "schema" not in flat
+    assert "extras.nested.name" not in flat
+
+
+def test_regression_flagged_only_past_threshold_and_in_bad_direction():
+    older = _record(12, serve_qps=100.0, serve_p50_ms=10.0)
+    newer = _record(
+        13, serve_qps=85.0, serve_p50_ms=10.5
+    )  # qps -15% (flag), p50 +5% (under threshold)
+    regressions, improvements = compare_records(older, newer, threshold=0.10)
+    names = [r["metric"] for r in regressions]
+    assert names == ["extras.serve_qps"]
+    assert regressions[0]["change_pct"] == -15.0
+    assert improvements == []
+    # the same moves in the GOOD direction report as improvements
+    regressions, improvements = compare_records(newer, older, threshold=0.10)
+    assert regressions == []
+    assert [r["metric"] for r in improvements] == ["extras.serve_qps"]
+
+
+def test_cli_exit_codes_and_report(tmp_path, capsys):
+    a = tmp_path / "BENCH_12.json"
+    b = tmp_path / "BENCH_13.json"
+    a.write_text(json.dumps(_record(12, serve_qps=100.0)))
+    b.write_text(json.dumps(_record(13, serve_qps=50.0)))
+    # order on the command line is irrelevant: records sort by round
+    assert main([str(b), str(a)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION extras.serve_qps" in out
+    b.write_text(json.dumps(_record(13, serve_qps=101.0)))
+    assert main([str(a), str(b)]) == 0
+    # a single record = seeded trajectory, exit 0
+    assert main([str(a)]) == 0
+    assert "trajectory seeded" in capsys.readouterr().out
+    # usage errors exit 2 — never confusable with a flagged regression
+    with pytest.raises(SystemExit) as exc:
+        main([str(tmp_path / "BENCH_nope.json")])
+    assert exc.value.code == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_bench_writes_versioned_trajectory_record(tmp_path, monkeypatch):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py",
+        ),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    path = tmp_path / "BENCH_12.json"
+    monkeypatch.setenv("BENCH_RECORD_FILE", str(path))
+    monkeypatch.setenv("BENCH_ROUND", "12")
+    state = {"retrieval": 12.5, "ingest": None}
+    record = bench.build_record(
+        state, {"index_docs": 1000, "serve_qps": 50.0}, {}, {}, "cpu"
+    )
+    written = bench.write_trajectory_record(record, state)
+    assert written == str(path)
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == 1 and doc["round"] == 12
+    assert doc["phases_measured"] == ["retrieval"]
+    assert doc["metric"].startswith("retrieval_p50_device_ms")
+    assert doc["extras"]["serve_qps"] == 50.0
+    # the comparator reads what bench writes
+    assert main([str(path)]) == 0
+    # BENCH_RECORD=0 disables
+    monkeypatch.setenv("BENCH_RECORD", "0")
+    assert bench.write_trajectory_record(record, state) is None

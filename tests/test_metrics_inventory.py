@@ -1,0 +1,300 @@
+"""Metrics-inventory drift gate (ISSUE 12 satellite).
+
+The README's metric inventory and the live ``/metrics`` scrape must
+agree in BOTH directions:
+
+- every ``pathway_*`` family documented in README renders on a live
+  scrape of a workload exercising the whole serve stack (docs for a
+  metric that no longer exists are worse than no docs);
+- every family the scrape renders is documented somewhere in README
+  (new instrumentation must not ship undocumented).
+
+"Documented" means a backticked full family name (`` `pathway_x` ``) —
+the README spells every family out in full precisely so this gate can
+parse it.  The workload below drives, in one process: the engine graph
++ a connector monitor, a sharded IVF + forward-index cascade serve
+(clean, degraded, retried, breaker-probed, host-merge-probed), the
+coalescing scheduler with all three cache tiers, a continuous-decode
+engine, an exchange plane pair, full-rate tracing and profiling, the
+HBM ledger, and the SLO engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe, robust
+from pathway_tpu.observe import profile, slo, trace
+from pathway_tpu.robust import CircuitBreaker, inject
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOCS = {
+    i: f"inventory doc {i} about {topic} with live updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+            "mesh collectives", "tokenizer ingest", "serving latency",
+            "cross encoders",
+        ]
+        * 2
+    )
+}
+QUERIES = ["rag retrieval serving", "exactly once stream"]
+
+# documented families this workload legitimately cannot produce.  Keep
+# this list near-empty, each entry with a reason — an unexplained entry
+# is the drift this gate exists to catch.
+_EXEMPT: set = {
+    # set ONLY by bench.py's sharded_serve A/B probe (device-merge vs
+    # host-merge timing): the share is a measured comparison, not live
+    # state, so no serve workload can produce it
+    "pathway_serve_shard_merge_share",
+}
+
+
+class _FakeKV:
+    def __init__(self):
+        self._kv = {}
+        self._cv = threading.Condition()
+
+    def set(self, key, value):
+        with self._cv:
+            self._kv[key] = value
+            self._cv.notify_all()
+
+    def get(self, key, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - time.monotonic()
+                assert left > 0, f"KV rendezvous timed out waiting for {key}"
+                self._cv.wait(timeout=left)
+            return self._kv[key]
+
+
+@pytest.fixture(scope="module")
+def rendered_families():
+    """Drive the whole stack once, scrape a live server, and return the
+    set of rendered ``pathway_*`` family names."""
+    import pathway_tpu as pw
+    from pathway_tpu.cache import (
+        EmbeddingCache,
+        PrefixKVCache,
+        ResultCache,
+    )
+    from pathway_tpu.index import ShardedForwardIndex
+    from pathway_tpu.internals.metrics import MetricsServer
+    from pathway_tpu.io._offsets import ConnectorMonitor
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+    from pathway_tpu.models.encoder import SentenceEncoder
+    from pathway_tpu.models.generator import TextGenerator
+    from pathway_tpu.ops.ivf import ShardedIvfIndex
+    from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+    from pathway_tpu.ops.serving import FusedEncodeSearch
+    from pathway_tpu.parallel.exchange import ExchangePlane
+    from pathway_tpu.parallel.shards import ShardGroup
+    from pathway_tpu.serve import ContinuousDecoder, ServeScheduler
+
+    from .utils import T
+
+    inject.disarm()
+    profile.set_sample(1.0)
+    sample0 = trace.sample_rate()
+    trace.set_sample(1.0)
+
+    # engine graph + connector monitor (operator/connector families)
+    t = T("""
+      | a
+    1 | 1
+    2 | 2
+    """)
+    _ = t.select(b=pw.this.a * 2)
+    pw.run(monitoring_level=None)
+    mon = ConnectorMonitor("inventory_src")  # strong ref: stays scraped
+    mon.on_insert(4)
+    mon.on_commit()
+
+    # sharded IVF + sharded forward index cascade
+    enc = SentenceEncoder(
+        dimension=16, n_layers=1, n_heads=2, max_length=16,
+        vocab_size=256, dtype=jnp.float32,
+    )
+    ce = CrossEncoderModel(
+        dimension=16, n_layers=1, n_heads=2, max_length=32,
+        vocab_size=256, dtype=jnp.float32,
+    )
+    group = ShardGroup(n_shards=2)
+    ivf = ShardedIvfIndex(
+        dimension=16, metric="cos", group=group, n_clusters=2, n_probe=2
+    )
+    keys = sorted(DOCS)
+    ivf.add(keys, enc.encode([DOCS[i] for i in keys]))
+    ivf.build()
+    forward = ShardedForwardIndex(enc, group=group, tokens_per_doc=4)
+    forward.add(keys, [DOCS[i] for i in keys])
+    fused = FusedEncodeSearch(
+        enc, ivf, k=8, embed_cache=EmbeddingCache(),
+        export_query_tokens=True,
+    )
+    pipe = RetrieveRerankPipeline(
+        fused, ce, DOCS, k=3, candidates=8, forward_index=forward,
+        cascade=4,
+        rerank_breaker=CircuitBreaker(
+            "inventory-ce", failure_threshold=100, reset_s=60
+        ),
+    )
+    robust.breaker("cross_encoder").reset()  # breaker families render
+    pipe(QUERIES)  # warmup
+    pipe(QUERIES)  # steady state: stage + shard + forward families
+
+    # host-merge probe arm (pathway_serve_shard_fetches_total)
+    fused.shard_host_merge = True
+    pipe([QUERIES[0]])
+    fused.shard_host_merge = False
+
+    # retried + exhausted + degraded + faults-fired
+    with inject.armed("rerank.dispatch", "raise", times=1):
+        pipe(QUERIES)  # transient: retried, clean
+    with inject.armed("rerank.dispatch", "raise"):
+        got = pipe(QUERIES)  # persistent: rung + retry exhausted
+    assert got.degraded == ("rerank_skipped",)
+    inject.disarm()
+
+    # coalescing scheduler + result cache (queue/replica/cache/trace)
+    with ServeScheduler(
+        pipe, window_us=1000, result_cache=ResultCache()
+    ) as sched:
+        sched.serve(QUERIES)
+        sched.serve(QUERIES)  # tier-0 hit (zero-dispatch serve)
+        with inject.armed("rerank.dispatch", "raise"):
+            # fresh text: a tier-0 hit would serve the cached CLEAN rows
+            flagged = sched.serve(["window aggregation state"])
+        assert flagged.degraded == ("rerank_skipped",)  # ⇒ kept trace
+    inject.disarm()
+
+    # continuous decode + prefix KV cache (generator + prefill families)
+    gen = TextGenerator(
+        dimension=32, n_layers=1, n_heads=4, max_length=64,
+        vocab_size=512, kv_cache=PrefixKVCache(block=8),
+    )
+    engine = ContinuousDecoder(gen, slots=2, step_bucket=2, window_us=0)
+    try:
+        engine.generate(
+            ["shared prefix inventory probe one",
+             "shared prefix inventory probe two"],
+            max_new_tokens=3,
+        )
+    finally:
+        engine.stop()
+
+    # exchange plane pair
+    kv = _FakeKV()
+    planes = [None, None]
+
+    def boot(rank):
+        planes[rank] = ExchangePlane(
+            rank, 2, kv.set, kv.get, namespace="inventory"
+        )
+
+    threads = [threading.Thread(target=boot, args=(r,)) for r in (0, 1)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    planes[0].broadcast("edge", 0, {"x": 1}, root=0)
+    planes[1].broadcast("edge", 0, None, root=0)
+
+    # profiler drain + SLO evaluation so every derived family is fresh
+    assert profile.drain()
+    slo.evaluate(max_age_s=0.0)
+
+    server = MetricsServer(pw.G.engine_graph, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = (
+            urllib.request.urlopen(f"{base}/metrics", timeout=10)
+            .read()
+            .decode()
+        )
+        slo_doc = json.loads(
+            urllib.request.urlopen(f"{base}/slo", timeout=10).read()
+        )
+    finally:
+        server.stop()
+        for p in planes:
+            p.close()
+        trace.set_sample(sample0)
+
+    assert slo_doc["slos"], "live /slo document is empty"
+    families = set()
+    for line in body.split("\n"):
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        if not name.startswith("pathway_"):
+            continue
+        if name.startswith("pathway_test_"):
+            continue  # synthetic fixtures from sibling test modules
+        # histogram series collapse to their family name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and f"# TYPE {name[:-len(suffix)]} histogram" in body:
+                name = name[: -len(suffix)]
+                break
+        families.add(name)
+    return families
+
+
+# a documented family is a backticked full name, optionally followed by
+# an example label block: `pathway_x_total` or `pathway_x_total{tag=...}`.
+# Brace-expansion shorthand (`pathway_serve_shard_{a,b}`) leaves a
+# dangling `_` prefix — not a family, skipped.
+_DOC_RE = re.compile(r"`(pathway_[a-z0-9_]+)[`{]")
+
+
+def _documented_families() -> set:
+    with open(os.path.join(_REPO_ROOT, "README.md")) as fh:
+        readme = fh.read()
+    names = set()
+    for name in _DOC_RE.findall(readme):
+        if name.endswith("_"):
+            continue
+        # a documented example SERIES (`..._bucket{le=...}`) documents
+        # its histogram family
+        for suffix in ("_bucket", "_sum"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+                break
+        names.add(name)
+    return names
+
+
+def test_every_rendered_family_is_documented(rendered_families):
+    documented = _documented_families()
+    undocumented = sorted(rendered_families - documented)
+    assert undocumented == [], (
+        "families render on /metrics but are missing from README "
+        f"(document them in the metric inventory): {undocumented}"
+    )
+
+
+def test_every_documented_family_renders(rendered_families):
+    documented = _documented_families()
+    stale = sorted(documented - rendered_families - _EXEMPT)
+    assert stale == [], (
+        "families documented in README did not render on a live scrape "
+        "of the full-stack workload (stale docs, or the workload lost "
+        f"coverage): {stale}"
+    )

@@ -1,0 +1,476 @@
+"""Device-time profiler + HBM ledger + SLO engine (ISSUE 12).
+
+Three layers:
+
+- **profiler**: transparent wrapping (same results), deterministic
+  1-in-N sampling, zero-path when disabled, submit→ready attribution
+  landing in ``pathway_profile_device_seconds{callable=...}``, the
+  share-of-wall gauges, and the 2+2 dispatch budget with the profiler
+  sampling EVERY call (attribution must never add a round trip);
+- **HBM ledger**: per-subsystem byte attribution agreeing with the
+  backend's own accounting (``device.memory_stats`` / live-array sum)
+  within 10% on a freshly created structure, watermark monotonicity,
+  exhaustion-ETA from observed growth, weakref drop-out;
+- **SLO engine**: burn-rate window math on synthetic counts, the
+  acceptance gate (a clean baseline stays green; synthetic latency
+  inflation fires the ``/slo`` burn-rate alert), the scheduler's
+  advisory ``should_shed`` (log + count, admission unchanged), and the
+  ``GET /slo`` endpoint shape.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe
+from pathway_tpu.observe import hbm, profile, slo
+
+DOCS = {
+    i: f"profile doc {i} about {topic} under load"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+        ]
+        * 2
+    )
+}
+QUERIES = ["rag retrieval serving", "exactly once stream"]
+
+
+@pytest.fixture(scope="module")
+def serve_stack():
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+    from pathway_tpu.models.encoder import SentenceEncoder
+    from pathway_tpu.ops.ivf import IvfKnnIndex
+    from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+    from pathway_tpu.ops.serving import FusedEncodeSearch
+
+    enc = SentenceEncoder(
+        dimension=16, n_layers=1, n_heads=2, max_length=16,
+        vocab_size=256, dtype=jnp.float32,
+    )
+    ce = CrossEncoderModel(
+        dimension=16, n_layers=1, n_heads=2, max_length=32,
+        vocab_size=256, dtype=jnp.float32,
+    )
+    ivf = IvfKnnIndex(dimension=16, metric="cos", n_clusters=4, n_probe=4)
+    keys = sorted(DOCS)
+    ivf.add(keys, enc.encode([DOCS[i] for i in keys]))
+    ivf.build()
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, ivf, k=8), ce, DOCS, k=3, candidates=8
+    )
+    pipe(QUERIES)  # warmup compile
+    return enc, ce, ivf, pipe
+
+
+@pytest.fixture(autouse=True)
+def _full_sampling():
+    """Deterministic tests: sample every call, restore the env stride."""
+    stride0 = profile.sample_stride()
+    profile.set_sample(1.0)
+    yield
+    profile.set_sample(1.0 / stride0 if stride0 else 0.0)
+
+
+# -- profiler ----------------------------------------------------------------
+
+
+def test_wrap_is_transparent_and_attributes_device_time():
+    calls = []
+
+    def kernel(x):
+        calls.append(1)
+        return jnp.asarray(x) * 2
+
+    fn = profile.wrap("test.transparent", kernel)
+    out = fn(np.arange(8.0))
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 2)
+    assert len(calls) == 1
+    assert profile.drain()
+    stats = profile.profile_stats()["test.transparent"]
+    assert stats["samples"] >= 1
+    assert stats["device_s"] > 0
+    assert 0.0 <= stats["share_of_wall"] <= 1.0
+
+
+def test_sampling_stride_is_deterministic():
+    fn = profile.wrap("test.stride", lambda x: jnp.asarray(x))
+    profile.set_sample(0.25)
+    assert profile.sample_stride() == 4
+    s0 = observe.counter(
+        "pathway_profile_samples_total", callable="test.stride"
+    ).value
+    for _ in range(16):
+        fn(np.ones(2))
+    assert profile.drain()
+    s1 = observe.counter(
+        "pathway_profile_samples_total", callable="test.stride"
+    ).value
+    assert s1 - s0 == 4  # exactly 1-in-4, no randomness
+
+
+def test_disabled_recorder_skips_sampling_entirely():
+    fn = profile.wrap("test.disabled", lambda x: jnp.asarray(x))
+    before = profile.profile_stats().get("test.disabled", {})
+    observe.set_enabled(False)
+    try:
+        out = fn(np.ones(3))
+        assert float(np.asarray(out).sum()) == 3.0  # result untouched
+    finally:
+        observe.set_enabled(True)
+    after = profile.profile_stats()["test.disabled"]
+    assert after["calls"] == before.get("calls", 0)  # not even counted
+    assert after["samples"] == before.get("samples", 0)
+
+
+def test_sample_zero_is_off():
+    fn = profile.wrap("test.off", lambda x: jnp.asarray(x))
+    profile.set_sample(0.0)
+    assert profile.sample_stride() == 0
+    for _ in range(8):
+        fn(np.ones(2))
+    assert profile.profile_stats()["test.off"]["samples"] == 0
+
+
+def test_unblockable_output_drops_sample_not_serve():
+    """A wrapped callable returning something with no array leaf (or a
+    deleted buffer) drops the sample — the caller's result is already in
+    hand and untouched."""
+    fn = profile.wrap("test.hostonly", lambda x: {"n": int(x)})
+    dropped = observe.counter("pathway_profile_samples_dropped_total")
+    before = dropped.value
+    assert fn(3) == {"n": 3}
+    assert dropped.value == before + 1
+
+
+def test_serve_budget_2plus2_with_profiler_sampling_every_call(serve_stack):
+    """Acceptance: attribution must never add a device round trip — a
+    steady-state serve with stride-1 sampling stays 2 dispatches +
+    2 fetches."""
+    from pathway_tpu.ops import dispatch_counter
+
+    _enc, _ce, _ivf, pipe = serve_stack
+    pipe(QUERIES)  # steady state
+    with dispatch_counter.DispatchCounter() as counter:
+        got = pipe(QUERIES)
+    assert got and all(got)
+    assert counter.dispatches == 2, counter.events
+    assert counter.fetches == 2, counter.events
+    assert profile.drain()
+    stats = profile.profile_stats()
+    # both stages attributed to their compiled callables
+    assert stats["serve.fused_ivf"]["samples"] >= 1
+    assert stats["rerank.stage2"]["samples"] >= 1
+
+
+def test_profile_families_render_and_serve_stats_column(serve_stack):
+    _enc, _ce, _ivf, pipe = serve_stack
+    pipe(QUERIES)
+    assert profile.drain()
+    body = "\n".join(observe.render_prometheus())
+    assert "pathway_profile_device_seconds_bucket" in body
+    assert "pathway_profile_samples_total" in body
+    assert "pathway_profile_device_share" in body
+    snap = observe.snapshot()
+    assert "serve.fused_ivf" in snap["profile"]
+    row = snap["profile"]["serve.fused_ivf"]
+    assert row["device_s"] > 0 and row["samples"] >= 1
+
+
+# -- HBM ledger --------------------------------------------------------------
+
+
+def test_ledger_delta_agrees_with_device_accounting_within_10pct():
+    """Acceptance: creating a known device-resident structure moves the
+    ledger total and the backend's own accounting by the same bytes
+    (±10%) — the cross-check that catches off-the-books HBM."""
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    gc.collect()
+    ledger0 = hbm.sample()["total_bytes"]
+    device0 = hbm.device_bytes()
+    assert device0 is not None
+    index = DeviceKnnIndex(
+        dimension=256, metric="cos", initial_capacity=4096
+    )  # ~4 MB matrix + planes, registered at construction
+    gc.collect()
+    ledger1 = hbm.sample()["total_bytes"]
+    device1 = hbm.device_bytes()
+    d_ledger = ledger1 - ledger0
+    d_device = device1 - device0
+    assert d_ledger > 1 << 20  # the structure is actually on the books
+    assert abs(d_device - d_ledger) / d_ledger < 0.10, (d_ledger, d_device)
+    # weakref drop-out: releasing the structure leaves the ledger
+    expected = dict(index.hbm_bytes())
+    del index
+    gc.collect()
+    ledger2 = hbm.sample()["total_bytes"]
+    assert ledger2 <= ledger1 - sum(expected.values()) + 1024
+
+
+def test_ledger_watermark_is_monotone():
+    before = hbm.sample()
+    assert before["watermark_bytes"] >= before["total_bytes"]
+    w0 = before["watermark_bytes"]
+
+    class Blob:
+        def hbm_bytes(self):
+            return 1 << 22
+
+    blob = Blob()
+    hbm.track("test_blob", blob)
+    mid = hbm.sample()
+    assert mid["watermark_bytes"] >= w0
+    assert mid["subsystems"]["test_blob"]["total"] == 1 << 22
+    w1 = mid["watermark_bytes"]
+    del blob
+    gc.collect()
+    after = hbm.sample()
+    assert "test_blob" not in after["subsystems"]
+    assert after["watermark_bytes"] == w1  # high-water never recedes
+
+
+def test_exhaustion_eta_tracks_observed_growth():
+    class Pool:
+        used = 0.0
+
+    pool = Pool()
+    hbm.track_resource(
+        "test_pool", pool, lambda p: p.used, lambda p: 100.0
+    )
+    doc = hbm.sample()
+    assert doc["resources"]["test_pool"]["exhaustion_eta_s"] == -1.0  # idle
+    t0 = time.monotonic()
+    pool.used = 10.0
+    time.sleep(0.15)  # past the EWMA's zero-dt guard (_MIN_GROWTH_DT_S)
+    doc = hbm.sample()
+    row = doc["resources"]["test_pool"]
+    assert row["growth_per_s"] > 0
+    # ~10 units in ~the elapsed interval, 90 units of headroom left
+    elapsed = max(time.monotonic() - t0, 1e-3)
+    expected_rate = hbm._EWMA_ALPHA * 10.0 / elapsed
+    assert row["growth_per_s"] == pytest.approx(expected_rate, rel=0.5)
+    assert row["exhaustion_eta_s"] == pytest.approx(
+        90.0 / row["growth_per_s"], rel=1e-6
+    )
+    # growth stops: the EWMA decays toward idle, never negative
+    doc = hbm.sample()
+    assert doc["resources"]["test_pool"]["growth_per_s"] >= 0
+
+
+def test_ledger_families_render_with_live_serve_stack(serve_stack):
+    _enc, _ce, ivf, _pipe = serve_stack
+    body = "\n".join(observe.render_prometheus())
+    assert 'pathway_hbm_bytes{component="resident",subsystem="ivf"}' in body
+    assert 'subsystem="params"' in body
+    assert "pathway_hbm_total_bytes" in body
+    assert "pathway_hbm_watermark_bytes" in body
+    assert "pathway_hbm_device_bytes" in body
+    # the ivf's own hbm_bytes feeds the ledger
+    parts = ivf.hbm_bytes()
+    assert parts["resident"] > 0
+    snap = observe.snapshot()
+    assert snap["hbm"]["total_bytes"] >= parts["resident"]
+
+
+# -- SLO engine --------------------------------------------------------------
+
+
+def _synthetic_latency_engine(name: str):
+    """A fresh engine over one latency spec reading a dedicated test
+    histogram family — full control of good/bad counts."""
+    spec = slo.SloSpec(
+        f"test_{name}",
+        "latency",
+        objective=0.99,
+        hist=f"pathway_test_{name}_seconds",
+        threshold_s=0.01,
+        shed=True,
+    )
+    return slo.SloEngine([spec]), observe.histogram(
+        f"pathway_test_{name}_seconds"
+    )
+
+
+def test_burn_rate_alert_fires_on_latency_inflation_baseline_green():
+    """The acceptance gate: a clean workload keeps every window's burn
+    rate ~0 (green); synthetic latency inflation pushes the fast AND
+    slow burn above threshold and the alert fires."""
+    engine, hist = _synthetic_latency_engine("inflate")
+    for _ in range(200):
+        hist.observe_ns(1_000_000)  # 1 ms — inside the 10 ms threshold
+    doc = engine.evaluate(max_age_s=0.0)
+    row = doc["slos"]["test_inflate"]
+    assert doc["alerting"] is False and row["state"] == "ok"
+    assert row["compliance"] == 1.0
+    assert row["windows"]["fast"]["burn_rate"] == 0.0
+    # inflation: 300 requests at 500 ms against a 10 ms threshold
+    for _ in range(300):
+        hist.observe_ns(500_000_000)
+    doc = engine.evaluate(max_age_s=0.0)
+    row = doc["slos"]["test_inflate"]
+    assert row["state"] == "firing", row
+    assert doc["alerting"] is True and doc["should_shed"] is True
+    assert row["windows"]["fast"]["burn_rate"] >= doc["burn_threshold"]
+    assert row["windows"]["slow"]["burn_rate"] >= doc["burn_threshold"]
+    # recovery: a long clean run drains the window back under threshold
+    for _ in range(20000):
+        hist.observe_ns(1_000_000)
+    doc = engine.evaluate(max_age_s=0.0)
+    assert doc["slos"]["test_inflate"]["windows"]["fast"]["error_ratio"] < 0.02
+
+
+def test_availability_spec_counts_every_ladder_rung():
+    bad = observe.counter("pathway_test_avail_bad_total", reason="x")
+    hist = observe.histogram("pathway_test_avail_seconds")
+    spec = slo.SloSpec(
+        "test_avail",
+        "availability",
+        objective=0.999,
+        bad="pathway_test_avail_bad_total",
+        total_hist="pathway_test_avail_seconds",
+    )
+    engine = slo.SloEngine([spec])
+    for _ in range(100):
+        hist.observe_ns(1000)
+    engine.evaluate(max_age_s=0.0)  # baseline snapshot
+    for _ in range(100):
+        hist.observe_ns(1000)
+    bad.inc(10)
+    doc = engine.evaluate(max_age_s=0.0)
+    row = doc["slos"]["test_avail"]
+    # 10 bad of 100 new events over a 0.001 budget: burn 100
+    assert row["windows"]["fast"]["error_ratio"] == pytest.approx(0.1)
+    assert row["windows"]["fast"]["burn_rate"] == pytest.approx(100.0)
+    assert row["state"] == "firing"
+
+
+def test_latency_threshold_snaps_to_bucket_bound():
+    engine, _hist = _synthetic_latency_engine("snap")
+    doc = engine.evaluate(max_age_s=0.0)
+    row = doc["slos"]["test_snap"]
+    assert row["threshold_s"] == 0.01
+    # the effective threshold is the next power-of-two bucket bound
+    assert row["effective_threshold_s"] >= 0.01
+    assert row["effective_threshold_s"] < 0.02
+
+
+def test_default_specs_cover_serve_and_decode():
+    names = {s.name for s in slo.default_specs()}
+    assert names == {"serve_latency", "serve_availability", "decode_ttlt"}
+    by_name = {s.name: s for s in slo.default_specs()}
+    assert by_name["serve_latency"].shed is True
+    assert by_name["serve_availability"].shed is True
+    assert by_name["decode_ttlt"].shed is False
+    assert by_name["serve_latency"].hist == "pathway_serve_request_seconds"
+    assert (
+        by_name["decode_ttlt"].hist == "pathway_generator_ttlt_seconds"
+    )
+
+
+def test_throttled_evaluate_reuses_cached_doc():
+    engine, hist = _synthetic_latency_engine("throttle")
+    doc1 = engine.evaluate(max_age_s=30.0)
+    hist.observe_ns(1000)
+    doc2 = engine.evaluate(max_age_s=30.0)
+    assert doc2 is doc1  # cached
+    doc3 = engine.evaluate(max_age_s=0.0)
+    assert doc3 is not doc1
+
+
+def test_scheduler_shed_advisory_counts_but_admits(serve_stack):
+    """The advisory seam: with a firing shed-enabled objective, the
+    scheduler LOGS + COUNTS and admits normally — results identical,
+    nothing shed this round (ROADMAP item 2 acts on the probe)."""
+    from pathway_tpu.serve import ServeScheduler
+
+    _enc, _ce, _ivf, pipe = serve_stack
+    # install a firing engine as THE process engine
+    engine, hist = _synthetic_latency_engine("shed")
+    engine.evaluate(max_age_s=0.0)
+    for _ in range(200):
+        hist.observe_ns(500_000_000)
+    slo._engine = engine  # direct install: set_engine() would re-read env
+    shed0 = slo.shed_advisory_enabled()
+    slo.set_shed_advisory(True)
+    advised = observe.counter("pathway_slo_shed_advised_total")
+    try:
+        assert engine.evaluate(max_age_s=0.0)["should_shed"] is True
+        before = advised.value
+        with ServeScheduler(pipe, window_us=0, result_cache=None) as sched:
+            got = sched.serve(QUERIES)
+        assert got and all(got) and got.degraded == ()  # admitted + clean
+        assert advised.value > before  # but the advisory fired
+    finally:
+        slo.set_shed_advisory(shed0)
+        slo.reset()
+
+
+def test_slo_endpoint_serves_burn_rate_document(serve_stack):
+    import pathway_tpu as pw
+    from pathway_tpu.internals.metrics import MetricsServer
+
+    slo.reset()
+    server = MetricsServer(pw.G.engine_graph, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        doc = json.loads(
+            urllib.request.urlopen(f"{base}/slo", timeout=10).read()
+        )
+        body = (
+            urllib.request.urlopen(f"{base}/metrics", timeout=10)
+            .read()
+            .decode()
+        )
+    finally:
+        server.stop()
+    assert doc["stale"] is False
+    assert set(doc["slos"]) == {
+        "serve_latency", "serve_availability", "decode_ttlt"
+    }
+    for row in doc["slos"].values():
+        assert {"fast", "slow"} <= set(row["windows"])
+        assert row["state"] in ("ok", "firing")
+    assert "pathway_slo_burn_rate" in body
+    assert "pathway_slo_alert" in body
+    assert "pathway_slo_objective" in body
+    snap = observe.snapshot()
+    assert "slos" in snap["slo"]
+
+
+def test_decode_ttlt_histogram_feeds_the_slo(serve_stack):
+    """The decode_ttlt objective reads a real series: a continuous-
+    decode request lands in pathway_generator_ttlt_seconds."""
+    from pathway_tpu.models.generator import TextGenerator
+    from pathway_tpu.serve import ContinuousDecoder
+
+    hist = observe.histogram("pathway_generator_ttlt_seconds")
+    n0 = hist.count
+    gen = TextGenerator(
+        dimension=32, n_layers=1, n_heads=4, max_length=64,
+        vocab_size=512, kv_cache=None,
+    )
+    eng = ContinuousDecoder(gen, slots=2, step_bucket=2, window_us=0)
+    try:
+        out = eng.generate(["ttlt slo probe"], max_new_tokens=3)
+        assert len(out) == 1
+    finally:
+        eng.stop()
+    assert hist.count > n0
+    engine = slo.SloEngine(slo.default_specs())
+    doc = engine.evaluate(max_age_s=0.0)
+    assert doc["slos"]["decode_ttlt"]["total"] >= hist.count

@@ -13,9 +13,14 @@ generator.dispatch, generator.chat, clip.dispatch, exchange.send,
 qa.rerank, forward.absorb, forward.upload, forward.gather, the
 serve-cache pair cache.get / cache.put (ISSUE 8: a faulted or corrupt
 cache degrades to recompute — a MISS — never a failed or wrong serve),
-and the tracing pair trace.record / trace.export (ISSUE 9: a faulted
+the tracing pair trace.record / trace.export (ISSUE 9: a faulted
 tracing path degrades to dropped spans / a flagged-empty /traces
-payload — never a failed, wrong, or stalled serve).
+payload — never a failed, wrong, or stalled serve), and the
+observability triple profile.sample / hbm.ledger / slo.evaluate
+(ISSUE 12: a faulted profiler sample is dropped and counted, a faulted
+ledger sample serves the last-known bytes stale-flagged, a faulted SLO
+evaluation serves the last-known burn-rate document — the serve is
+never failed, slowed, or shed by its own observability).
 
 Plus: Deadline / RetryPolicy / CircuitBreaker / ServeResult units,
 ``PATHWAY_FAULTS`` parsing, the missing-doc response-metadata
@@ -1225,6 +1230,114 @@ def test_shard_skipped_stacks_with_other_rungs_once(stack):
     # both rungs clear on the next clean serve
     got2 = pipe(QUERIES)
     assert got2.ok, got2.degraded
+
+
+# -- chaos: profiler / HBM ledger / SLO engine (ISSUE 12) --------------------
+
+
+def test_profile_sample_chaos_triple_never_touches_the_serve(stack):
+    """``profile.sample`` armed raise/delay/hang: the sampled call's
+    attribution is DROPPED (counted on
+    ``pathway_profile_samples_dropped_total``) — the serve result stays
+    bit-identical, unflagged, and un-stalled (the site fires under a
+    spent deadline, so even an armed hang releases immediately)."""
+    from pathway_tpu.observe import profile
+
+    pipe = _pipeline(stack)
+    stride0 = profile.sample_stride()
+    profile.set_sample(1.0)
+    dropped = observe.counter("pathway_profile_samples_dropped_total")
+    try:
+        clean = pipe(QUERIES)
+        assert clean.ok
+        for mode, kwargs in (
+            ("raise", {}),
+            ("delay", {"delay_s": 0.02}),
+            ("hang", {"hang_s": 5.0}),
+        ):
+            before = dropped.value
+            t0 = time.perf_counter()
+            with inject.armed("profile.sample", mode, **kwargs):
+                got = pipe(QUERIES)
+            elapsed = time.perf_counter() - t0
+            assert got.degraded == (), mode
+            assert [list(r) for r in got] == [list(r) for r in clean], mode
+            assert dropped.value > before, mode
+            # an armed hang caps at the spent deadline: the serve never
+            # waits the 5 s hang budget
+            assert elapsed < 3.0, (mode, elapsed)
+    finally:
+        profile.set_sample(1.0 / max(stride0, 1) if stride0 else 0.0)
+
+
+def test_hbm_ledger_chaos_serves_stale_sample_never_raises():
+    """``hbm.ledger`` armed raise/delay/hang: the sample path degrades
+    to the last-known (stale-flagged) ledger document, counted on
+    ``pathway_hbm_samples_dropped_total`` — a scrape riding the provider
+    never fails and never stalls."""
+    from pathway_tpu.observe import hbm
+
+    fresh = hbm.sample()
+    assert fresh["stale"] is False
+    dropped = observe.counter("pathway_hbm_samples_dropped_total")
+    for mode, kwargs in (
+        ("raise", {}),
+        ("delay", {"delay_s": 0.02}),
+        ("hang", {"hang_s": 5.0}),
+    ):
+        before = dropped.value
+        t0 = time.perf_counter()
+        with inject.armed("hbm.ledger", mode, **kwargs):
+            stale = hbm.sample()
+            # the provider (scrape path) rides the same contract
+            body = "\n".join(observe.render_prometheus())
+        elapsed = time.perf_counter() - t0
+        assert stale["stale"] is True, mode
+        assert stale["total_bytes"] == fresh["total_bytes"], mode
+        assert dropped.value > before, mode
+        assert "pathway_hbm_total_bytes" in body, mode
+        assert elapsed < 3.0, (mode, elapsed)
+    assert hbm.sample()["stale"] is False  # disarmed: fresh again
+
+
+def test_slo_evaluate_chaos_serves_stale_doc_never_fails_admission(stack):
+    """``slo.evaluate`` armed raise/delay/hang: evaluation degrades to
+    the last-known (stale-flagged) document, counted on
+    ``pathway_slo_evaluations_dropped_total``; the scheduler's
+    ``should_shed`` advisory probe never raises and never stalls an
+    admission."""
+    from pathway_tpu.observe import slo
+    from pathway_tpu.serve import ServeScheduler
+
+    slo.reset()
+    clean_doc = slo.evaluate(max_age_s=0.0)
+    assert clean_doc["stale"] is False
+    dropped = observe.counter("pathway_slo_evaluations_dropped_total")
+    pipe = _pipeline(stack)
+    shed0 = slo.shed_advisory_enabled()
+    slo.set_shed_advisory(True)
+    try:
+        for mode, kwargs in (
+            ("raise", {}),
+            ("delay", {"delay_s": 0.02}),
+            ("hang", {"hang_s": 5.0}),
+        ):
+            before = dropped.value
+            t0 = time.perf_counter()
+            with inject.armed("slo.evaluate", mode, **kwargs):
+                doc = slo.evaluate(max_age_s=0.0)
+                with ServeScheduler(
+                    pipe, window_us=0, result_cache=None
+                ) as sched:
+                    got = sched.serve([QUERIES[0]])
+            elapsed = time.perf_counter() - t0
+            assert doc["stale"] is True, mode
+            assert got.degraded == () and got[0], mode
+            assert dropped.value > before, mode
+            assert elapsed < 5.0, (mode, elapsed)
+    finally:
+        slo.set_shed_advisory(shed0)
+    assert slo.evaluate(max_age_s=0.0)["stale"] is False
 
 
 # -- happy path: budget + surface -------------------------------------------
