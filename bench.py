@@ -1031,6 +1031,170 @@ def phase_profiling_overhead(backend: str, extras: dict) -> float:
     return round(overhead_pct, 3)
 
 
+def phase_sanitizer_overhead(backend: str, extras: dict) -> float:
+    """Price of the runtime lock-order sanitizer (ISSUE 13): the SAME
+    c16 coalescing serve driven over a sanitizer-wrapped stack (every
+    lock an order-recording proxy: held stacks, edge set, cycle check)
+    vs the raw-primitive stack, paired-ratio A/B with arm order
+    alternated.  The phase value is the added p50 latency in percent;
+    the budget is < 3% (BENCH_SAN_MAX_OVERHEAD_PCT overrides).  Also
+    asserts the 2+2 per-batch dispatch budget WITH the proxies
+    installed, and that the whole run records ZERO violations (the
+    sanitizer must price in clean, not by firing)."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu.analysis import sanitizer
+    from pathway_tpu.ops import dispatch_counter
+    from pathway_tpu.serve import ServeScheduler
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_SAN_DOCS", "20000" if on_tpu else "1000"))
+    k, candidates = 10, 32
+    conc = 16
+    window_us = float(os.environ.get("BENCH_SAN_WINDOW_US", "5000"))
+    max_batch = int(
+        os.environ.get("BENCH_SAN_MAX_BATCH", "16" if on_tpu else "4")
+    )
+
+    # two identical stacks: one built with raw primitives, one with the
+    # sanitizer installed so EVERY lock in it is a proxy (uninstalling
+    # later never unwraps existing proxies, so each arm keeps its kind)
+    sanitizer.uninstall()
+    pipe_off, _c0, docs, _q0 = _build_rr_pipeline(
+        n_docs, 16, k, candidates, small=not on_tpu
+    )
+    sanitizer.install()
+    try:
+        pipe_on, _c1, _d1, _q1 = _build_rr_pipeline(
+            n_docs, 16, k, candidates, small=not on_tpu
+        )
+    finally:
+        sanitizer.uninstall()
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(32)
+    ]
+    for pipe in (pipe_off, pipe_on):
+        for q in pool[:8]:
+            pipe([q], k)
+        for b in (2, 4, 8, 16):
+            pipe(sorted(set(pool))[:b], k)
+
+    def drive(pipe, armed: bool, n_req: int):
+        """One c16 burst; the install state is toggled around the burst
+        so runtime-created locks (per-batch handoff locks) follow the
+        arm being measured."""
+        if armed:
+            sanitizer.install()
+        else:
+            sanitizer.uninstall()
+        lats: list = [None] * n_req
+        errs: list = []
+        sched = ServeScheduler(
+            pipe, window_us=window_us, max_batch=max_batch, result_cache=None
+        )
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    t0 = time.perf_counter()
+                    rows = sched.serve([pool[(i * 7) % len(pool)]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    assert rows and rows[0]
+            except Exception as exc:
+                errs.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sched.stop()
+        if errs:
+            raise RuntimeError(f"sanitizer_overhead c{conc} failed: {errs[:3]}")
+        return np.asarray([l for l in lats if l is not None])
+
+    try:
+        # per-batch 2+2 with the proxies installed: order recording must
+        # never add a device round trip
+        sanitizer.install()
+        with ServeScheduler(
+            pipe_on, window_us=200_000, result_cache=None
+        ) as sched:
+            with dispatch_counter.DispatchCounter() as counter:
+                res, errs = [], []
+                barrier = threading.Barrier(8)
+
+                def w(q):
+                    try:
+                        barrier.wait(timeout=30)
+                        res.append(sched.serve([q], k))
+                    except Exception as exc:
+                        errs.append(repr(exc))
+
+                threads = [
+                    threading.Thread(target=w, args=(q,)) for q in pool[:8]
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert not errs, errs[:3]
+            batches = max(1, sched.stats["batches"] + sched.stats["solo"])
+        extras["sanitizer_dispatches_per_batch"] = round(
+            counter.dispatches / batches, 2
+        )
+        assert counter.dispatches <= 2 * batches, (counter.events, batches)
+        assert counter.fetches <= 2 * batches, (counter.events, batches)
+
+        # paired A/B: per-round on/off p50 ratios, arm order alternated
+        rounds = int(os.environ.get("BENCH_SAN_ROUNDS", "5"))
+        n_req = int(os.environ.get("BENCH_SAN_REQUESTS", str(conc * 8)))
+        lat = {True: [], False: []}
+        ratios = []
+        for r in range(rounds):
+            order = (True, False) if r % 2 == 0 else (False, True)
+            round_p50 = {}
+            for mode in order:
+                pipe = pipe_on if mode else pipe_off
+                drive(pipe, mode, 2 * conc)  # settle after the flip
+                arm = drive(pipe, mode, n_req)
+                lat[mode].append(arm)
+                round_p50[mode] = float(np.percentile(arm, 50))
+            ratios.append(round_p50[True] / max(round_p50[False], 1e-9))
+    finally:
+        if sanitizer.enabled_from_env():
+            sanitizer.install()
+        else:
+            sanitizer.uninstall()
+    p50_on = float(np.percentile(np.concatenate(lat[True]), 50))
+    p50_off = float(np.percentile(np.concatenate(lat[False]), 50))
+    overhead_pct = (float(np.median(ratios)) - 1.0) * 100.0
+    stats = sanitizer.stats()
+    extras["sanitizer_p50_on_ms"] = round(p50_on, 3)
+    extras["sanitizer_p50_off_ms"] = round(p50_off, 3)
+    extras["sanitizer_round_ratios"] = [round(x, 4) for x in ratios]
+    extras["sanitizer_overhead_pct"] = round(overhead_pct, 3)
+    extras["sanitizer_locks_tracked"] = stats["locks_tracked"]
+    extras["sanitizer_edges_observed"] = stats["edges_observed"]
+    extras["sanitizer_violations"] = stats["violations"]
+    assert all(v == 0 for v in stats["violations"].values()), (
+        f"sanitizer recorded violations on the clean serve stack: "
+        f"{stats['violations']}"
+    )
+    max_pct = float(os.environ.get("BENCH_SAN_MAX_OVERHEAD_PCT", "3.0"))
+    assert overhead_pct < max_pct, (
+        f"sanitizer overhead {overhead_pct:.2f}% exceeds the {max_pct}% "
+        f"budget (p50 on {p50_on:.3f} ms vs off {p50_off:.3f} ms)"
+    )
+    return round(overhead_pct, 3)
+
+
 def phase_fault_tolerance(backend: str, extras: dict) -> float:
     """Price and prove the serve-path fault-tolerance layer (ISSUE 4,
     pathway_tpu/robust): the SAME steady-state fused retrieve→rerank
@@ -2504,6 +2668,7 @@ _PHASES = {
     "observe_overhead": (phase_observe_overhead, 450),
     "tracing_overhead": (phase_tracing_overhead, 450),
     "profiling_overhead": (phase_profiling_overhead, 450),
+    "sanitizer_overhead": (phase_sanitizer_overhead, 450),
     "fault_tolerance": (phase_fault_tolerance, 450),
     "concurrent_serve": (phase_concurrent_serve, 600),
     "sharded_serve": (phase_sharded_serve, 600),
@@ -2733,6 +2898,7 @@ def main() -> None:
         ("observe_overhead", lambda: device_phase("observe_overhead")),
         ("tracing_overhead", lambda: device_phase("tracing_overhead")),
         ("profiling_overhead", lambda: device_phase("profiling_overhead")),
+        ("sanitizer_overhead", lambda: device_phase("sanitizer_overhead")),
         ("fault_tolerance", lambda: device_phase("fault_tolerance")),
         ("concurrent_serve", lambda: device_phase("concurrent_serve")),
         ("sharded_serve", lambda: device_phase("sharded_serve")),
@@ -2769,6 +2935,8 @@ def main() -> None:
             extras["tracing_overhead_pct"] = round(value, 3)
         elif name == "profiling_overhead" and value is not None:
             extras["profiling_overhead_pct"] = round(value, 3)
+        elif name == "sanitizer_overhead" and value is not None:
+            extras["sanitizer_overhead_pct"] = round(value, 3)
         elif name == "fault_tolerance" and value is not None:
             extras["fault_overhead_pct"] = round(value, 3)
         elif name == "concurrent_serve" and value is not None:

@@ -18,6 +18,20 @@ Usage mirrors the reference's ``import pathway as pw`` surface::
 
 from __future__ import annotations
 
+# the runtime lock-order sanitizer must patch the threading constructors
+# BEFORE any pathway module creates its locks — this import chain is
+# where they all get created, so the hook runs first.  The env test is
+# inlined (mirrors sanitizer.enabled_from_env) so the analysis package
+# (pure stdlib, but six modules) loads only when the knob is ON.
+import os as _os
+
+if _os.environ.get("PATHWAY_LOCK_SANITIZER", "").strip() not in (
+    "", "0", "false", "off",
+):
+    from .analysis.sanitizer import install as _sanitizer_install
+
+    _sanitizer_install()
+
 from .internals import dtype as dt
 from .internals import api_reducers as reducers
 from .internals.expression import (

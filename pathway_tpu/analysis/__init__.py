@@ -1,7 +1,7 @@
 """Hot-path static analysis for pathway_tpu.
 
-An AST lint framework plus three rule families that make the round-5 bug
-classes impossible to reintroduce silently:
+An AST lint framework plus four rule families that make the round-5 bug
+classes (and the deadlock class) impossible to reintroduce silently:
 
 - ``lock-discipline`` — device dispatch / host sync / GIL-holding C calls
   lexically inside ``with <lock>:`` bodies (the ``ops/ivf.py``
@@ -10,11 +10,18 @@ classes impossible to reintroduce silently:
 - ``hidden-sync`` — implicit host round trips on serve-path modules,
   cross-checked against the ``ops/dispatch_counter.py`` budget;
 - ``recompile-hazard`` — jitted calls fed unbucketed Python-varying
-  shapes (paired with the runtime tripwire in ``ops/recompile_guard.py``).
+  shapes (paired with the runtime tripwire in ``ops/recompile_guard.py``);
+- ``lock-order`` — the whole-program concurrency sanitizer
+  (``lock_order.py`` + ``lock_ranks.py``): lock-acquisition hierarchy
+  inversions, deadlock cycles with witness paths, ``Condition.wait``
+  holding a second lock, locks in jitted scopes — paired with the
+  runtime tripwire in ``sanitizer.py`` (``PATHWAY_LOCK_SANITIZER=1``).
 
 Run ``python -m pathway_tpu.analysis pathway_tpu/`` for file:line
-diagnostics; suppress a reviewed finding in place with
-``# pathway: allow(<rule>): <reason>``.  The tier-1 gate
+diagnostics (``--format sarif`` for CI diff annotation,
+``--check-pragmas`` for stale-waiver audit, ``PATHWAY_ANALYSIS_CACHE``
+for incremental repo-wide runs); suppress a reviewed finding in place
+with ``# pathway: allow(<rule>): <reason>``.  The tier-1 gate
 (``tests/test_analysis.py``) asserts the whole tree stays clean.
 """
 
@@ -27,15 +34,18 @@ from .core import (
     analyze_source,
     default_rules,
     iter_py_files,
+    stale_pragma_findings,
 )
 from .hidden_sync import HiddenSyncRule
 from .lock_discipline import LockDisciplineRule
+from .lock_order import LockOrderRule
 from .recompile_hazard import RecompileHazardRule
 
 __all__ = [
     "Finding",
     "HiddenSyncRule",
     "LockDisciplineRule",
+    "LockOrderRule",
     "ModuleContext",
     "RecompileHazardRule",
     "Rule",
@@ -45,6 +55,7 @@ __all__ = [
     "default_rules",
     "iter_py_files",
     "main",
+    "stale_pragma_findings",
 ]
 
 

@@ -8,7 +8,17 @@ audits every pragma allowance alongside the live findings.
 Machine-readable output: ``--format json`` emits ONE JSON document
 (``{"findings": [...], "live": N, "suppressed": M}`` — the CI-friendly
 shape); ``--format jsonl`` (alias: the legacy ``--json`` flag) emits one
-JSON record per finding.  Exit codes are identical across formats.
+JSON record per finding; ``--format sarif`` emits a SARIF 2.1.0 log so
+CI can annotate findings directly onto PR diffs (suppressed findings
+ride along as SARIF suppressions).  Exit codes are identical across
+formats.
+
+``--check-pragmas`` additionally reports every suppression pragma that
+no longer suppresses any finding (stale waivers rot: the violation they
+blessed was fixed or moved, and a dead pragma silently blesses the NEXT
+violation near it).  ``PATHWAY_ANALYSIS_CACHE=<dir>`` arms the
+content-hash incremental cache so repo-wide runs re-parse only changed
+modules.
 
 The analysis modules themselves are pure stdlib + AST (no jax import),
 so the lint runs anywhere — pre-commit, CI boxes with no accelerator, a
@@ -20,16 +30,87 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
-from .core import analyze_paths, default_rules
+from .core import Finding, analyze_paths, default_rules, stale_pragma_findings
+
+# SARIF severity: every rule here is a correctness gate, so findings map
+# to "error"; suppressed ones carry a SARIF suppression object instead
+_SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def render_sarif(findings: Sequence[Finding]) -> dict:
+    """One SARIF 2.1.0 log for the whole run — deterministic (findings
+    arrive sorted), so the golden-file test can assert bytes."""
+    rule_ids = sorted({f.rule for f in findings})
+    descriptions = {
+        rule.name: rule.description for rule in default_rules()
+    }
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace("\\", "/"),
+                        },
+                        "region": {
+                            "startLine": max(1, f.line),
+                            "startColumn": max(1, f.col + 1),
+                        },
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [
+                {
+                    "kind": "inSource",
+                    "justification": f.reason or "",
+                }
+            ]
+        results.append(result)
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": _SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "pathway-analysis",
+                        "informationUri": (
+                            "python -m pathway_tpu.analysis"
+                        ),
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {
+                                    "text": descriptions.get(rid, rid)
+                                },
+                            }
+                            for rid in rule_ids
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pathway_tpu.analysis",
         description="Hot-path lint: lock-discipline, hidden-sync, "
-        "recompile-hazard.",
+        "recompile-hazard, lock-order.",
     )
     parser.add_argument(
         "paths", nargs="*", default=["pathway_tpu"],
@@ -40,10 +121,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="also print suppressed findings with their pragma reasons",
     )
     parser.add_argument(
-        "--format", choices=("text", "json", "jsonl"), default="text",
-        dest="fmt",
+        "--check-pragmas", action="store_true",
+        help="also report suppression pragmas that no longer suppress "
+        "any finding (stale waivers)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "jsonl", "sarif"),
+        default="text", dest="fmt",
         help="output format: human text (default), one JSON document "
-        "(json), or one JSON record per finding (jsonl)",
+        "(json), one JSON record per finding (jsonl), or a SARIF 2.1.0 "
+        "log for CI diff annotation (sarif)",
     )
     parser.add_argument(
         "--json", action="store_const", const="jsonl", dest="fmt",
@@ -60,9 +147,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{rule.name}: {rule.description}")
         return 0
 
-    findings = analyze_paths(args.paths)
+    findings, pragma_map = analyze_paths(args.paths, return_pragmas=True)
+    if args.check_pragmas:
+        findings = list(findings) + stale_pragma_findings(pragma_map)
     live = [f for f in findings if not f.suppressed]
     n_sup = len(findings) - len(live)
+    if args.fmt == "sarif":
+        print(json.dumps(render_sarif(findings), indent=1, sort_keys=True))
+        return 1 if live else 0
     if args.fmt == "json":
         # one complete document: what a CI step or the tier-1 gate wants
         # to parse — every finding (suppressed ones carry their reason),

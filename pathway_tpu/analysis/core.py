@@ -28,7 +28,9 @@ Framework pieces (rules live in sibling modules):
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
+import json
 import os
 import re
 import tokenize
@@ -44,6 +46,7 @@ __all__ = [
     "analyze_source",
     "default_rules",
     "iter_py_files",
+    "stale_pragma_findings",
 ]
 
 _PRAGMA_RE = re.compile(
@@ -86,6 +89,7 @@ class _Pragma:
     reason: Optional[str]
     whole_file: bool
     span: Tuple[int, int] = (0, 0)  # statement body the pragma covers
+    used: bool = False  # matched at least one finding (stale-waiver audit)
 
 
 class ModuleContext:
@@ -119,17 +123,36 @@ class ModuleContext:
         )
 
     def _suppression_for(self, rule: str, line: int) -> Tuple[bool, Optional[str]]:
-        for p in self.pragmas:
-            if rule not in p.rules and "*" not in p.rules:
-                continue
-            if p.whole_file or p.line == line or p.span[0] <= line <= p.span[1]:
-                return True, p.reason
-        return False, None
+        return _suppress_with(self.pragmas, rule, line)
+
+
+def _suppress_with(
+    pragmas: Sequence[_Pragma], rule: str, line: int
+) -> Tuple[bool, Optional[str]]:
+    """First pragma covering (rule, line) wins; the match is recorded on
+    the pragma so ``--check-pragmas`` can flag waivers that no longer
+    suppress anything."""
+    for p in pragmas:
+        if rule not in p.rules and "*" not in p.rules:
+            continue
+        if p.whole_file or p.line == line or p.span[0] <= line <= p.span[1]:
+            p.used = True
+            return True, p.reason
+    return False, None
 
 
 class Rule:
     """Base rule: subclasses set ``name`` and implement ``run(ctx)``,
-    reporting through ``ctx.report`` (suppression is applied centrally)."""
+    reporting through ``ctx.report`` (suppression is applied centrally).
+
+    **Whole-program rules** (the lock-order family) additionally define
+    ``finalize() -> List[Finding]``: ``run`` extracts a per-module
+    summary, ``finalize`` is called ONCE after every module has been
+    seen and returns cross-module findings (suppression is applied by
+    the caller from each finding's own module's pragmas).  For the
+    incremental cache they also define ``dump_summary(path) -> dict``
+    (JSON-able per-module facts) and ``load_summary(path, summary)``
+    (rehydrate a cache hit without re-parsing)."""
 
     name = "rule"
     description = ""
@@ -193,9 +216,68 @@ def _attach_spans(pragmas: List[_Pragma], tree: ast.Module) -> None:
 def default_rules() -> List[Rule]:
     from .hidden_sync import HiddenSyncRule
     from .lock_discipline import LockDisciplineRule
+    from .lock_order import LockOrderRule
     from .recompile_hazard import RecompileHazardRule
 
-    return [LockDisciplineRule(), HiddenSyncRule(), RecompileHazardRule()]
+    return [
+        LockDisciplineRule(),
+        HiddenSyncRule(),
+        RecompileHazardRule(),
+        LockOrderRule(),
+    ]
+
+
+def _run_module(
+    source: str,
+    display_path: str,
+    rules: Sequence[Rule],
+    real_path: Optional[str] = None,
+) -> Tuple[Optional[ModuleContext], List[Finding]]:
+    """Parse + run the per-module side of every rule.  Whole-program
+    findings (rule.finalize) are NOT included — the caller owns that."""
+    try:
+        ctx = ModuleContext(real_path or display_path, display_path, source)
+    except SyntaxError as exc:
+        return None, [
+            Finding(
+                display_path, exc.lineno or 0, exc.offset or 0,
+                "parse-error", f"could not parse: {exc.msg}",
+            )
+        ]
+    for rule in rules:
+        rule.run(ctx)
+    # a pragma with no reason is itself a violation: allowances must be
+    # reviewable, and "because it complained" is not a review
+    for p in ctx.pragmas:
+        if p.reason is None:
+            ctx.findings.append(
+                Finding(
+                    display_path, p.line, 0, "pragma-missing-reason",
+                    "suppression pragma without a ': <reason>' — every "
+                    "allowance must record why it is safe",
+                )
+            )
+    return ctx, ctx.findings
+
+
+def _finalize_rules(
+    rules: Sequence[Rule], pragma_map: Dict[str, List[_Pragma]]
+) -> List[Finding]:
+    """Collect whole-program findings and apply each one's own module's
+    pragma suppression (a waiver lives at the acquisition site it
+    blesses, exactly like per-module findings)."""
+    out: List[Finding] = []
+    for rule in rules:
+        finalize = getattr(rule, "finalize", None)
+        if finalize is None:
+            continue
+        for f in finalize():
+            suppressed, reason = _suppress_with(
+                pragma_map.get(f.path, ()), f.rule, f.line
+            )
+            f.suppressed, f.reason = suppressed, reason
+            out.append(f)
+    return out
 
 
 def analyze_file(
@@ -216,30 +298,16 @@ def analyze_source(
     rules: Optional[Sequence[Rule]] = None,
     real_path: Optional[str] = None,
 ) -> List[Finding]:
-    try:
-        ctx = ModuleContext(real_path or display_path, display_path, source)
-    except SyntaxError as exc:
-        return [
-            Finding(
-                display_path, exc.lineno or 0, exc.offset or 0,
-                "parse-error", f"could not parse: {exc.msg}",
-            )
-        ]
-    for rule in rules if rules is not None else default_rules():
-        rule.run(ctx)
-    # a pragma with no reason is itself a violation: allowances must be
-    # reviewable, and "because it complained" is not a review
-    for p in ctx.pragmas:
-        if p.reason is None:
-            ctx.findings.append(
-                Finding(
-                    display_path, p.line, 0, "pragma-missing-reason",
-                    "suppression pragma without a ': <reason>' — every "
-                    "allowance must record why it is safe",
-                )
-            )
-    ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
-    return ctx.findings
+    """Single-module entry (fixtures, one-file CLI runs): per-module
+    rules plus the whole-program pass over just this module."""
+    rules = list(rules) if rules is not None else default_rules()
+    ctx, findings = _run_module(source, display_path, rules, real_path)
+    if ctx is not None:
+        findings.extend(
+            _finalize_rules(rules, {display_path: ctx.pragmas})
+        )
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
 
 
 def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
@@ -258,15 +326,170 @@ def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
                     yield os.path.join(root, name)
 
 
+# -- incremental analysis cache -------------------------------------------
+#
+# PATHWAY_ANALYSIS_CACHE=<dir> keys one JSON record per module on a
+# content hash salted with the analyzer's OWN sources (any rule change
+# invalidates everything) — the repo-wide tier-1 gate then re-parses
+# only changed modules.  Cached records carry the per-module findings,
+# the pragma table (with spans — whole-program suppression needs them
+# without re-parsing) and each whole-program rule's module summary, so
+# warm runs produce bit-identical findings to cold ones.
+
+_CACHE_SALT: Optional[str] = None
+
+
+def _analysis_salt() -> str:
+    global _CACHE_SALT
+    if _CACHE_SALT is None:
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        for name in sorted(os.listdir(pkg)):
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(pkg, name), "rb") as fh:
+                h.update(name.encode())
+                h.update(fh.read())
+        _CACHE_SALT = h.hexdigest()
+    return _CACHE_SALT
+
+
+def _cache_dir() -> Optional[str]:
+    return os.environ.get("PATHWAY_ANALYSIS_CACHE") or None
+
+
+def _cache_key(display: str, source: bytes) -> str:
+    h = hashlib.sha256()
+    h.update(_analysis_salt().encode())
+    h.update(display.encode())
+    h.update(b"\0")
+    h.update(source)
+    return h.hexdigest()
+
+
+def _cache_load(cache_dir: str, key: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(cache_dir, key + ".json")) as fh:
+            record = json.load(fh)
+        return record if record.get("v") == 1 else None
+    except (OSError, ValueError):
+        return None
+
+
+def _cache_store(cache_dir: str, key: str, record: dict) -> None:
+    # best effort: an unwritable cache degrades to a cold run, never an
+    # analysis failure
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = os.path.join(cache_dir, f".{key}.tmp.{os.getpid()}")
+        with open(tmp, "w") as fh:
+            json.dump(record, fh)
+        os.replace(tmp, os.path.join(cache_dir, key + ".json"))
+    except OSError:
+        pass
+
+
+def _pragma_to_json(p: _Pragma) -> dict:
+    return {
+        "line": p.line, "rules": sorted(p.rules), "reason": p.reason,
+        "whole_file": p.whole_file, "span": list(p.span), "used": p.used,
+    }
+
+
+def _pragma_from_json(d: dict) -> _Pragma:
+    return _Pragma(
+        line=d["line"], rules=set(d["rules"]), reason=d["reason"],
+        whole_file=d["whole_file"], span=tuple(d["span"]), used=d["used"],
+    )
+
+
 def analyze_paths(
-    paths: Sequence[str], rules: Optional[Sequence[Rule]] = None
-) -> List[Finding]:
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    return_pragmas: bool = False,
+):
+    """Repo walker used by the CLI and the tier-1 gate: per-module rules
+    over every ``.py`` under ``paths``, then the whole-program pass
+    (lock-order graph) over all of them together.  With
+    ``return_pragmas=True`` returns ``(findings, pragma_map)`` so the
+    caller can audit stale waivers (``--check-pragmas``)."""
     rules = list(rules) if rules is not None else default_rules()
     findings: List[Finding] = []
+    pragma_map: Dict[str, List[_Pragma]] = {}
+    cache_dir = _cache_dir()
     base = os.getcwd()
     for file_path in iter_py_files(paths):
         display = os.path.relpath(file_path, base)
         if display.startswith(".."):
             display = file_path
-        findings.extend(analyze_file(file_path, rules=rules, display_path=display))
+        with open(file_path, "rb") as fh:
+            raw = fh.read()
+        key = _cache_key(display, raw) if cache_dir else None
+        record = _cache_load(cache_dir, key) if cache_dir else None
+        if record is not None:
+            module_findings = [Finding(**f) for f in record["findings"]]
+            pragmas = [_pragma_from_json(p) for p in record["pragmas"]]
+            for rule in rules:
+                loader = getattr(rule, "load_summary", None)
+                summary = record["summaries"].get(rule.name)
+                if loader is not None and summary is not None:
+                    loader(display, summary)
+        else:
+            source = raw.decode("utf-8")
+            ctx, module_findings = _run_module(
+                source, display, rules, real_path=file_path
+            )
+            module_findings.sort(key=lambda f: (f.line, f.col, f.rule))
+            pragmas = ctx.pragmas if ctx is not None else []
+            if cache_dir:
+                summaries = {}
+                for rule in rules:
+                    dumper = getattr(rule, "dump_summary", None)
+                    if dumper is not None:
+                        summary = dumper(display)
+                        if summary is not None:
+                            summaries[rule.name] = summary
+                _cache_store(
+                    cache_dir, key,
+                    {
+                        "v": 1,
+                        "findings": [f.__dict__ for f in module_findings],
+                        "pragmas": [_pragma_to_json(p) for p in pragmas],
+                        "summaries": summaries,
+                    },
+                )
+        findings.extend(module_findings)
+        pragma_map[display] = pragmas
+    extra = _finalize_rules(rules, pragma_map)
+    extra.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    findings.extend(extra)
+    if return_pragmas:
+        return findings, pragma_map
     return findings
+
+
+def stale_pragma_findings(
+    pragma_map: Dict[str, List[_Pragma]]
+) -> List[Finding]:
+    """``--check-pragmas``: every suppression pragma that matched ZERO
+    findings is itself reported — a waiver that no longer waives
+    anything is rot (the code it blessed moved or was fixed), and it
+    would silently bless the NEXT violation added near it."""
+    out: List[Finding] = []
+    for path in sorted(pragma_map):
+        for p in pragma_map[path]:
+            if p.used or p.reason is None:
+                # reasonless pragmas are already reported as
+                # pragma-missing-reason; don't double-count them here
+                continue
+            rules = ", ".join(sorted(p.rules))
+            out.append(
+                Finding(
+                    path, p.line, 0, "stale-pragma",
+                    f"suppression pragma allow({rules}) no longer "
+                    "suppresses any finding — the violation it waived "
+                    "was fixed or moved; delete the pragma (reason was: "
+                    f"{p.reason})",
+                )
+            )
+    return out

@@ -659,7 +659,7 @@ class FusedEncodeSearch:
                 # deterministically (the generic shard.dispatch site
                 # fires inside retry_call and models transient faults)
                 inject.fire(f"shard.dispatch.{s}", deadline=deadline)
-                with jax.default_device(group.device(s)), child._lock:
+                with jax.default_device(group.device(s)), child._lock:  # pathway: allow(lock-order): rank exception index(3)<scheduler(5) — the fused-serve pair order is index-before-pipeline at EVERY site (absorb DONATES slab buffers, forcing launch-before-unlock under the shard's index lock; the compiled-getter guard self._lock nests briefly inside), so the pair is globally ordered and deadlock-free
                     if child._slabs is None:
                         child.build()  # first build only
                     else:
@@ -1077,7 +1077,7 @@ class FusedEncodeSearch:
             z, encoded = self._cached_embeddings(ids, mask, n_real, deadline)
             stage1_launches = 2 if encoded else 1
         if self._ivf:
-            with index._lock, self._lock:
+            with index._lock, self._lock:  # pathway: allow(lock-order): rank exception index(3)<scheduler(5) — index-before-pipeline is the fused-serve pair order at EVERY site (IVF absorb DONATES slab buffers, so the stage-1 launch must precede unlocking the index; self._lock nests inside to guard the compiled-fn cache), globally ordered with the shard fan-out's child._lock→self._lock
                 return self._submit_ivf(
                     texts, ids, mask, n_real, k, t_start, deadline,
                     z=z, stage1_launches=stage1_launches,
@@ -1103,7 +1103,7 @@ class FusedEncodeSearch:
         bucket-padded off-lock by the caller; ``z``/``stage1_launches``
         as in ``_submit_ivf``)."""
         index = self.index
-        with index._lock, self._lock:
+        with index._lock, self._lock:  # pathway: allow(lock-order): rank exception index(3)<scheduler(5) — same index-before-pipeline pair order as the IVF branch (one global order for the pair keeps it deadlock-free; the exact index swaps buffers functionally but shares the submit shape)
             n_items = len(index.key_to_slot)
             if n_items == 0:
                 empty = ServeResult(

@@ -636,7 +636,7 @@ class ContinuousDecoder(_CoalescerBase):
                     chunk=self.chunk,
                 )
         t0 = time.perf_counter_ns()
-        try:  # pathway: allow(recompile-hazard): every per-slot array here is a fixed [slots]-shaped row of the static pool — one compile signature per engine, asserted by the census test
+        try:
             args = (
                 gen.params, self._pk, self._pv, jnp.asarray(tok),
                 jnp.asarray(pos), jnp.asarray(act), jnp.asarray(left),

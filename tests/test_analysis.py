@@ -12,6 +12,8 @@ from __future__ import annotations
 import os
 import textwrap
 
+import pytest
+
 from pathway_tpu.analysis import analyze_paths, analyze_source, main
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1102,6 +1104,460 @@ def test_recompile_hazard_pragma_suppresses():
     assert any(f.rule == "recompile-hazard" and f.suppressed for f in findings)
 
 
+# -- lock-order (ISSUE 13) ---------------------------------------------------
+
+def test_lock_order_flags_three_lock_cycle_with_witness():
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+                self._clock = threading.Lock()
+
+            def f(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def g(self):
+                with self._block:
+                    with self._clock:
+                        pass
+
+            def h(self):
+                with self._clock:
+                    with self._alock:
+                        pass
+    """
+    live = _live(_run(src, "fixtures/cyc3.py"), "lock-order")
+    assert len(live) == 1, live
+    msg = live[0].message
+    assert "deadlock cycle" in msg
+    # full witness path: all three locks, each hop with file:line
+    for attr in ("_alock", "_block", "_clock"):
+        assert f"fixtures.cyc3.A.{attr}" in msg
+    assert msg.count("fixtures/cyc3.py:") == 3
+
+
+def test_lock_order_rank_inversion_across_modules(tmp_path):
+    """A module under observe/ holding its lock while reaching a
+    scheduler-rank lock through a helper call — the inversion is
+    interprocedural AND cross-module."""
+    obs = tmp_path / "pathway_tpu" / "observe"
+    srv = tmp_path / "pathway_tpu" / "serve"
+    obs.mkdir(parents=True)
+    srv.mkdir(parents=True)
+    (obs / "histo.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            _obs_lock = threading.Lock()
+            def rec(sched):
+                with _obs_lock:
+                    sched.admit_probe()
+            """
+        )
+    )
+    (srv / "scheduler.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            class S:
+                def __init__(self):
+                    self._qlock = threading.Lock()
+                def admit_probe(self):
+                    with self._qlock:
+                        pass
+            """
+        )
+    )
+    findings = analyze_paths([str(tmp_path / "pathway_tpu")])
+    live = [
+        f for f in findings if f.rule == "lock-order" and not f.suppressed
+    ]
+    assert len(live) == 1, live
+    assert "rank inversion" in live[0].message
+    assert "observe(0)" in live[0].message
+    assert "scheduler(5)" in live[0].message
+    assert live[0].path.endswith("histo.py")
+    # the witness chain names the helper the edge flows through
+    assert "admit_probe" in live[0].message
+
+
+def test_lock_order_cond_wait_holding_second_lock():
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def f(self):
+                with self._lock:
+                    with self._cv:
+                        self._cv.wait()
+    """
+    live = _live(_run(src, "fixtures/wait.py"), "lock-order")
+    assert len(live) == 1, live
+    assert "Condition.wait releases only its OWN lock" in live[0].message
+    # waiting while holding ONLY the condition's own lock (the
+    # scheduler's _qlock/_cond handoff shape: Condition wraps the lock)
+    good = """
+        import threading
+
+        class Sched:
+            def __init__(self):
+                self._qlock = threading.Lock()
+                self._cond = threading.Condition(self._qlock)
+
+            def collect(self):
+                with self._cond:
+                    self._cond.wait(0.1)
+    """
+    assert _live(_run(good, "fixtures/handoff.py"), "lock-order") == []
+
+
+def test_lock_order_helper_resolved_nested_acquisition():
+    """A second lock reached through a helper method is an edge exactly
+    like a lexically nested `with` — two helpers disagreeing on order is
+    the classic hidden ABBA."""
+    src = """
+        import threading
+
+        class P:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def f(self):
+                with self._alock:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._block:
+                    pass
+
+            def g(self):
+                with self._block:
+                    self._take_a()
+
+            def _take_a(self):
+                with self._alock:
+                    pass
+    """
+    live = _live(_run(src, "fixtures/helpers.py"), "lock-order")
+    assert len(live) == 1, live
+    assert "deadlock cycle" in live[0].message
+
+
+def test_lock_order_self_deadlock_plain_lock_via_helper():
+    src = """
+        import threading
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """
+    live = _live(_run(src, "fixtures/selfdl.py"), "lock-order")
+    assert len(live) == 1 and "self-deadlock" in live[0].message
+    # the SAME shape over an RLock is the sanctioned re-entry pattern
+    # (ops/ivf.py maintenance): no finding
+    rlock = src.replace("threading.Lock()", "threading.RLock()")
+    assert _live(_run(rlock, "fixtures/selfdl.py"), "lock-order") == []
+
+
+def test_lock_order_lock_in_jitted_scope():
+    src = """
+        import threading
+
+        import jax
+
+        lock = threading.Lock()
+
+        @jax.jit
+        def _kernel(x):
+            with lock:
+                return x * 2
+    """
+    live = _live(_run(src), "lock-order")
+    assert len(live) == 1
+    assert "jitted dispatch scope" in live[0].message
+
+
+def test_lock_order_pragma_waives_rank_exception(tmp_path):
+    obs = tmp_path / "pathway_tpu" / "cache"
+    srv = tmp_path / "pathway_tpu" / "serve"
+    obs.mkdir(parents=True)
+    srv.mkdir(parents=True)
+    (obs / "tier.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            class Tier:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def fill_probe(self, sched):
+                    with self._lock:  # pathway: allow(lock-order): fixture — reviewed rank exception cache<scheduler
+                        sched.admit_probe()
+            """
+        )
+    )
+    (srv / "scheduler.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            class S:
+                def __init__(self):
+                    self._qlock = threading.Lock()
+                def admit_probe(self):
+                    with self._qlock:
+                        pass
+            """
+        )
+    )
+    findings = analyze_paths([str(tmp_path / "pathway_tpu")])
+    assert [
+        f for f in findings if f.rule == "lock-order" and not f.suppressed
+    ] == []
+    waived = [
+        f for f in findings if f.rule == "lock-order" and f.suppressed
+    ]
+    assert len(waived) == 1
+    assert "reviewed rank exception" in waived[0].reason
+
+
+def test_lock_order_inherited_lock_is_one_graph_node(tmp_path):
+    """A lock DEFINED in a cross-module base class is the same physical
+    lock in every subclass: an ABBA whose two halves spell it as
+    ``base._qlock`` and ``sub._qlock`` must still close ONE cycle (the
+    decode engine inherits the scheduler's ``_qlock``/``_cond`` this
+    way)."""
+    pkg = tmp_path / "pathway_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "sched.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class Base:
+                def __init__(self):
+                    self._qlock = threading.Lock()
+                    self._other_lock = threading.Lock()
+
+                def fwd(self):
+                    with self._qlock:
+                        with self._other_lock:
+                            pass
+            """
+        )
+    )
+    (pkg / "decode.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            from .sched import Base
+
+            class Engine(Base):
+                def bwd(self):
+                    with self._other_lock:
+                        with self._qlock:
+                            pass
+            """
+        )
+    )
+    findings = analyze_paths([str(tmp_path / "pathway_tpu")])
+    live = [
+        f for f in findings if f.rule == "lock-order" and not f.suppressed
+    ]
+    assert len(live) == 1, live
+    assert "deadlock cycle" in live[0].message
+    # one node per physical lock: the witness names the DEFINING class
+    assert live[0].message.count("Base._qlock") >= 1
+    assert "Engine._qlock" not in live[0].message
+
+
+# -- --check-pragmas (stale waivers) ----------------------------------------
+
+def test_stale_pragma_detection(tmp_path):
+    from pathway_tpu.analysis.core import stale_pragma_findings
+
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            import pickle
+            import threading
+
+            def f(lock, a):
+                with lock:
+                    x = pickle.dumps(a)  # pathway: allow(lock-discipline): fixture — live waiver
+                return x
+
+            def g(a):
+                return len(a)  # pathway: allow(lock-discipline): fixture — STALE: nothing here violates
+            """
+        )
+    )
+    findings, pragmas = analyze_paths([str(mod)], return_pragmas=True)
+    stale = stale_pragma_findings(pragmas)
+    assert len(stale) == 1, stale
+    assert stale[0].rule == "stale-pragma"
+    assert "STALE" in stale[0].message  # carries the dead reason
+    assert stale[0].line == 8 or "len" not in stale[0].message
+
+
+def test_repo_has_no_stale_pragmas(repo_analysis):
+    """Satellite gate: every suppression pragma in the tree still
+    suppresses at least one finding (``--check-pragmas`` clean)."""
+    from pathway_tpu.analysis.core import stale_pragma_findings
+
+    _findings, pragmas = repo_analysis
+    stale = stale_pragma_findings(pragmas)
+    assert stale == [], "stale waivers (fix or delete):\n" + "\n".join(
+        f.format() for f in stale
+    )
+
+
+def test_cli_check_pragmas_flag(tmp_path, capsys):
+    mod = tmp_path / "stale.py"
+    mod.write_text(
+        "def g(a):\n"
+        "    return len(a)  # pathway: allow(lock-discipline): fixture — dead\n"
+    )
+    assert main([str(mod)]) == 0  # without the flag: clean
+    assert main([str(mod), "--check-pragmas"]) == 1
+    out = capsys.readouterr().out
+    assert "stale-pragma" in out
+
+
+# -- --format sarif ----------------------------------------------------------
+
+def test_sarif_output_matches_golden(tmp_path, capsys):
+    """Golden-file test: a fixed fixture renders to byte-stable SARIF
+    (the format CI uses to annotate PR diffs)."""
+    import json
+
+    fixture = tmp_path / "sarif_fixture.py"
+    fixture.write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            import jax
+
+            @jax.jit
+            def _score(x):
+                return x
+
+            def f(lock, q):
+                with lock:
+                    return _score(q)
+
+            def g(lock, q):
+                with lock:  # pathway: allow(lock-discipline): fixture — reviewed
+                    return _score(q)
+            """
+        )
+    )
+    rc = main([str(fixture), "--format", "sarif"])
+    assert rc == 1  # the unsuppressed finding still fails the run
+    doc = json.loads(capsys.readouterr().out)
+    # normalize the tmp path so the golden is location-independent
+    body = json.dumps(doc, indent=1, sort_keys=True).replace(
+        str(fixture).replace("\\", "/"), "sarif_fixture.py"
+    )
+    golden_path = os.path.join(_REPO_ROOT, "tests", "goldens", "analysis.sarif")
+    with open(golden_path) as fh:
+        golden = fh.read()
+    assert body.strip() == golden.strip(), (
+        "SARIF output drifted from tests/goldens/analysis.sarif — if the "
+        "change is deliberate, regenerate the golden"
+    )
+    # structural invariants beyond the byte comparison
+    run = doc["runs"][0]
+    assert doc["version"] == "2.1.0"
+    assert any(r.get("suppressions") for r in run["results"])
+    assert any(not r.get("suppressions") for r in run["results"])
+
+
+# -- incremental analysis cache ----------------------------------------------
+
+def test_analysis_cache_cold_equals_warm(tmp_path, monkeypatch):
+    """PATHWAY_ANALYSIS_CACHE satellite: a warm run re-parses only
+    changed modules and produces BIT-IDENTICAL findings (including the
+    whole-program lock-order pass, whose per-module summaries ride the
+    cache)."""
+    from pathway_tpu.analysis import core
+
+    tree = tmp_path / "pathway_tpu" / "serve"
+    tree.mkdir(parents=True)
+    (tree / "a.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._alock = threading.Lock()
+                    self._block = threading.Lock()
+
+                def f(self):
+                    with self._alock:
+                        with self._block:
+                            pass
+
+                def g(self):
+                    with self._block:
+                        with self._alock:
+                            pass
+            """
+        )
+    )
+    (tree / "b.py").write_text("x = 1\n")
+    cache_dir = tmp_path / "cache"
+    monkeypatch.setenv("PATHWAY_ANALYSIS_CACHE", str(cache_dir))
+
+    parses = []
+    orig = core._run_module
+
+    def counting_run(source, display, rules, real_path=None):
+        parses.append(display)
+        return orig(source, display, rules, real_path)
+
+    monkeypatch.setattr(core, "_run_module", counting_run)
+
+    cold = analyze_paths([str(tmp_path / "pathway_tpu")])
+    cold_parses = len(parses)
+    assert cold_parses == 2
+    assert any(
+        f.rule == "lock-order" and "deadlock cycle" in f.message
+        for f in cold
+    )
+
+    warm = analyze_paths([str(tmp_path / "pathway_tpu")])
+    assert len(parses) == cold_parses, "warm run re-parsed a cached module"
+    assert [f.__dict__ for f in warm] == [f.__dict__ for f in cold]
+
+    # touching one module re-parses ONLY that module, and the
+    # whole-program pass still sees both
+    (tree / "b.py").write_text("x = 2\n")
+    third = analyze_paths([str(tmp_path / "pathway_tpu")])
+    assert len(parses) == cold_parses + 1
+    assert [f.__dict__ for f in third] == [f.__dict__ for f in cold]
+
+
 # -- CLI + repo-wide gate ----------------------------------------------------
 
 def test_cli_exit_codes(tmp_path, capsys):
@@ -1131,11 +1587,22 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert main([str(good)]) == 0
 
 
-def test_repo_wide_zero_unsuppressed_findings():
+@pytest.fixture(scope="module")
+def repo_analysis():
+    """ONE repo-wide analysis shared by the enforcement gate and the
+    stale-pragma gate (the pass costs ~13 s; running it twice would
+    spend tier-1 budget on identical work)."""
+    return analyze_paths(
+        [os.path.join(_REPO_ROOT, "pathway_tpu")], return_pragmas=True
+    )
+
+
+def test_repo_wide_zero_unsuppressed_findings(repo_analysis):
     """THE enforcement gate (tier-1): the whole tree stays clean — any new
-    lock-section device work, serve-path hidden sync, or unbucketed jit
-    call must be fixed or explicitly suppressed with a reviewed reason."""
-    findings = analyze_paths([os.path.join(_REPO_ROOT, "pathway_tpu")])
+    lock-section device work, serve-path hidden sync, unbucketed jit
+    call, or lock-order violation must be fixed or explicitly
+    suppressed with a reviewed reason."""
+    findings, _pragmas = repo_analysis
     live = [f for f in findings if not f.suppressed]
     assert live == [], "unsuppressed hot-path findings:\n" + "\n".join(
         f.format() for f in live

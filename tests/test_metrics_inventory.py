@@ -217,6 +217,19 @@ def rendered_families():
     planes[0].broadcast("edge", 0, {"x": 1}, root=0)
     planes[1].broadcast("edge", 0, None, root=0)
 
+    # lock-order sanitizer (ISSUE 13): installing registers the
+    # pathway_sanitizer_* provider; one tracked acquisition proves the
+    # families render (counters stay 0 — the tree is violation-free)
+    from pathway_tpu.analysis import sanitizer
+
+    was_installed = sanitizer.installed()
+    sanitizer.install()
+    probe_lock = sanitizer.make_lock("inventory.probe")
+    with probe_lock:
+        pass
+    if not was_installed:
+        sanitizer.uninstall()
+
     # profiler drain + SLO evaluation so every derived family is fresh
     assert profile.drain()
     slo.evaluate(max_age_s=0.0)

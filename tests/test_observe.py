@@ -724,6 +724,14 @@ _BASELINE_SUPPRESSIONS = sorted(
         # launch-before-unlock, same rule as the IVF dispatch)
         ("pathway_tpu/ops/serving.py", "lock-discipline"),
         ("pathway_tpu/ops/serving.py", "lock-discipline"),
+        # ISSUE 13 lock-order hierarchy: the fused serve takes the index
+        # lock BEFORE its own pipeline lock at every site (the same
+        # donated-buffer launch-before-unlock constraint) — the one
+        # reviewed rank exception, waived at the two submit sites and
+        # the shard fan-out, mirrored in lock_ranks.DECLARED_EXCEPTIONS
+        ("pathway_tpu/ops/serving.py", "lock-order"),
+        ("pathway_tpu/ops/serving.py", "lock-order"),
+        ("pathway_tpu/ops/serving.py", "lock-order"),
     ]
 )
 
