@@ -1195,6 +1195,186 @@ def phase_sanitizer_overhead(backend: str, extras: dict) -> float:
     return round(overhead_pct, 3)
 
 
+def phase_analysis_runtime(backend: str, extras: dict) -> float:
+    """ISSUE 15: (a) whole-repo analyzer wall time COLD vs WARM through
+    the per-family incremental cache (``PATHWAY_ANALYSIS_CACHE``) — the
+    warm run must re-parse only changed modules, asserted at < 25% of
+    cold wall time (BENCH_ANALYSIS_WARM_MAX_PCT overrides); (b) the
+    runtime donation guard's serve overhead: the SAME c16 coalescing
+    serve driven with ``PATHWAY_DONATION_GUARD=1`` (production mode) vs
+    off, paired-ratio A/B, < 3% p50 budget with the per-batch 2+2
+    dispatch budget asserted under the armed guard.  Phase value = the
+    donation-guard overhead in percent."""
+    import shutil
+    import tempfile
+
+    # -- (a) analyzer cold vs warm ------------------------------------
+    from pathway_tpu.analysis import analyze_paths
+
+    repo_pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "pathway_tpu")
+    cache_dir = tempfile.mkdtemp(prefix="pathway_analysis_cache_")
+    os.environ["PATHWAY_ANALYSIS_CACHE"] = cache_dir
+    try:
+        t0 = time.perf_counter()
+        cold = analyze_paths([repo_pkg])
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm = analyze_paths([repo_pkg])
+        warm_s = time.perf_counter() - t0
+    finally:
+        os.environ.pop("PATHWAY_ANALYSIS_CACHE", None)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    assert [f.__dict__ for f in warm] == [f.__dict__ for f in cold], (
+        "warm analyzer findings drifted from cold"
+    )
+    live = [f for f in cold if not f.suppressed]
+    assert live == [], f"analyzer tree not clean: {live[:3]}"
+    warm_pct = 100.0 * warm_s / max(cold_s, 1e-9)
+    extras["analysis_cold_s"] = round(cold_s, 3)
+    extras["analysis_warm_s"] = round(warm_s, 3)
+    extras["analysis_warm_over_cold_pct"] = round(warm_pct, 2)
+    extras["analysis_findings_suppressed"] = len(cold) - len(live)
+    warm_max = float(os.environ.get("BENCH_ANALYSIS_WARM_MAX_PCT", "25"))
+    assert warm_pct < warm_max, (
+        f"warm analyzer run at {warm_pct:.1f}% of cold exceeds the "
+        f"{warm_max:.0f}% budget (cold {cold_s:.2f}s, warm {warm_s:.2f}s)"
+    )
+
+    # -- (b) donation-guard serve overhead at c16 ----------------------
+    jax = _init_jax(backend)
+
+    from pathway_tpu.ops import dispatch_counter, donation_guard
+    from pathway_tpu.serve import ServeScheduler
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_DG_DOCS", "20000" if on_tpu else "1000"))
+    k, candidates = 10, 32
+    conc = 16
+    window_us = float(os.environ.get("BENCH_DG_WINDOW_US", "5000"))
+    max_batch = int(os.environ.get("BENCH_DG_MAX_BATCH", "16" if on_tpu else "4"))
+
+    os.environ.pop("PATHWAY_DONATION_GUARD", None)
+    os.environ["PATHWAY_DONATION_GUARD_STRICT"] = "0"  # production mode
+    pipe, _c0, docs, _q0 = _build_rr_pipeline(
+        n_docs, 16, k, candidates, small=not on_tpu
+    )
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(32)
+    ]
+    for q in pool[:8]:
+        pipe([q], k)
+    for b in (2, 4, 8, 16):
+        pipe(sorted(set(pool))[:b], k)
+
+    def drive(armed: bool, n_req: int):
+        if armed:
+            os.environ["PATHWAY_DONATION_GUARD"] = "1"
+        else:
+            os.environ.pop("PATHWAY_DONATION_GUARD", None)
+        lats: list = [None] * n_req
+        errs: list = []
+        sched = ServeScheduler(
+            pipe, window_us=window_us, max_batch=max_batch, result_cache=None
+        )
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(t, n_req, conc):
+                    t0 = time.perf_counter()
+                    rows = sched.serve([pool[(i * 7) % len(pool)]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    assert rows and rows[0]
+            except Exception as exc:
+                errs.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sched.stop()
+        if errs:
+            raise RuntimeError(f"analysis_runtime c{conc} failed: {errs[:3]}")
+        return np.asarray([l for l in lats if l is not None])
+
+    try:
+        # per-batch 2+2 with the guard armed: poisoning bookkeeping must
+        # never add a device round trip
+        os.environ["PATHWAY_DONATION_GUARD"] = "1"
+        with ServeScheduler(
+            pipe, window_us=200_000, result_cache=None
+        ) as sched:
+            with dispatch_counter.DispatchCounter() as counter:
+                res, errs = [], []
+                barrier = threading.Barrier(8)
+
+                def w(q):
+                    try:
+                        barrier.wait(timeout=30)
+                        res.append(sched.serve([q], k))
+                    except Exception as exc:
+                        errs.append(repr(exc))
+
+                threads = [
+                    threading.Thread(target=w, args=(q,)) for q in pool[:8]
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert not errs, errs[:3]
+            batches = max(1, sched.stats["batches"] + sched.stats["solo"])
+        extras["donation_guard_dispatches_per_batch"] = round(
+            counter.dispatches / batches, 2
+        )
+        assert counter.dispatches <= 2 * batches, (counter.events, batches)
+        assert counter.fetches <= 2 * batches, (counter.events, batches)
+
+        rounds = int(os.environ.get("BENCH_DG_ROUNDS", "5"))
+        n_req = int(os.environ.get("BENCH_DG_REQUESTS", str(conc * 8)))
+        lat = {True: [], False: []}
+        ratios = []
+        for r in range(rounds):
+            order = (True, False) if r % 2 == 0 else (False, True)
+            round_p50 = {}
+            for mode in order:
+                drive(mode, 2 * conc)  # settle after the flip
+                arm = drive(mode, n_req)
+                lat[mode].append(arm)
+                round_p50[mode] = float(np.percentile(arm, 50))
+            ratios.append(round_p50[True] / max(round_p50[False], 1e-9))
+    finally:
+        os.environ.pop("PATHWAY_DONATION_GUARD", None)
+        os.environ.pop("PATHWAY_DONATION_GUARD_STRICT", None)
+    p50_on = float(np.percentile(np.concatenate(lat[True]), 50))
+    p50_off = float(np.percentile(np.concatenate(lat[False]), 50))
+    overhead_pct = (float(np.median(ratios)) - 1.0) * 100.0
+    stats = donation_guard.stats()
+    extras["donation_guard_p50_on_ms"] = round(p50_on, 3)
+    extras["donation_guard_p50_off_ms"] = round(p50_off, 3)
+    extras["donation_guard_round_ratios"] = [round(x, 4) for x in ratios]
+    extras["donation_guard_overhead_pct"] = round(overhead_pct, 3)
+    extras["donation_guard_poisoned"] = stats["poisoned"]
+    extras["donation_guard_violations"] = stats["violations"]
+    assert all(v == 0 for v in stats["violations"].values()), (
+        f"donation guard recorded violations on the clean serve stack: "
+        f"{stats['violations']}"
+    )
+    max_pct = float(os.environ.get("BENCH_DG_MAX_OVERHEAD_PCT", "3.0"))
+    assert overhead_pct < max_pct, (
+        f"donation-guard overhead {overhead_pct:.2f}% exceeds the "
+        f"{max_pct}% budget (p50 on {p50_on:.3f} ms vs off {p50_off:.3f} ms)"
+    )
+    return round(overhead_pct, 3)
+
+
 def phase_fault_tolerance(backend: str, extras: dict) -> float:
     """Price and prove the serve-path fault-tolerance layer (ISSUE 4,
     pathway_tpu/robust): the SAME steady-state fused retrieve→rerank
@@ -2669,6 +2849,7 @@ _PHASES = {
     "tracing_overhead": (phase_tracing_overhead, 450),
     "profiling_overhead": (phase_profiling_overhead, 450),
     "sanitizer_overhead": (phase_sanitizer_overhead, 450),
+    "analysis_runtime": (phase_analysis_runtime, 450),
     "fault_tolerance": (phase_fault_tolerance, 450),
     "concurrent_serve": (phase_concurrent_serve, 600),
     "sharded_serve": (phase_sharded_serve, 600),
@@ -2899,6 +3080,7 @@ def main() -> None:
         ("tracing_overhead", lambda: device_phase("tracing_overhead")),
         ("profiling_overhead", lambda: device_phase("profiling_overhead")),
         ("sanitizer_overhead", lambda: device_phase("sanitizer_overhead")),
+        ("analysis_runtime", lambda: device_phase("analysis_runtime")),
         ("fault_tolerance", lambda: device_phase("fault_tolerance")),
         ("concurrent_serve", lambda: device_phase("concurrent_serve")),
         ("sharded_serve", lambda: device_phase("sharded_serve")),
@@ -2937,6 +3119,8 @@ def main() -> None:
             extras["profiling_overhead_pct"] = round(value, 3)
         elif name == "sanitizer_overhead" and value is not None:
             extras["sanitizer_overhead_pct"] = round(value, 3)
+        elif name == "analysis_runtime" and value is not None:
+            extras["donation_guard_overhead_pct"] = round(value, 3)
         elif name == "fault_tolerance" and value is not None:
             extras["fault_overhead_pct"] = round(value, 3)
         elif name == "concurrent_serve" and value is not None:

@@ -1,6 +1,6 @@
 """Hot-path static analysis for pathway_tpu.
 
-An AST lint framework plus four rule families that make the round-5 bug
+An AST lint framework plus five rule families that make the round-5 bug
 classes (and the deadlock class) impossible to reintroduce silently:
 
 - ``lock-discipline`` — device dispatch / host sync / GIL-holding C calls
@@ -15,7 +15,13 @@ classes (and the deadlock class) impossible to reintroduce silently:
   (``lock_order.py`` + ``lock_ranks.py``): lock-acquisition hierarchy
   inversions, deadlock cycles with witness paths, ``Condition.wait``
   holding a second lock, locks in jitted scopes — paired with the
-  runtime tripwire in ``sanitizer.py`` (``PATHWAY_LOCK_SANITIZER=1``).
+  runtime tripwire in ``sanitizer.py`` (``PATHWAY_LOCK_SANITIZER=1``);
+- ``value-flow`` — the device value-flow analyzer (``value_flow.py`` +
+  ``residency.py``): use-after-donate on ``donate_argnums`` buffers,
+  hidden host transfers (implicit ``bool``/iteration/``tolist``/
+  comparison syncs), redundant loop-invariant uploads — paired with
+  the runtime donation tripwire in ``ops/donation_guard.py``
+  (``PATHWAY_DONATION_GUARD=1``).
 
 Run ``python -m pathway_tpu.analysis pathway_tpu/`` for file:line
 diagnostics (``--format sarif`` for CI diff annotation,
@@ -40,6 +46,7 @@ from .hidden_sync import HiddenSyncRule
 from .lock_discipline import LockDisciplineRule
 from .lock_order import LockOrderRule
 from .recompile_hazard import RecompileHazardRule
+from .value_flow import ValueFlowRule
 
 __all__ = [
     "Finding",
@@ -49,6 +56,7 @@ __all__ = [
     "ModuleContext",
     "RecompileHazardRule",
     "Rule",
+    "ValueFlowRule",
     "analyze_file",
     "analyze_paths",
     "analyze_source",

@@ -145,17 +145,28 @@ class Rule:
     """Base rule: subclasses set ``name`` and implement ``run(ctx)``,
     reporting through ``ctx.report`` (suppression is applied centrally).
 
-    **Whole-program rules** (the lock-order family) additionally define
-    ``finalize() -> List[Finding]``: ``run`` extracts a per-module
-    summary, ``finalize`` is called ONCE after every module has been
-    seen and returns cross-module findings (suppression is applied by
-    the caller from each finding's own module's pragmas).  For the
-    incremental cache they also define ``dump_summary(path) -> dict``
-    (JSON-able per-module facts) and ``load_summary(path, summary)``
-    (rehydrate a cache hit without re-parsing)."""
+    **Whole-program rules** (the lock-order and value-flow families)
+    additionally define ``finalize() -> List[Finding]``: ``run``
+    extracts a per-module summary, ``finalize`` is called ONCE after
+    every module has been seen and returns cross-module findings
+    (suppression is applied by the caller from each finding's own
+    module's pragmas).  For the incremental cache they also define
+    ``dump_summary(path) -> dict`` (JSON-able per-module facts) and
+    ``load_summary(path, summary)`` (rehydrate a cache hit without
+    re-parsing).
+
+    ``salt_sources`` names the analyzer source files THIS family's
+    results depend on (``core.py`` and ``registry.py`` are always
+    included — they are shared resolution machinery).  The incremental
+    cache salts each family's cached results with only those files, so
+    editing (or ADDING) one family re-runs just that family on warm
+    modules instead of cold-invalidating every other family's cached
+    findings.  ``None`` (the conservative default for out-of-tree
+    rules) salts with every ``.py`` in the analysis package."""
 
     name = "rule"
     description = ""
+    salt_sources: Optional[Tuple[str, ...]] = None
 
     def run(self, ctx: ModuleContext) -> None:  # pragma: no cover - interface
         raise NotImplementedError
@@ -218,12 +229,14 @@ def default_rules() -> List[Rule]:
     from .lock_discipline import LockDisciplineRule
     from .lock_order import LockOrderRule
     from .recompile_hazard import RecompileHazardRule
+    from .value_flow import ValueFlowRule
 
     return [
         LockDisciplineRule(),
         HiddenSyncRule(),
         RecompileHazardRule(),
         LockOrderRule(),
+        ValueFlowRule(),
     ]
 
 
@@ -329,29 +342,56 @@ def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
 # -- incremental analysis cache -------------------------------------------
 #
 # PATHWAY_ANALYSIS_CACHE=<dir> keys one JSON record per module on a
-# content hash salted with the analyzer's OWN sources (any rule change
-# invalidates everything) — the repo-wide tier-1 gate then re-parses
-# only changed modules.  Cached records carry the per-module findings,
-# the pragma table (with spans — whole-program suppression needs them
-# without re-parsing) and each whole-program rule's module summary, so
-# warm runs produce bit-identical findings to cold ones.
+# content hash salted with the SHARED analyzer machinery (core.py +
+# registry.py) — the repo-wide tier-1 gate then re-parses only changed
+# modules.  Within a record, each rule FAMILY's findings and module
+# summary carry their own salt over just that family's sources
+# (``Rule.salt_sources``): editing one family — or ADDING a new one —
+# re-runs only that family on warm modules instead of cold-invalidating
+# the other families' cached results.  Records carry the per-family
+# findings, the pragma table (spans included — whole-program
+# suppression needs them without re-parsing) and each whole-program
+# rule's module summary, so warm runs produce bit-identical findings
+# to cold ones.
 
-_CACHE_SALT: Optional[str] = None
+# analyzer files every family depends on (parsing, pragma handling and
+# the shared name-resolution registry live here)
+_SHARED_SOURCES = ("core.py", "registry.py")
+
+_SALT_CACHE: Dict[Tuple[str, ...], str] = {}
 
 
-def _analysis_salt() -> str:
-    global _CACHE_SALT
-    if _CACHE_SALT is None:
-        h = hashlib.sha256()
-        pkg = os.path.dirname(os.path.abspath(__file__))
-        for name in sorted(os.listdir(pkg)):
-            if not name.endswith(".py"):
-                continue
-            with open(os.path.join(pkg, name), "rb") as fh:
-                h.update(name.encode())
+def _salt_of(files: Tuple[str, ...]) -> str:
+    cached = _SALT_CACHE.get(files)
+    if cached is not None:
+        return cached
+    h = hashlib.sha256()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for name in files:
+        path = os.path.join(pkg, name)
+        h.update(name.encode())
+        try:
+            with open(path, "rb") as fh:
                 h.update(fh.read())
-        _CACHE_SALT = h.hexdigest()
-    return _CACHE_SALT
+        except OSError:
+            h.update(b"<missing>")
+    _SALT_CACHE[files] = out = h.hexdigest()
+    return out
+
+
+def _shared_salt() -> str:
+    return _salt_of(_SHARED_SOURCES)
+
+
+def _family_salt(rule: Rule) -> str:
+    sources = rule.salt_sources
+    if sources is None:
+        # conservative fallback: every .py in the package
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        sources = tuple(
+            sorted(n for n in os.listdir(pkg) if n.endswith(".py"))
+        )
+    return _salt_of(_SHARED_SOURCES + tuple(sources))
 
 
 def _cache_dir() -> Optional[str]:
@@ -360,7 +400,7 @@ def _cache_dir() -> Optional[str]:
 
 def _cache_key(display: str, source: bytes) -> str:
     h = hashlib.sha256()
-    h.update(_analysis_salt().encode())
+    h.update(_shared_salt().encode())
     h.update(display.encode())
     h.update(b"\0")
     h.update(source)
@@ -371,7 +411,7 @@ def _cache_load(cache_dir: str, key: str) -> Optional[dict]:
     try:
         with open(os.path.join(cache_dir, key + ".json")) as fh:
             record = json.load(fh)
-        return record if record.get("v") == 1 else None
+        return record if record.get("v") == 2 else None
     except (OSError, ValueError):
         return None
 
@@ -403,16 +443,113 @@ def _pragma_from_json(d: dict) -> _Pragma:
     )
 
 
+def _analyze_one(
+    file_path: str,
+    display: str,
+    rules: Sequence[Rule],
+    cache_dir: Optional[str],
+) -> Tuple[List[Finding], List[_Pragma]]:
+    """One module through the per-family cache: families whose salt
+    matches reuse their cached findings + summary; the module is parsed
+    (once) only when at least one family is missing or stale, and only
+    THOSE families run on it."""
+    with open(file_path, "rb") as fh:
+        raw = fh.read()
+    key = _cache_key(display, raw) if cache_dir else None
+    record = _cache_load(cache_dir, key) if cache_dir else None
+    fam_salts = {rule.name: _family_salt(rule) for rule in rules}
+    families = dict(record["families"]) if record is not None else {}
+    need = [
+        rule
+        for rule in rules
+        if families.get(rule.name, {}).get("salt") != fam_salts[rule.name]
+    ]
+    if record is not None and not need:
+        pragmas = [_pragma_from_json(p) for p in record["pragmas"]]
+        base_findings = [Finding(**f) for f in record["base"]]
+        for rule in rules:
+            loader = getattr(rule, "load_summary", None)
+            summary = families[rule.name].get("summary")
+            if loader is not None and summary is not None:
+                loader(display, summary)
+        fresh_names: Set[str] = set()
+    else:
+        source = raw.decode("utf-8")
+        ctx, run_findings = _run_module(
+            source, display, need, real_path=file_path
+        )
+        pragmas = ctx.pragmas if ctx is not None else []
+        fresh_names = {rule.name for rule in need}
+        base_findings = [
+            f for f in run_findings if f.rule not in fresh_names
+        ]
+        for rule in need:
+            entry: dict = {
+                "salt": fam_salts[rule.name],
+                "findings": [
+                    f.__dict__
+                    for f in sorted(
+                        (f for f in run_findings if f.rule == rule.name),
+                        key=lambda f: (f.line, f.col),
+                    )
+                ],
+                "summary": None,
+            }
+            dumper = getattr(rule, "dump_summary", None)
+            if dumper is not None:
+                entry["summary"] = dumper(display)
+            families[rule.name] = entry
+        # salt-valid families NOT re-run still need their summaries live
+        for rule in rules:
+            if rule.name in fresh_names:
+                continue
+            loader = getattr(rule, "load_summary", None)
+            summary = families.get(rule.name, {}).get("summary")
+            if loader is not None and summary is not None:
+                loader(display, summary)
+        if cache_dir:
+            _cache_store(
+                cache_dir, key,
+                {
+                    "v": 2,
+                    "pragmas": [_pragma_to_json(p) for p in pragmas],
+                    "base": [f.__dict__ for f in base_findings],
+                    "families": families,
+                },
+            )
+    module_findings = list(base_findings)
+    for rule in rules:
+        entry = families.get(rule.name)
+        if entry is None:
+            continue
+        if rule.name in fresh_names:
+            module_findings.extend(
+                Finding(**f) for f in entry["findings"]
+            )
+        else:
+            cached = [Finding(**f) for f in entry["findings"]]
+            # cached findings did not pass through ctx.report this run:
+            # replay the suppression match so the pragma `used` flags
+            # (the --check-pragmas audit) stay identical to a cold run
+            for f in cached:
+                if f.suppressed:
+                    _suppress_with(pragmas, f.rule, f.line)
+            module_findings.extend(cached)
+    module_findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return module_findings, pragmas
+
+
 def analyze_paths(
     paths: Sequence[str],
     rules: Optional[Sequence[Rule]] = None,
     return_pragmas: bool = False,
 ):
     """Repo walker used by the CLI and the tier-1 gate: per-module rules
-    over every ``.py`` under ``paths``, then the whole-program pass
-    (lock-order graph) over all of them together.  With
-    ``return_pragmas=True`` returns ``(findings, pragma_map)`` so the
-    caller can audit stale waivers (``--check-pragmas``)."""
+    over every ``.py`` under ``paths``, then the whole-program passes
+    (lock-order graph, value-flow donation replay) over all of them
+    together.  With ``return_pragmas=True`` returns ``(findings,
+    pragma_map)`` so the caller can audit stale waivers
+    (``--check-pragmas``)."""
     rules = list(rules) if rules is not None else default_rules()
     findings: List[Finding] = []
     pragma_map: Dict[str, List[_Pragma]] = {}
@@ -422,42 +559,9 @@ def analyze_paths(
         display = os.path.relpath(file_path, base)
         if display.startswith(".."):
             display = file_path
-        with open(file_path, "rb") as fh:
-            raw = fh.read()
-        key = _cache_key(display, raw) if cache_dir else None
-        record = _cache_load(cache_dir, key) if cache_dir else None
-        if record is not None:
-            module_findings = [Finding(**f) for f in record["findings"]]
-            pragmas = [_pragma_from_json(p) for p in record["pragmas"]]
-            for rule in rules:
-                loader = getattr(rule, "load_summary", None)
-                summary = record["summaries"].get(rule.name)
-                if loader is not None and summary is not None:
-                    loader(display, summary)
-        else:
-            source = raw.decode("utf-8")
-            ctx, module_findings = _run_module(
-                source, display, rules, real_path=file_path
-            )
-            module_findings.sort(key=lambda f: (f.line, f.col, f.rule))
-            pragmas = ctx.pragmas if ctx is not None else []
-            if cache_dir:
-                summaries = {}
-                for rule in rules:
-                    dumper = getattr(rule, "dump_summary", None)
-                    if dumper is not None:
-                        summary = dumper(display)
-                        if summary is not None:
-                            summaries[rule.name] = summary
-                _cache_store(
-                    cache_dir, key,
-                    {
-                        "v": 1,
-                        "findings": [f.__dict__ for f in module_findings],
-                        "pragmas": [_pragma_to_json(p) for p in pragmas],
-                        "summaries": summaries,
-                    },
-                )
+        module_findings, pragmas = _analyze_one(
+            file_path, display, rules, cache_dir
+        )
         findings.extend(module_findings)
         pragma_map[display] = pragmas
     extra = _finalize_rules(rules, pragma_map)

@@ -60,6 +60,7 @@ _COERCIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
 
 class HiddenSyncRule(Rule):
     name = "hidden-sync"
+    salt_sources = ("hidden_sync.py",)
     description = (
         "implicit host sync / unaccounted dispatch on a serve-path module"
     )

@@ -129,6 +129,7 @@ _LOCK_ITEM_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
 
 class LockDisciplineRule(Rule):
     name = "lock-discipline"
+    salt_sources = ("lock_discipline.py",)
     description = (
         "device dispatch / host sync / GIL-holding C call inside a "
         "`with <lock>:` body"

@@ -459,6 +459,7 @@ class _Extractor(ast.NodeVisitor):
 
 class LockOrderRule(Rule):
     name = "lock-order"
+    salt_sources = ("lock_order.py", "lock_ranks.py")
     description = (
         "lock-acquisition hierarchy: rank inversions against the declared "
         f"table ({table()}), deadlock cycles with witness paths, "

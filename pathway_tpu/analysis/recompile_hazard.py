@@ -38,6 +38,7 @@ _BUCKET_HELPERS = {"_bucket", "seg_bucket", "row_length_bucket", "pad_packed_row
 
 class RecompileHazardRule(Rule):
     name = "recompile-hazard"
+    salt_sources = ("recompile_hazard.py",)
     description = (
         "jitted call fed jnp.asarray(host data) in a scope with no shape "
         "bucketing — every distinct input size compiles a new executable"

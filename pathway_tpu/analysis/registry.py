@@ -34,13 +34,15 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 __all__ = [
+    "collect_donating_jits",
     "collect_jit_names",
     "dotted_name",
     "is_cache_access",
     "is_cache_wrapper",
+    "is_device_producer_call",
     "is_handle_fetch",
     "is_lock_context",
     "is_observability_callback",
@@ -70,7 +72,13 @@ _CACHE_GETTER_RE = re.compile(
     r"|slot_prefill_fn|slot_step_fn)$"
 )
 _LOCK_NAME_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
-_JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+# donation_guard.donating_jit is the guard-aware jit constructor
+# (ops/donation_guard.py): it compiles the donating callable AND registers
+# the runtime poison site, so the rules treat it exactly like jax.jit
+_JIT_CTORS = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "donating_jit", "donation_guard.donating_jit",
+}
 # the robust retry wrapper (pathway_tpu/robust/retry.py): a call like
 # ``retry_call("site", fn, *args)`` DISPATCHES ``fn`` when ``fn`` is a
 # jitted callable — the rules must keep treating it as a device dispatch
@@ -168,6 +176,99 @@ def collect_jit_names(tree: ast.AST) -> Set[str]:
                     if isinstance(tgt, ast.Name):
                         names.add(tgt.id)
     return names
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The ``donate_argnums=`` keyword of a jit-constructor call, as a
+    tuple of positional indices, or None when absent/unparseable."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        value = kw.value
+        if isinstance(value, ast.Constant) and isinstance(value.value, int):
+            return (value.value,)
+        if isinstance(value, (ast.Tuple, ast.List)):
+            out = []
+            for elt in value.elts:
+                if not (
+                    isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)
+                ):
+                    return None
+                out.append(elt.value)
+            return tuple(out)
+        return None
+    return None
+
+
+def collect_donating_jits(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """Names bound to DONATING jitted callables in the module, mapped to
+    their donated positional indices.  Covers every spelling the repo
+    uses: ``@partial(jax.jit, donate_argnums=(0, 1))`` decorators (plain
+    or through ``donation_guard.donating_jit``), direct
+    ``@donating_jit(site=..., donate_argnums=...)`` decorator calls, and
+    ``name = jax.jit(fn, donate_argnums=...)`` assignments.  The
+    value-flow rule's use-after-donate check poisons the arguments at
+    these positions after every call."""
+    out: Dict[str, Tuple[int, ...]] = {}
+
+    def from_expr(node: ast.AST) -> Optional[Tuple[int, ...]]:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted_name(node.func)
+        if name in _JIT_CTORS or (
+            name is not None and name.rsplit(".", 1)[-1] in _JIT_CTORS
+        ):
+            return _donate_positions(node)
+        if name in ("partial", "functools.partial") and node.args:
+            inner = dotted_name(node.args[0])
+            if inner in _JIT_CTORS or (
+                inner is not None
+                and inner.rsplit(".", 1)[-1] in _JIT_CTORS
+            ):
+                return _donate_positions(node)
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                positions = from_expr(dec)
+                if positions:
+                    out[node.name] = positions
+        elif isinstance(node, ast.Assign):
+            positions = from_expr(node.value)
+            if positions:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = positions
+    return out
+
+
+# device-PRODUCER method convention: ``<embedder|encoder|model>.encode(
+# texts)`` returns device rows (SentenceEncoder.encode and friends) —
+# the value-flow rule treats the result as a device value so an
+# immediate host coercion (``np.asarray(embedder.encode(texts))``) is a
+# visible device→host crossing even in modules with no jit of their own
+# (the stdlib adapter class).  The receiver spelling carries the
+# convention; ``str.encode`` receivers (payload/text vars) do not match.
+_PRODUCER_METHODS = {"encode", "encode_token_states"}
+_PRODUCER_RECEIVER_RE = re.compile(
+    r"(^|_)(embedder|encoder|enc|model)s?$", re.IGNORECASE
+)
+
+
+def is_device_producer_call(call: ast.Call) -> bool:
+    """``<encoder-spelled receiver>.encode(...)`` — a model call whose
+    result lives on device by the repo's encoder convention."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr not in _PRODUCER_METHODS:
+        return False
+    receiver = dotted_name(func.value)
+    if receiver is None:
+        return False
+    return bool(_PRODUCER_RECEIVER_RE.search(receiver.rsplit(".", 1)[-1]))
 
 
 def is_lock_context(with_node: ast.With) -> bool:

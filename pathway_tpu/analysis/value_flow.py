@@ -1,0 +1,805 @@
+"""value-flow: where the bytes go — use-after-donate, hidden host
+transfers, redundant uploads.
+
+The fifth analyzer family.  The serve stack leans hard on donated
+device buffers (IVF absorb slabs, forward-index scatter commits) and on
+the "every host↔device crossing is booked" discipline (the 2+2 budget,
+``record_fetch``), but the four existing families only police WHERE
+code runs (under a lock, in a serve scope) — not where the VALUES flow.
+This family runs an interprocedural dataflow over the residency lattice
+(``residency.py``: ``host < device < donated-consumed``) through
+assignments, helper calls, ``retry_call``/``profile.wrap`` wrappers and
+the compiled-fn cache-getter conventions (``registry.py``), and checks:
+
+1. **use-after-donate** — a value passed at a ``donate_argnums``
+   position of a donating jitted callable (module-local
+   ``@partial(jax.jit, donate_argnums=...)`` defs + the seeded
+   ``residency.DONATION_SITES`` registry + helper functions that
+   forward a parameter into a donating position, resolved to a
+   fixpoint in ``finalize``) is read, fetched, or re-dispatched
+   afterwards.  XLA reused the buffer for the outputs; jax marks the
+   reference deleted — on TPU the read is garbage-or-crash, on CPU it
+   raises, and either way the bug only surfaces at runtime without
+   this check.  Rebinding the name (the sanctioned
+   ``self._slabs, self._bias = _absorb_scatter(self._slabs, ...)``
+   shape) clears the poison.
+2. **hidden host transfer** — an IMPLICIT device→host sync the
+   hidden-sync family cannot see: ``bool(dv)`` / branching on a device
+   value (``if dv > 0:``), iterating one (``for x in dv:`` fetches per
+   element), ``dv.tolist()``, plus — in modules hidden-sync does not
+   cover — explicit coercions (``np.asarray``/``float``/``int``/
+   ``.item()``) of a provably-device value, and coercion of an
+   unknown-residency PARAMETER inside a lock body (callers hand the
+   encoder's device rows straight to ``add(keys, vectors)``; the sync
+   then happens under the lock).  A scope that books the crossing with
+   ``record_fetch`` is clean.
+3. **redundant upload** — a host→device transfer (``jnp.asarray`` /
+   ``jnp.array`` / ``jax.device_put``) of a loop-invariant value inside
+   a serve-path loop: the same bytes ride the PCIe/ICI link once per
+   iteration (the exact-tail re-upload PR 1 fixed by hand — this makes
+   the class unreintroducible).  Hoist the upload or cache the device
+   buffer; a deliberate per-target scatter is waived with a reviewed
+   pragma mirrored in ``residency.DECLARED_TRANSFERS``.
+
+Runtime twin: ``ops/donation_guard.py`` (``PATHWAY_DONATION_GUARD=1``)
+poisons donated references dynamically — touching one raises under
+pytest and logs + counts ``pathway_donation_violations_total{site}`` in
+production.
+
+A reviewed exception is waived at the site::
+
+    return np.asarray(rows)  # pathway: allow(value-flow): <why the crossing is sound>
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleContext, Rule
+from .registry import (
+    collect_donating_jits,
+    dotted_name,
+    is_device_producer_call,
+    is_jit_call,
+    scope_jit_and_device_vars,
+    walk_scope,
+)
+from . import residency
+
+__all__ = ["ValueFlowRule"]
+
+_EXPLICIT_COERCIONS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "float", "int", "jax.device_get",
+}
+_PARAM_COERCIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_UPLOAD_CALLS = {
+    "jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array",
+    "jax.device_put", "device_put",
+}
+# wrapper spellings whose call dispatches their FUNCTION argument with
+# the remaining args (the robust-retry convention: retry_call("site",
+# fn, *args)) — donated positions shift past the two leading args
+_RETRY_LEAVES = {"retry_call"}
+# wrapper BINDINGS that alias a donating callable: w = profile.wrap(
+# "site", fn) / w = donation_guard.wrap("site", fn) — calling w donates
+# exactly like fn
+_ALIAS_WRAP_LEAVES = {"wrap"}
+
+
+def _pure_dotted(node: ast.AST) -> Optional[str]:
+    """Dotted spelling of a Name/Attribute/Subscript chain containing no
+    embedded calls (``self._slabs``, ``out[0]``), else None."""
+    probe = node
+    while True:
+        if isinstance(probe, ast.Attribute):
+            probe = probe.value
+        elif isinstance(probe, ast.Subscript):
+            probe = probe.value
+        elif isinstance(probe, ast.Name):
+            return dotted_name(node)
+        else:
+            return None
+
+
+def _component_prefixed(name: str, prefix: str) -> bool:
+    """``self._slabs`` poisons ``self._slabs`` and ``self._slabs.shape``
+    but NOT ``self._slabs_host`` — prefixing is per dotted component."""
+    return name == prefix or name.startswith(prefix + ".")
+
+
+class _FunctionFacts:
+    """Ordered event stream for one function scope: calls (with dotted
+    arg spellings), loads and rebinds of candidate names — everything
+    the finalize-side donation replay needs, JSON-able for the cache."""
+
+    def __init__(self, params: List[str]):
+        self.params = params
+        self.events: List[list] = []  # [line, col, kind, ...]
+        self._arg_names: Set[str] = set()
+
+    def call(
+        self,
+        line: int,
+        col: int,
+        leaves: List[str],
+        args: List[Optional[str]],
+        method: bool,
+    ) -> None:
+        self.events.append([line, col, "call", leaves, args, method])
+        self._arg_names.update(a for a in args if a)
+
+    def load(self, line: int, col: int, name: str) -> None:
+        self.events.append([line, col, "load", name])
+
+    def bind(self, line: int, col: int, name: str) -> None:
+        self.events.append([line, col, "bind", name])
+
+    def compact(self) -> dict:
+        """Drop load/bind events that can never interact with a donated
+        name: only names related (component-prefix either way) to some
+        call argument can be poisoned."""
+        cands = self._arg_names
+
+        def relevant(name: str) -> bool:
+            return any(
+                _component_prefixed(name, c) or _component_prefixed(c, name)
+                for c in cands
+            )
+
+        events = [
+            ev
+            for ev in self.events
+            if ev[2] == "call" or relevant(ev[3])
+        ]
+        return {"params": self.params, "events": events}
+
+
+class _Extractor:
+    """One pass over a module: reports the per-module findings (hidden
+    host transfers, redundant uploads) and extracts the donation facts
+    (donating defs, wrap aliases, per-function event streams) for the
+    whole-program use-after-donate pass."""
+
+    def __init__(self, ctx: ModuleContext, rule_name: str):
+        self.ctx = ctx
+        self.rule_name = rule_name
+        self.donating = {
+            name: list(pos)
+            for name, pos in collect_donating_jits(ctx.tree).items()
+        }
+        self.aliases: Dict[str, str] = {}
+        self.functions: Dict[str, dict] = {}
+        self._collect_aliases(ctx.tree)
+        self._visit_scope(ctx.tree, None, None, None)
+
+    # -- alias bindings: w = profile.wrap("site", fn) ----------------------
+    def _collect_aliases(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            callee = dotted_name(value.func)
+            if callee is None:
+                continue
+            if callee.rsplit(".", 1)[-1] not in _ALIAS_WRAP_LEAVES:
+                continue
+            for arg in value.args:
+                target = dotted_name(arg)
+                if target is None:
+                    continue
+                leaf = target.rsplit(".", 1)[-1]
+                if leaf in self.donating or leaf in residency.DONATION_SITES:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.aliases[tgt.id] = leaf
+                    break
+
+    # -- scope walk (hidden-transfer + redundant-upload + events) ----------
+    def _visit_scope(self, scope, cls, inherited_fns, inherited_vars) -> None:
+        jit_fns, device_vars = scope_jit_and_device_vars(
+            scope, self.ctx.jit_names, inherited_fns, inherited_vars
+        )
+        device_vars = set(device_vars) | self._producer_vars(scope)
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local = f"{cls}.{scope.name}" if cls else scope.name
+            if (
+                scope.name not in self.ctx.jit_names
+                and scope.name not in self.donating
+            ):
+                self._check_transfers(scope, jit_fns, device_vars)
+                self._check_uploads(scope)
+                if local not in self.functions:
+                    self.functions[local] = self._extract_events(scope)
+        for child in ast.iter_child_nodes(scope):
+            self._recurse(child, cls, jit_fns, device_vars)
+
+    def _recurse(self, node, cls, fns, dvars) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in ast.iter_child_nodes(node):
+                self._recurse(child, node.name, fns, dvars)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._visit_scope(node, cls, fns, dvars)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        for child in ast.iter_child_nodes(node):
+            self._recurse(child, cls, fns, dvars)
+
+    def _producer_vars(self, scope) -> Set[str]:
+        """Names assigned from a device-producer call (the encoder
+        ``.encode`` convention) — device values even in modules with no
+        jit registry of their own."""
+        out: Set[str] = set()
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if isinstance(node.value, ast.Call) and is_device_producer_call(
+                node.value
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        return out
+
+    # -- rule 2: hidden host transfer --------------------------------------
+    def _residency_of(self, node, jit_fns, device_vars) -> int:
+        """HOST/DEVICE classification for one expression over the
+        residency lattice (the DONATED state is per-NAME, tracked by the
+        finalize replay's poison map)."""
+        if isinstance(node, ast.Call):
+            if is_jit_call(node, jit_fns) or is_device_producer_call(node):
+                return residency.DEVICE
+            return residency.HOST
+        name = _pure_dotted(node)
+        if name is not None and name in device_vars:
+            return residency.DEVICE
+        return residency.HOST
+
+    def _is_device_expr(self, node, jit_fns, device_vars) -> bool:
+        return self._residency_of(node, jit_fns, device_vars) >= residency.DEVICE
+
+    def _test_device_name(self, test, device_vars) -> Optional[str]:
+        """A device value used as a DIRECT operand of a branch/loop/
+        assert test (``if dv:``, ``if dv > 0:``, ``while not dv:``) —
+        the bool() of the comparison result syncs.  Metadata reads
+        (``len(dv)``, ``dv.shape[0]``) are free and stay quiet: only an
+        exact device-var spelling (possibly subscripted) matches."""
+
+        def direct(node) -> Optional[str]:
+            if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+                name = _pure_dotted(node)
+                if name is not None and name in device_vars:
+                    return name
+                return None
+            if isinstance(node, ast.Compare):
+                # `is` / `is not` are pure reference checks — `if dv is
+                # None:` never fetches; only value comparisons sync
+                if all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+                ):
+                    return None
+                for operand in [node.left] + list(node.comparators):
+                    got = direct(operand)
+                    if got is not None:
+                        return got
+                return None
+            if isinstance(node, ast.BoolOp):
+                for operand in node.values:
+                    got = direct(operand)
+                    if got is not None:
+                        return got
+                return None
+            if isinstance(node, ast.UnaryOp):
+                return direct(node.operand)
+            return None
+
+        return direct(test)
+
+    def _check_transfers(self, scope, jit_fns, device_vars) -> None:
+        ctx = self.ctx
+        has_record_fetch = False
+        found: List[Tuple[ast.AST, str]] = []
+        params = {
+            a.arg
+            for a in list(scope.args.args) + list(scope.args.kwonlyargs)
+            if a.arg not in ("self", "cls")
+        }
+        lock_depth_nodes = self._lock_bodies(scope)
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                leaf = callee.rsplit(".", 1)[-1] if callee else ""
+                if leaf == "record_fetch":
+                    has_record_fetch = True
+                elif leaf == "tolist" and isinstance(node.func, ast.Attribute):
+                    base = _pure_dotted(node.func.value)
+                    if base is not None and base in device_vars:
+                        found.append(
+                            (
+                                node,
+                                f"`{base}.tolist()` forces an element-wise "
+                                "device→host transfer of the whole array",
+                            )
+                        )
+                elif leaf == "bool" and node.args and self._is_device_expr(
+                    node.args[0], jit_fns, device_vars
+                ):
+                    found.append(
+                        (
+                            node,
+                            "`bool()` of a device value blocks on the "
+                            "transfer just to branch",
+                        )
+                    )
+                elif (
+                    not ctx.serve_path
+                    and callee in _EXPLICIT_COERCIONS
+                    and node.args
+                    and self._is_device_expr(
+                        node.args[0], jit_fns, device_vars
+                    )
+                ):
+                    found.append(
+                        (
+                            node,
+                            f"`{callee}` of a device value — an unbooked "
+                            "device→host sync",
+                        )
+                    )
+                elif (
+                    not ctx.serve_path
+                    and leaf == "item"
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    base = _pure_dotted(node.func.value)
+                    if base is not None and base in device_vars:
+                        found.append(
+                            (
+                                node,
+                                f"`{base}.item()` — an unbooked device→host "
+                                "sync",
+                            )
+                        )
+                elif (
+                    callee in _PARAM_COERCIONS
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                    and node in lock_depth_nodes
+                ):
+                    found.append(
+                        (
+                            node,
+                            f"`{callee}({node.args[0].id})` coerces a "
+                            "caller-provided value inside a lock body — "
+                            "callers hand device arrays here (the encoder "
+                            "convention), making this a device→host sync "
+                            "under the lock; coerce BEFORE acquiring it",
+                        )
+                    )
+            elif isinstance(node, ast.For):
+                name = _pure_dotted(node.iter)
+                if name is not None and name in device_vars:
+                    found.append(
+                        (
+                            node,
+                            f"iterating device value `{name}` fetches one "
+                            "element per step — a transfer per iteration",
+                        )
+                    )
+            elif isinstance(node, (ast.If, ast.While, ast.Assert)):
+                name = self._test_device_name(node.test, device_vars)
+                if name is not None:
+                    found.append(
+                        (
+                            node,
+                            f"branching on device value `{name}` forces an "
+                            "implicit bool() sync",
+                        )
+                    )
+        if has_record_fetch:
+            return  # the scope books its crossing: not hidden
+        for node, what in found:
+            self.ctx.report(
+                self.rule_name, node,
+                f"hidden host transfer: {what} outside a record_fetch "
+                "scope — book the crossing (record_fetch) or move it off "
+                "the hot path",
+            )
+
+    def _lock_bodies(self, scope) -> Set[ast.AST]:
+        """Every node lexically inside a ``with <lock>:`` body of this
+        scope (nested defs excluded, same as every other rule)."""
+        from .registry import is_lock_context
+
+        out: Set[ast.AST] = set()
+        for node in walk_scope(scope):
+            if isinstance(node, ast.With) and is_lock_context(node):
+                for inner in walk_scope(node):
+                    out.add(inner)
+        return out
+
+    # -- rule 3: redundant upload ------------------------------------------
+    def _check_uploads(self, scope) -> None:
+        if not self.ctx.serve_path:
+            return
+        reported: Set[int] = set()  # one finding per call site: nested
+        # loops each walk the inner call, but it is ONE upload
+        for node in walk_scope(scope):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            assigned = self._loop_assigned(node)
+            for inner in walk_scope(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                if id(inner) in reported:
+                    continue
+                callee = dotted_name(inner.func)
+                if callee not in _UPLOAD_CALLS or not inner.args:
+                    continue
+                name = _pure_dotted(inner.args[0])
+                if name is None:
+                    continue
+                root = name.split(".", 1)[0]
+                if name in assigned or root in assigned:
+                    continue  # varies per iteration: a real per-item upload
+                reported.add(id(inner))
+                self.ctx.report(
+                    self.rule_name, inner,
+                    f"redundant upload: `{callee}({name})` inside a "
+                    "serve-path loop re-transfers a loop-invariant value "
+                    "every iteration — hoist the upload (or cache the "
+                    "device buffer, the PR-1 exact-tail lesson); a "
+                    "deliberate per-target scatter needs a reviewed "
+                    "pragma mirrored in residency.DECLARED_TRANSFERS",
+                )
+
+    def _loop_assigned(self, loop) -> Set[str]:
+        """Names that may vary per iteration: anything (re)bound inside
+        the loop, the loop target(s), and the RECEIVER of any method
+        call (``rows.append(item)`` mutates ``rows`` in place — a value
+        grown per iteration is not loop-invariant even though it is
+        never re-assigned)."""
+        out: Set[str] = set()
+
+        def add_target(tgt) -> None:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for elt in tgt.elts:
+                    add_target(elt)
+                return
+            name = _pure_dotted(tgt)
+            if name is not None:
+                out.add(name)
+                out.add(name.split(".", 1)[0])
+
+        if isinstance(loop, ast.For):
+            add_target(loop.target)
+        for node in walk_scope(loop):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    add_target(tgt)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                add_target(node.target)
+            elif isinstance(node, ast.For):
+                add_target(node.target)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                add_target(node.optional_vars)
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                # conservative: a method receiver may have been mutated
+                # in place — erring this way only SILENCES the rule
+                add_target(node.func.value)
+        return out
+
+    # -- rule 1 facts: ordered event extraction ----------------------------
+    def _extract_events(self, scope) -> dict:
+        params = [a.arg for a in scope.args.args]
+        facts = _FunctionFacts(params)
+
+        def emit_expr(node) -> None:
+            if node is None:
+                return
+            if isinstance(node, ast.Call):
+                emit_call(node)
+                return
+            if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+                name = _pure_dotted(node)
+                if name is not None:
+                    facts.load(node.lineno, node.col_offset, name)
+                    if isinstance(node, ast.Subscript):
+                        emit_expr(node.slice)
+                    return
+            if isinstance(node, (ast.Lambda,)):
+                return  # separate execution scope
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    emit_expr(child)
+
+        def emit_call(node: ast.Call) -> None:
+            callee = dotted_name(node.func)
+            leaf = callee.rsplit(".", 1)[-1] if callee else ""
+            args = list(node.args)
+            method = isinstance(node.func, ast.Attribute)
+            if leaf in _RETRY_LEAVES and len(args) >= 2:
+                # retry_call("site", fn, *args): the wrapper dispatches
+                # fn — donated positions index into args[2:]
+                fn_name = dotted_name(args[1])
+                leaf = fn_name.rsplit(".", 1)[-1] if fn_name else ""
+                method = False
+                args = args[2:]
+            arg_names: List[Optional[str]] = []
+            for arg in args:
+                name = _pure_dotted(arg)
+                arg_names.append(name)
+                if name is None:
+                    emit_expr(arg)
+                elif isinstance(arg, ast.Subscript):
+                    emit_expr(arg.slice)
+            for kw in node.keywords:
+                emit_expr(kw.value)
+            # a method call READS its receiver: self._slabs.sum() after
+            # a donation is a use (the bare `self` of helper calls never
+            # poisons, so this stays quiet for plain self.helper())
+            if isinstance(node.func, ast.Attribute):
+                base = _pure_dotted(node.func.value)
+                if base is not None:
+                    facts.load(
+                        node.func.value.lineno,
+                        node.func.value.col_offset,
+                        base,
+                    )
+            leaves = [self.aliases.get(leaf, leaf)] if leaf else []
+            facts.call(
+                node.lineno, node.col_offset, leaves, arg_names, method
+            )
+
+        def emit_binds(tgt) -> None:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for elt in tgt.elts:
+                    emit_binds(elt)
+                return
+            if isinstance(tgt, ast.Subscript):
+                emit_expr(tgt.slice)
+                return  # x[i] = v mutates in place: x stays whatever it was
+            name = _pure_dotted(tgt)
+            if name is not None:
+                facts.bind(tgt.lineno, tgt.col_offset, name)
+
+        def emit_stmt(stmt) -> None:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                return
+            if isinstance(stmt, ast.Assign):
+                emit_expr(stmt.value)
+                for tgt in stmt.targets:
+                    emit_binds(tgt)
+                return
+            if isinstance(stmt, (ast.AugAssign,)):
+                emit_expr(stmt.value)
+                name = _pure_dotted(stmt.target)
+                if name is not None:
+                    facts.load(
+                        stmt.target.lineno, stmt.target.col_offset, name
+                    )
+                return
+            if isinstance(stmt, ast.AnnAssign):
+                emit_expr(stmt.value)
+                emit_binds(stmt.target)
+                return
+            if isinstance(stmt, ast.For):
+                emit_expr(stmt.iter)
+                emit_binds(stmt.target)
+                for s in stmt.body + stmt.orelse:
+                    emit_stmt(s)
+                return
+            if isinstance(stmt, ast.Delete):
+                # `del snapshot` discards the reference — that is the
+                # sanctioned way to DROP a donated ref, not a read
+                for tgt in stmt.targets:
+                    emit_binds(tgt)
+                return
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    emit_expr(child)
+                elif isinstance(child, ast.stmt):
+                    emit_stmt(child)
+                elif isinstance(child, ast.withitem):
+                    emit_expr(child.context_expr)
+                    if child.optional_vars is not None:
+                        emit_binds(child.optional_vars)
+                elif isinstance(child, ast.ExceptHandler):
+                    for s in child.body:
+                        emit_stmt(s)
+
+        for stmt in scope.body:
+            emit_stmt(stmt)
+        return facts.compact()
+
+    def summary(self) -> dict:
+        return {
+            "donating": self.donating,
+            "functions": self.functions,
+        }
+
+
+class _DonationProgram:
+    """The whole-program use-after-donate pass: merge every module's
+    donating registry (seed table + AST-discovered defs), propagate
+    donation through helper functions to a fixpoint (a helper that
+    forwards a parameter into a donated position donates that
+    parameter), then replay each function's event stream."""
+
+    def __init__(self, summaries: Dict[str, dict]):
+        self.summaries = summaries
+        # leaf -> (positions, has_self)
+        self.donating: Dict[str, Tuple[Tuple[int, ...], bool]] = {
+            leaf: (tuple(pos), False)
+            for leaf, pos in residency.DONATION_SITES.items()
+        }
+        for path in sorted(summaries):
+            for name, pos in summaries[path].get("donating", {}).items():
+                self.donating.setdefault(
+                    name.rsplit(".", 1)[-1], (tuple(pos), False)
+                )
+        self._fixpoint()
+
+    def _donated_args(
+        self, leaves: Sequence[str], args: Sequence[Optional[str]],
+        method: bool,
+    ) -> Tuple[Optional[str], List[Optional[str]]]:
+        """(callee leaf, donated arg names) when the call donates."""
+        for leaf in leaves:
+            entry = self.donating.get(leaf)
+            if entry is None:
+                continue
+            positions, has_self = entry
+            offset = 1 if (has_self and method) else 0
+            out: List[Optional[str]] = []
+            for p in positions:
+                i = p - offset
+                out.append(args[i] if 0 <= i < len(args) else None)
+            return leaf, out
+        return None, []
+
+    def _fixpoint(self) -> None:
+        for _ in range(20):
+            changed = False
+            for path in sorted(self.summaries):
+                funcs = self.summaries[path].get("functions", {})
+                for local in sorted(funcs):
+                    rec = funcs[local]
+                    params = rec["params"]
+                    leaf = local.rsplit(".", 1)[-1]
+                    if leaf in self.donating:
+                        continue
+                    donated_params: Set[int] = set()
+                    for ev in rec["events"]:
+                        if ev[2] != "call":
+                            continue
+                        _callee, names = self._donated_args(
+                            ev[3], ev[4], ev[5]
+                        )
+                        for name in names:
+                            if name in params:
+                                donated_params.add(params.index(name))
+                    if donated_params:
+                        has_self = bool(params) and params[0] in (
+                            "self", "cls"
+                        )
+                        self.donating[leaf] = (
+                            tuple(sorted(donated_params)), has_self
+                        )
+                        changed = True
+            if not changed:
+                return
+
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        for path in sorted(self.summaries):
+            funcs = self.summaries[path].get("functions", {})
+            for local in sorted(funcs):
+                out.extend(self._replay(path, local, funcs[local]))
+        return out
+
+    def _replay(self, path: str, local: str, rec: dict) -> List[Finding]:
+        poison: Dict[str, Tuple[str, int]] = {}
+        out: List[Finding] = []
+
+        def poisoned(name: str) -> Optional[Tuple[str, Tuple[str, int]]]:
+            # a USE must reach the buffer: the loaded name is the
+            # poisoned name or a path UNDER it.  A bare prefix load
+            # (`self` as a helper-call receiver after `self._slabs` was
+            # donated) is not a use — matching the other direction
+            # would flag every `self.helper()` between a donating call
+            # and its rebind.
+            for p, origin in poison.items():
+                if _component_prefixed(name, p):
+                    return p, origin
+            return None
+
+        for ev in rec["events"]:
+            line, col, kind = ev[0], ev[1], ev[2]
+            if kind == "call":
+                leaves, args, method = ev[3], ev[4], ev[5]
+                for name in args:
+                    if name is None:
+                        continue
+                    hit = poisoned(name)
+                    if hit is not None:
+                        p, (origin, oline) = hit
+                        out.append(
+                            Finding(
+                                path, line, col, "value-flow",
+                                f"use-after-donate: `{name}` passed to "
+                                f"`{'/'.join(leaves) or '<call>'}(...)` "
+                                f"after `{p}` was donated to `{origin}` "
+                                f"at line {oline} — the buffer was "
+                                "consumed in place; snapshot before the "
+                                "donating call or rebind from its "
+                                "results",
+                            )
+                        )
+                        del poison[p]  # report each donation once
+                callee, donated = self._donated_args(leaves, args, method)
+                if callee is not None:
+                    for name in donated:
+                        if name is not None:
+                            poison[name] = (callee, line)
+            elif kind == "load":
+                name = ev[3]
+                hit = poisoned(name)
+                if hit is not None:
+                    p, (origin, oline) = hit
+                    out.append(
+                        Finding(
+                            path, line, col, "value-flow",
+                            f"use-after-donate: `{name}` read after "
+                            f"`{p}` was donated to `{origin}` at line "
+                            f"{oline} — the buffer was consumed in "
+                            "place (jax marks it deleted); snapshot "
+                            "before the donating call or rebind from "
+                            "its results",
+                        )
+                    )
+                    del poison[p]
+            elif kind == "bind":
+                name = ev[3]
+                for p in [
+                    p for p in poison if _component_prefixed(p, name)
+                ]:
+                    del poison[p]
+        return out
+
+
+class ValueFlowRule(Rule):
+    name = "value-flow"
+    salt_sources = ("value_flow.py", "residency.py")
+    description = (
+        "device value-flow over the residency lattice: use-after-donate "
+        "(static twin of ops/donation_guard.py), hidden host transfers "
+        "(implicit device→host syncs), redundant loop-invariant uploads"
+    )
+
+    def __init__(self) -> None:
+        self._summaries: Dict[str, dict] = {}
+
+    def run(self, ctx: ModuleContext) -> None:
+        extractor = _Extractor(ctx, self.name)
+        self._summaries[ctx.display_path] = extractor.summary()
+
+    def dump_summary(self, display_path: str) -> Optional[dict]:
+        return self._summaries.get(display_path)
+
+    def load_summary(self, display_path: str, summary: dict) -> None:
+        self._summaries[display_path] = summary
+
+    def finalize(self) -> List[Finding]:
+        return _DonationProgram(self._summaries).findings()

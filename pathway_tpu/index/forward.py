@@ -56,6 +56,7 @@ import numpy as np
 
 from .. import observe
 from ..observe import hbm, profile
+from ..ops import donation_guard
 from ..ops.dispatch_counter import record_dispatch, record_fetch
 from ..ops.maxsim import (
     build_maxsim_kernel,
@@ -114,11 +115,17 @@ class ForwardUnavailable(RuntimeError):
     ``late_interaction_skipped`` rung."""
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2))
+@partial(
+    donation_guard.donating_jit,
+    site="forward.absorb_scatter",
+    donate_argnums=(0, 1, 2),
+)
 def _forward_scatter(tok, scales, nvalid, slots, q, s, nv):
     """Scatter one absorb plan into the row buckets; donated buffers so
     XLA updates the (possibly GB-scale) token store in place.  Pad plan
-    rows carry an out-of-range slot and drop."""
+    rows carry an out-of-range slot and drop.  Compiled through the
+    donation tripwire (``PATHWAY_DONATION_GUARD=1`` poisons the donated
+    refs post-call — ops/donation_guard.py)."""
     tok = tok.at[slots].set(q, mode="drop")
     scales = scales.at[slots].set(s, mode="drop")
     nvalid = nvalid.at[slots].set(nv, mode="drop")
@@ -606,6 +613,15 @@ class ForwardIndex:
         k_out = min(int(k_out), Kc)  # top-k cannot exceed the pool width
         if deadline is not None:
             deadline.check("forward.gather")
+        # cheap unlocked emptiness peek BEFORE paying the mask coercion:
+        # an empty index raises ForwardUnavailable without a host sync
+        # or upload (the authoritative re-check runs under the lock)
+        if self._tok is None or not self._slot_of_key:
+            raise ForwardUnavailable("forward index is empty")
+        # the query mask is caller-provided (possibly an unfetched device
+        # array from stage 1): coerce + upload OFF the index lock so the
+        # implicit sync never stalls a concurrent absorb commit
+        mask_dev = jnp.asarray(np.asarray(query_mask, np.float32))
         with self._lock:
             if self._tok is None or not self._slot_of_key:
                 raise ForwardUnavailable("forward index is empty")
@@ -633,7 +649,7 @@ class ForwardIndex:
                 "forward.gather",
                 fn,
                 query_tokens,
-                jnp.asarray(np.asarray(query_mask, np.float32)),
+                mask_dev,
                 self._tok,
                 self._scales,
                 self._nvalid,

@@ -1,0 +1,287 @@
+"""Runtime donation tripwire — the dynamic half of the value-flow
+analyzer's use-after-donate rule (pathway_tpu/analysis/value_flow.py).
+
+The static rule catches use-after-donate lexically; a violation that
+slips past it (a ref threaded through data structures, a snapshot taken
+on another thread) surfaces at runtime only as jax's opaque "Array has
+been deleted" — with no pointer to WHICH donation consumed the buffer,
+and on backends where donation is silently unusable, as a p99 cliff
+instead of an error.  ``PATHWAY_DONATION_GUARD=1`` arms this module:
+
+- every donating compiled callable built through :func:`donating_jit`
+  (the IVF absorb scatter, the forward-index commit scatter) POISONS
+  its donated argument references after the call — the reference ids
+  land in a site-attributed registry, and in strict mode the buffers
+  are explicitly ``.delete()``-d so a later touch raises even on
+  backends that ignored the donation;
+- a poisoned reference passed back INTO any guarded call is a detected
+  use-after-donate: **strict mode** (pytest, or
+  ``PATHWAY_DONATION_GUARD_STRICT=1``) raises :class:`DonationViolation`
+  naming both the donating and the re-using site; **production mode**
+  logs once and counts ``pathway_donation_violations_total{site}`` —
+  and runs the guarded call through a donation-FREE twin of the
+  kernel, so the serve keeps producing correct results while the
+  counter pins down the offender (the diagnostic trades donation's
+  in-place-update win for safety while armed);
+- ``check(value)`` is the explicit probe for tests and fetch helpers.
+
+Guard off (the default): :func:`donating_jit` calls dispatch straight
+through the donating executable — one flag read of overhead, donation
+semantics untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "DonationViolation",
+    "check",
+    "donating_jit",
+    "enabled",
+    "poison",
+    "stats",
+    "strict_mode",
+    "wrap",
+]
+
+
+class DonationViolation(RuntimeError):
+    """A donated (consumed) buffer reference was used again."""
+
+
+def enabled() -> bool:
+    return os.environ.get("PATHWAY_DONATION_GUARD", "") not in (
+        "", "0", "false", "no",
+    )
+
+
+def strict_mode() -> bool:
+    """Raise on a detected violation instead of log+count: explicitly
+    via ``PATHWAY_DONATION_GUARD_STRICT=1`` / off via ``=0``; defaults
+    to on under pytest so a use-after-donate is a red test, never a
+    silent garbage read."""
+    flag = os.environ.get("PATHWAY_DONATION_GUARD_STRICT")
+    if flag is not None:
+        return flag not in ("", "0", "false", "no")
+    return "PYTEST_CURRENT_TEST" in os.environ
+
+
+# id(buffer) -> (site, finalizer): site-attributed poison registry.  A
+# finalizer removes the id on GC so a recycled id can never inherit a
+# dead buffer's poison.
+_poisoned: Dict[int, Tuple[str, Any]] = {}
+_lock = threading.Lock()
+_poisoned_total: Dict[str, int] = {}
+_violations_total: Dict[str, int] = {}
+_sites: Dict[str, None] = {}  # insertion-ordered site registry
+
+
+class _Provider:
+    """Flight-recorder provider: both families render for every known
+    site (zeros stay visible — a silent counter is indistinguishable
+    from a dead one)."""
+
+    def observe_metrics(self):
+        with _lock:
+            sites = list(_sites)
+            poisoned = dict(_poisoned_total)
+            violations = dict(_violations_total)
+        for site in sites:
+            labels = {"site": site}
+            yield (
+                "counter", "pathway_donation_poisoned_total", labels,
+                poisoned.get(site, 0),
+            )
+            yield (
+                "counter", "pathway_donation_violations_total", labels,
+                violations.get(site, 0),
+            )
+
+
+_provider = _Provider()
+
+
+def _register_site(site: str) -> None:
+    with _lock:
+        first = not _sites
+        _sites.setdefault(site, None)
+    if first:
+        # weakly registered, but the module global keeps it alive for
+        # the process lifetime
+        from .. import observe
+
+        observe.register_provider(_provider)
+
+
+def _forget(buf_id: int) -> None:
+    with _lock:
+        _poisoned.pop(buf_id, None)
+
+
+def poison(site: str, *buffers: Any) -> None:
+    """Mark donated buffer references consumed.  Strict mode also
+    ``.delete()``-s them so ANY later touch raises, even on backends
+    where the donation itself was unusable (retro-fitting TPU
+    semantics onto CPU test runs)."""
+    if not enabled():
+        return
+    _register_site(site)
+    strict = strict_mode()
+    n = 0
+    for buf in buffers:
+        if buf is None or not hasattr(buf, "is_deleted"):
+            continue
+        try:
+            fin = weakref.finalize(buf, _forget, id(buf))
+        except TypeError:  # not weakref-able: track without cleanup
+            fin = None
+        with _lock:
+            _poisoned[id(buf)] = (site, fin)
+        n += 1
+        if strict:
+            try:
+                if not buf.is_deleted():
+                    buf.delete()
+            except Exception:
+                pass  # a committed/aliased buffer: jax already owns it
+    if n:
+        with _lock:
+            _poisoned_total[site] = _poisoned_total.get(site, 0) + n
+
+
+def check(value: Any) -> Optional[str]:
+    """The explicit probe: the donating site that consumed ``value``,
+    or None when the reference is live."""
+    with _lock:
+        entry = _poisoned.get(id(value))
+    return entry[0] if entry is not None else None
+
+
+def _violation(origin: str, use_site: str) -> None:
+    with _lock:
+        _violations_total[use_site] = _violations_total.get(use_site, 0) + 1
+    msg = (
+        f"use-after-donate: a buffer donated to {origin!r} was passed "
+        f"back into {use_site!r} — the donation consumed it in place; "
+        "snapshot before the donating call or rebind from its results"
+    )
+    if strict_mode():
+        raise DonationViolation(msg)
+    from ..robust import log_once
+
+    log_once(f"donation_guard:{origin}->{use_site}", "[donation_guard] %s", msg)
+
+
+def _check_args(site: str, args: Tuple[Any, ...]) -> None:
+    for arg in args:
+        with _lock:
+            entry = _poisoned.get(id(arg))
+        if entry is not None:
+            _violation(entry[0], site)
+
+
+class _DonatingJit:
+    """One donating compiled callable under the guard.  Guard off: the
+    donating executable, straight through.  Guard on: incoming args are
+    checked against the poison registry, the donated inputs are
+    poisoned after the call, and production mode dispatches a
+    donation-free twin so a detected violation stays log-only."""
+
+    def __init__(self, fn: Callable, site: str,
+                 donate_argnums: Tuple[int, ...], jit_kwargs: dict):
+        import jax
+
+        self.site = site
+        self.donate_argnums = tuple(donate_argnums)
+        self._fn = fn
+        self._donating = jax.jit(
+            fn, donate_argnums=self.donate_argnums, **jit_kwargs
+        )
+        self._safe: Optional[Callable] = None  # compiled on first use
+        self._jit_kwargs = jit_kwargs
+        self.__name__ = getattr(fn, "__name__", site)
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        if not enabled():
+            return self._donating(*args, **kwargs)
+        _register_site(self.site)
+        _check_args(self.site, args)
+        if strict_mode():
+            out = self._donating(*args, **kwargs)
+        else:
+            # production diagnostic mode: skip the real donation so a
+            # use-after-donate stays a counted log line, not a crash
+            if self._safe is None:
+                import jax
+
+                self._safe = jax.jit(self._fn, **self._jit_kwargs)
+            out = self._safe(*args, **kwargs)
+        poison(
+            self.site,
+            *(args[i] for i in self.donate_argnums if i < len(args)),
+        )
+        return out
+
+
+def donating_jit(
+    fn: Optional[Callable] = None,
+    *,
+    site: str,
+    donate_argnums: Tuple[int, ...],
+    **jit_kwargs: Any,
+) -> Callable:
+    """``jax.jit(fn, donate_argnums=...)`` with the donation tripwire
+    attached — the guard-aware constructor every donating kernel in the
+    tree uses (the static analyzer registers this spelling alongside
+    ``jax.jit``, so the wrapper launders nothing out of the rules)."""
+    if fn is None:
+        return lambda f: donating_jit(
+            f, site=site, donate_argnums=donate_argnums, **jit_kwargs
+        )
+    return _DonatingJit(fn, site, tuple(donate_argnums), jit_kwargs)
+
+
+def wrap(
+    site: str, fn: Callable, donate_argnums: Tuple[int, ...]
+) -> Callable:
+    """Guard an ALREADY-compiled donating callable: args are checked
+    and poisoned around every call.  Unlike :func:`donating_jit` this
+    cannot substitute a donation-free twin, so production mode only
+    counts — the underlying call still sees the real donation."""
+
+    def guarded(*args: Any, **kwargs: Any):
+        if not enabled():
+            return fn(*args, **kwargs)
+        _register_site(site)
+        _check_args(site, args)
+        out = fn(*args, **kwargs)
+        poison(site, *(args[i] for i in donate_argnums if i < len(args)))
+        return out
+
+    guarded.__name__ = f"donation_guard[{site}]"
+    guarded.site = site
+    return guarded
+
+
+def stats() -> dict:
+    """Bench/test snapshot of the guard's counters."""
+    with _lock:
+        return {
+            "sites": list(_sites),
+            "tracked": len(_poisoned),
+            "poisoned": dict(_poisoned_total),
+            "violations": dict(_violations_total),
+        }
+
+
+def _reset_for_tests() -> None:
+    with _lock:
+        _poisoned.clear()
+        _poisoned_total.clear()
+        _violations_total.clear()
+        _sites.clear()

@@ -48,6 +48,7 @@ from ..robust import (
     record_degraded,
     retry_call,
 )
+from . import donation_guard
 from .knn import _bucket, normalize_metric
 from .recompile_guard import RecompileTripwire
 
@@ -87,7 +88,7 @@ def _kmeans(
         return jnp.argmax(scores, axis=1)
 
     for _ in range(iters):
-        # pathway: allow(recompile-hazard): train-time — centroids keep one [C, d] shape for all iterations of a build; one compile per (C, d), off the serve path
+        # pathway: allow(recompile-hazard, value-flow): train-time — centroids keep one [C, d] shape for all iterations of a build; one compile per (C, d), and the synchronous fetch is the k-means loop's contract, off the serve path
         owner = np.asarray(assign(jnp.asarray(centroids)))
         sums = np.zeros_like(centroids)
         np.add.at(sums, owner, sample)
@@ -149,10 +150,16 @@ def _tail_prefs(rows, centroids, n_pref):
     return idx
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@partial(
+    donation_guard.donating_jit,
+    site="ivf.absorb_scatter",
+    donate_argnums=(0, 1),
+)
 def _absorb_scatter(slabs, bias, slots, vecs):
     """Scatter absorbed rows into free slots; donated buffers so XLA can
-    update the (possibly GB-scale) slabs in place instead of copying."""
+    update the (possibly GB-scale) slabs in place instead of copying.
+    Compiled through the donation tripwire (``PATHWAY_DONATION_GUARD=1``
+    poisons the donated refs post-call — ops/donation_guard.py)."""
     C_pad, M_pad, d_pad = slabs.shape
     flat = slabs.reshape(C_pad * M_pad, d_pad).at[slots].set(vecs)
     b = bias.reshape(-1).at[slots].set(jnp.float32(0.0))
@@ -350,13 +357,17 @@ class IvfKnnIndex:
 
     # -- mutation (host-of-record; device rebuilt lazily) ------------------
     def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+        # coerce + normalize BEFORE the lock: callers hand the encoder's
+        # device rows straight here, and the implicit device→host sync
+        # must not stall every concurrent search/absorb on the index
+        # lock (value-flow analyzer finding)
+        vectors = np.asarray(vectors, np.float32).reshape(
+            len(keys), self.dimension
+        )
+        if self.metric == "cos":
+            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+            vectors = vectors / np.where(norms == 0, 1.0, norms)
         with self._lock:
-            vectors = np.asarray(vectors, np.float32).reshape(
-                len(keys), self.dimension
-            )
-            if self.metric == "cos":
-                norms = np.linalg.norm(vectors, axis=1, keepdims=True)
-                vectors = vectors / np.where(norms == 0, 1.0, norms)
             # membership check covers BOTH stores: host rows and (after
             # build_from_matrix) device-only bulk keys known via their slot
             existing = [
@@ -573,11 +584,11 @@ class IvfKnnIndex:
             chunk = data[start : start + step]
             if chunk.shape[0] < step and n > step:
                 pad = np.zeros((step - chunk.shape[0], data.shape[1]), data.dtype)
-                # pathway: allow(recompile-hazard): build-time — chunks are padded to the fixed 131072-row step, so large builds compile once; the n<=step case compiles once per corpus size per build
+                # pathway: allow(recompile-hazard, value-flow): build-time — chunks are padded to the fixed 131072-row step, so large builds compile once (the n<=step case once per corpus size), and the chunked synchronous fetch IS the layout build, off the serve path
                 got = np.asarray(_prefs(jnp.asarray(np.concatenate([chunk, pad]))))
                 parts.append(got[: chunk.shape[0]])
             else:
-                # pathway: allow(recompile-hazard): build-time — one compile per (n, d) layout build, off the serve path (serving shapes go through _bucket)
+                # pathway: allow(recompile-hazard, value-flow): build-time — one compile per (n, d) layout build and a deliberate synchronous fetch, off the serve path (serving shapes go through _bucket)
                 parts.append(np.asarray(_prefs(jnp.asarray(chunk))))
         order = np.concatenate(parts) if len(parts) > 1 else parts[0]
         assignment, counts = _balanced_assign(order, C, cap)
@@ -753,7 +764,7 @@ class IvfKnnIndex:
             if tb > t
             else data
         )
-        prefs = np.asarray(
+        prefs = np.asarray(  # pathway: allow(value-flow): absorb PLAN phase — a deliberate synchronous preference fetch on the off-lock background planner, never on the serve path
             _tail_prefs(jnp.asarray(data_p), snap["centroids"], n_pref)
         )[:t]
         live = snap["live"]
@@ -1026,7 +1037,7 @@ class IvfKnnIndex:
             m = min(step, n - start)
             chunk = jax.lax.dynamic_slice_in_dim(matrix_dev, start, m, 0) \
                 if m == step else matrix_dev[start : start + m]
-            parts.append(np.asarray(_prefs(chunk)))
+            parts.append(np.asarray(_prefs(chunk)))  # pathway: allow(value-flow): bulk build — deliberate chunked synchronous fetch of cluster preferences, never on the serve path
         order = np.concatenate(parts) if len(parts) > 1 else parts[0]
         assignment, counts = _balanced_assign(order, C, cap)
 
@@ -1106,11 +1117,13 @@ class IvfKnnIndex:
         return max(1, min(C, int(np.ceil(C * frac))))
 
     # -- search ------------------------------------------------------------
-    def search(
+    def search(  # pathway: allow(value-flow): reference host search — the synchronous host-results contract (serving uses submit/complete, which books its crossings); the fetch + float/int post-process below runs OFF the lock by design
         self, queries: np.ndarray, k: int, n_probe: Optional[int] = None
     ) -> List[List[Tuple[int, float]]]:
+        # off-lock coercion: a device-array query batch syncs here, not
+        # while holding the index lock
+        queries = np.asarray(queries, np.float32).reshape(-1, self.dimension)
         with self._lock:
-            queries = np.asarray(queries, np.float32).reshape(-1, self.dimension)
             nq = queries.shape[0]
             if nq == 0 or len(self) == 0:
                 return [[] for _ in range(nq)]

@@ -226,12 +226,15 @@ class DeviceKnnIndex:
 
     # -- mutation ----------------------------------------------------------
     def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+        if len(keys) == 0:
+            return
+        # coerce BEFORE the lock: callers hand the encoder's device rows
+        # straight here — the device→host sync must not run under the
+        # index lock (value-flow analyzer finding)
+        vectors = np.asarray(vectors, dtype=np.float32).reshape(
+            len(keys), self.dimension
+        )
         with self._lock:
-            if len(keys) == 0:
-                return
-            vectors = np.asarray(vectors, dtype=np.float32).reshape(
-                len(keys), self.dimension
-            )
             # upsert: remove keys that already exist
             existing = [k for k in keys if int(k) in self.key_to_slot]
             if existing:
@@ -396,8 +399,10 @@ class DeviceKnnIndex:
 
         ``candidate_keys``: optional per-query allow-list (metadata filtering
         path) — scoring stays on device, the allow-mask is built host-side."""
+        # off-lock coercion: a device-array query batch syncs here, not
+        # while holding the index lock (value-flow analyzer finding)
+        queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dimension)
         with self._lock:
-            queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dimension)
             nq = queries.shape[0]
             if nq == 0 or not self.key_to_slot:
                 return [[] for _ in range(nq)]

@@ -675,7 +675,7 @@ class FusedEncodeSearch:
                     # launches — under the child lock, because a
                     # concurrent absorb commit DONATES the slab buffers
                     # (same launch-before-unlock rule as _submit_ivf)
-                    z_s = jax.device_put(z, group.device(s))  # pathway: allow(lock-discipline): device→device scatter of an UNFETCHED [B, d] embedding — an async ICI hop enqueued like a dispatch, not a host link round trip; it must precede the launch that consumes it under this lock
+                    z_s = jax.device_put(z, group.device(s))  # pathway: allow(lock-discipline, value-flow): device→device scatter of an UNFETCHED [B, d] embedding — an async ICI hop enqueued like a dispatch, not a host link round trip; the value is loop-invariant but the TARGET device varies per shard (mirrored in residency.DECLARED_TRANSFERS), and it must precede the launch that consumes it under this lock
                     out = retry_call(  # pathway: allow(lock-discipline): dispatch-only — donated absorb buffers force launch-before-unlock; the merged fetch happens off-lock in the completion
                         "shard.dispatch",
                         fn,

@@ -530,7 +530,7 @@ class ContinuousDecoder(_CoalescerBase):
                 jnp.asarray(temps),
                 deadline=deadline,
             )
-            firsts = np.asarray(toks)
+            firsts = np.asarray(toks)  # pathway: allow(value-flow): the prefill JOIN's one deliberate host fetch — first tokens must reach the riders' tickets before the step loop takes over
             t1 = time.perf_counter_ns()
             _H_PREFILL.observe_ns(t1 - t0)
         except Exception as exc:
@@ -651,7 +651,7 @@ class ContinuousDecoder(_CoalescerBase):
                 pk, pv, rngs, em = retry_call(
                     "generator.step", fn, *args, deadline=deadline
                 )
-            em = np.asarray(em)  # [chunk, S]: the per-chunk host fetch
+            em = np.asarray(em)  # [chunk, S]: the per-chunk host fetch  # pathway: allow(value-flow): THE decode-loop fetch — one deliberate sync per step chunk delivers every slot's tokens to its rider
         except Exception as exc:
             if bctx is not None:
                 trace.finish(bctx, statuses=("error",))
@@ -685,7 +685,7 @@ class ContinuousDecoder(_CoalescerBase):
             flags: Tuple[str, ...] = ()
             finished = False
             for i in range(self.chunk):
-                t = int(em[i, s])
+                t = int(em[i, s])  # pathway: allow(value-flow): `em` was rebound to its HOST copy at the fetch above — the rule's name-level residency tracking cannot see the rebind; no device touch happens here
                 st.tokens.append(t)
                 st.pos += 1
                 st.left -= 1

@@ -74,10 +74,13 @@ class LshKnnIndex:
         return out
 
     def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+        # coerce BEFORE the lock: callers hand the encoder's device rows
+        # straight here, and the device→host sync must not run while
+        # holding the bucket lock (value-flow analyzer finding)
+        vectors = np.asarray(vectors, np.float32).reshape(
+            len(keys), self.dimension
+        )
         with self._lock:
-            vectors = np.asarray(vectors, np.float32).reshape(
-                len(keys), self.dimension
-            )
             existing = [int(k) for k in keys if int(k) in self._rows]
             if existing:
                 self.remove(existing)
@@ -107,10 +110,12 @@ class LshKnnIndex:
     def search(
         self, queries: np.ndarray, k: int
     ) -> List[List[Tuple[int, float]]]:
+        # same off-lock coercion rule as add(): a device-array query
+        # batch syncs here, not under the lock
+        queries = np.asarray(queries, np.float32).reshape(
+            -1, self.dimension
+        )
         with self._lock:
-            queries = np.asarray(queries, np.float32).reshape(
-                -1, self.dimension
-            )
             if queries.shape[0] == 0 or not self._rows:
                 return [[] for _ in range(queries.shape[0])]
             sigs = self._signatures(queries)

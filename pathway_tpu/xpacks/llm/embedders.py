@@ -108,7 +108,7 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             self._dimension = int(st_model.get_sentence_embedding_dimension())
 
             def embed(texts) -> np.ndarray:
-                return np.asarray(
+                return np.asarray(  # pathway: allow(value-flow): SentenceTransformer is a HOST-side model — its .encode matches the device-producer spelling but returns numpy rows; no device crossing exists here
                     st_model.encode(list(texts), **call_kwargs), dtype=np.float32
                 )
 

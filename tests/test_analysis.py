@@ -1388,6 +1388,518 @@ def test_lock_order_inherited_lock_is_one_graph_node(tmp_path):
     assert "Engine._qlock" not in live[0].message
 
 
+# -- value-flow (ISSUE 15) ---------------------------------------------------
+
+_DONATE_HDR = """
+    import jax
+    import numpy as np
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def _scatter(slabs, bias, slots, vecs):
+        return slabs, bias
+"""
+
+
+def test_value_flow_use_after_donate_flags_and_rebind_clean():
+    bad = _DONATE_HDR + """
+    class Index:
+        def broken(self, slots, vecs):
+            new_slabs, new_bias = _scatter(self._slabs, self._bias, slots, vecs)
+            return np.asarray(self._slabs)  # reads the consumed buffer
+    """
+    live = _live(_run(bad, "fixtures/donate.py"), "value-flow")
+    assert len(live) == 1, live
+    assert "use-after-donate" in live[0].message
+    assert "_scatter" in live[0].message
+
+    good = _DONATE_HDR + """
+    class Index:
+        def commit(self, slots, vecs):
+            self._slabs, self._bias = _scatter(
+                self._slabs, self._bias, slots, vecs
+            )
+            return self._slabs  # rebound from the call's results: live
+    """
+    assert _live(_run(good, "fixtures/donate.py"), "value-flow") == []
+
+
+def test_value_flow_use_after_donate_pragma_suppresses():
+    src = _DONATE_HDR + """
+    class Index:
+        def audited(self, slots, vecs):
+            out = _scatter(self._slabs, self._bias, slots, vecs)
+            return np.asarray(self._slabs)  # pathway: allow(value-flow): fixture — reviewed
+    """
+    findings = _run(src, "fixtures/donate.py")
+    assert _live(findings, "value-flow") == []
+    assert any(f.rule == "value-flow" and f.suppressed for f in findings)
+
+
+def test_value_flow_interprocedural_through_helper_retry_and_wrap():
+    """ISSUE 15: donation propagates through helper calls (a helper
+    forwarding a parameter into a donated position donates that
+    parameter), ``retry_call("site", fn, ...)`` wrappers (positions
+    shift past the two leading args), and ``profile.wrap`` bindings."""
+    src = _DONATE_HDR + """
+    from pathway_tpu.observe import profile
+    from pathway_tpu.robust import retry_call
+
+    _wrapped = profile.wrap("ivf.scatter", _scatter)
+
+    class Index:
+        def _commit(self, slabs, bias, slots, vecs):
+            return _scatter(slabs, bias, slots, vecs)
+
+        def via_helper(self, slots, vecs):
+            out = self._commit(self._slabs, self._bias, slots, vecs)
+            return float(self._slabs[0, 0])
+
+        def via_retry(self, slots, vecs):
+            out = retry_call("ivf.absorb", _scatter, self._slabs, self._bias, slots, vecs)
+            return self._bias.sum()
+
+        def via_wrap(self, slots, vecs):
+            out = _wrapped(self._slabs, self._bias, slots, vecs)
+            return self._slabs
+    """
+    live = _live(_run(src, "fixtures/donate_ip.py"), "value-flow")
+    messages = "\n".join(f.message for f in live)
+    assert len(live) == 3, messages
+    assert all("use-after-donate" in f.message for f in live)
+
+
+def test_value_flow_helper_call_between_donate_and_rebind_clean():
+    """Precision: a bare ``self.helper()`` between the donating call and
+    the rebind loads `self`, NOT the donated buffer — it must not be
+    reported as a use (only the poisoned name or a path under it is)."""
+    src = _DONATE_HDR + """
+    class Index:
+        def commit(self, slots, vecs):
+            out = _scatter(self._slabs, self._bias, slots, vecs)
+            self._note_commit()          # helper between donate and rebind
+            self.stats["absorbs"] += 1   # unrelated attr is not a use
+            self._slabs, self._bias = out
+            return self._slabs
+    """
+    assert _live(_run(src, "fixtures/donate.py"), "value-flow") == []
+
+
+def test_value_flow_is_none_guard_clean():
+    """Precision: ``is`` / ``is not`` are reference checks, never a
+    device fetch — the ubiquitous `if out is None:` guard stays quiet
+    while a value comparison still flags."""
+    quiet = """
+    import jax
+
+    @jax.jit
+    def _fused(x):
+        return x
+
+    def guarded(q):
+        out = _fused(q)
+        if out is None:
+            return None
+        if out is not None and q is None:
+            return out
+        return out
+    """
+    assert _live(_run(quiet, "fixtures/isnone.py"), "value-flow") == []
+
+
+def test_value_flow_nested_loop_upload_reported_once():
+    """Precision: an upload inside nested loops is ONE call site — the
+    outer- and inner-loop walks must not duplicate the finding."""
+    src = _SERVE_HDR + textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def fan_out(shards, w):
+            for s in shards:
+                for t in range(4):
+                    push(jnp.asarray(w))
+            return shards
+    """)
+    live = _live(analyze_source(src, "fixtures/serve.py"), "value-flow")
+    assert len(live) == 1, [f.format() for f in live]
+
+
+def test_value_flow_inplace_mutated_value_not_loop_invariant():
+    """Precision: a value grown in place per iteration
+    (``rows.append(item)``) is NOT loop-invariant even though it is
+    never re-assigned — its upload each round carries new bytes."""
+    src = _SERVE_HDR + textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def accumulate(batch):
+            rows = []
+            outs = []
+            for item in batch:
+                rows.append(item)
+                outs.append(jnp.asarray(rows))
+            return outs
+    """)
+    assert _live(analyze_source(src, "fixtures/serve.py"), "value-flow") == []
+
+
+def test_value_flow_registry_seeded_donation_site():
+    """A call reaching a donating callable by LEAF name resolves through
+    the seeded ``residency.DONATION_SITES`` table even when the
+    defining module is not in the analyzed set (cross-module calls)."""
+    src = """
+    import numpy as np
+
+    from pathway_tpu.ops.ivf import _absorb_scatter
+
+    class Index:
+        def commit(self, slots, vecs):
+            out = _absorb_scatter(self._slabs, self._bias, slots, vecs)
+            return np.asarray(self._slabs)
+    """
+    live = _live(_run(src, "fixtures/seeded.py"), "value-flow")
+    assert len(live) == 1 and "use-after-donate" in live[0].message
+
+
+def test_value_flow_hidden_transfer_implicit_conversions():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def _fused(x):
+        return x
+
+    def decide(q):
+        out = _fused(q)
+        if out > 0:                  # branch: implicit bool() sync
+            return 1
+        for v in out:                # iteration: per-element fetch
+            print(v)
+        return out.tolist()          # tolist: whole-array transfer
+    """
+    live = _live(_run(src, "fixtures/implicit.py"), "value-flow")
+    messages = "\n".join(f.message for f in live)
+    assert len(live) == 3, messages
+    assert "bool()" in messages and "iterat" in messages and "tolist" in messages
+
+    # metadata reads are free and must stay quiet
+    quiet = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def _fused(x):
+        return x
+
+    def shapes(q):
+        out = _fused(q)
+        if len(out) > 0 and out.shape[0] > 2:
+            return out
+        return None
+    """
+    assert _live(_run(quiet, "fixtures/implicit.py"), "value-flow") == []
+
+    # a scope that books its crossing with record_fetch is clean
+    booked = """
+    import jax
+    import numpy as np
+
+    from pathway_tpu.ops.dispatch_counter import record_fetch
+
+    @jax.jit
+    def _fused(x):
+        return x
+
+    def fetch(q):
+        out = _fused(q)
+        host = np.asarray(out)
+        record_fetch("serve")
+        return host.tolist()
+    """
+    assert _live(_run(booked, "fixtures/implicit.py"), "value-flow") == []
+
+
+def test_value_flow_device_producer_convention():
+    """``<embedder>.encode(texts)`` returns device rows by the encoder
+    convention — coercing the result is a visible crossing even in a
+    module with no jit registry of its own (the stdlib adapter class)."""
+    bad = """
+    import numpy as np
+
+    class Adapter:
+        def _embed(self, values):
+            texts = [str(v) for v in values]
+            return list(np.asarray(self.embedder.encode(texts), np.float32))
+    """
+    live = _live(_run(bad, "fixtures/adapter.py"), "value-flow")
+    assert len(live) == 1 and "hidden host transfer" in live[0].message
+    # str.encode receivers do not match the producer spelling
+    quiet = """
+    import numpy as np
+
+    def pack(payload):
+        return np.asarray(payload.encode("utf-8"))
+    """
+    assert _live(_run(quiet, "fixtures/adapter.py"), "value-flow") == []
+
+
+def test_value_flow_param_coercion_under_lock():
+    """The ``_knn_lsh.py`` class: ``np.asarray(vectors)`` inside a lock
+    body where callers hand the encoder's device rows — the sync runs
+    under the lock.  The hoisted shape is the fix, not a pragma."""
+    bad = """
+    import threading
+
+    import numpy as np
+
+    class LshIndex:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def add(self, keys, vectors):
+            with self._lock:
+                vectors = np.asarray(vectors, np.float32)
+                self._rows = vectors
+    """
+    live = _live(_run(bad, "fixtures/lsh.py"), "value-flow")
+    assert len(live) == 1 and "inside a lock body" in live[0].message
+
+    good = """
+    import threading
+
+    import numpy as np
+
+    class LshIndex:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def add(self, keys, vectors):
+            vectors = np.asarray(vectors, np.float32)  # off-lock
+            with self._lock:
+                self._rows = vectors
+    """
+    assert _live(_run(good, "fixtures/lsh.py"), "value-flow") == []
+
+
+def test_value_flow_redundant_upload():
+    bad = _SERVE_HDR + textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        def fan_out(shards, z):
+            outs = []
+            for s in shards:
+                outs.append(jax.device_put(z, s))  # loop-invariant
+            return outs
+    """)
+    live = _live(analyze_source(bad, "fixtures/serve.py"), "value-flow")
+    assert len(live) == 1 and "redundant upload" in live[0].message
+
+    # per-iteration values are real uploads, not redundant ones
+    good = _SERVE_HDR + textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def per_item(rows):
+            return [jnp.asarray(r) for r in rows]
+
+        def per_chunk(chunks):
+            outs = []
+            for c in chunks:
+                c2 = c.reshape(-1)
+                outs.append(jnp.asarray(c2))
+            return outs
+    """)
+    assert _live(analyze_source(good, "fixtures/serve.py"), "value-flow") == []
+
+    # off the serve path the loop rule does not apply
+    off_path = textwrap.dedent("""
+        import jax
+
+        def fan_out(shards, z):
+            return [jax.device_put(z, s) for s in shards]
+    """)
+    assert _live(analyze_source(off_path, "fixtures/offline.py"), "value-flow") == []
+
+    # a reviewed per-target scatter pragma suppresses
+    waived = _SERVE_HDR + textwrap.dedent("""
+        import jax
+
+        def fan_out(shards, z):
+            outs = []
+            for s in shards:
+                outs.append(jax.device_put(z, s))  # pathway: allow(value-flow): fixture — per-TARGET scatter, mirrored in DECLARED_TRANSFERS
+            return outs
+    """)
+    findings = analyze_source(waived, "fixtures/serve.py")
+    assert _live(findings, "value-flow") == []
+    assert any(f.rule == "value-flow" and f.suppressed for f in findings)
+
+
+def _enclosing_qualnames(real_path: str, lines: set) -> set:
+    """Innermost-function qualnames (Class.method / Class.method.inner)
+    covering the given lines — the DECLARED_TRANSFERS key shape."""
+    import ast
+
+    with open(real_path) as fh:
+        tree = ast.parse(fh.read())
+    out = set()
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            s = stack
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                s = stack + [child.name]
+            walk(child, s)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for ln in lines:
+                    if child.lineno <= ln <= child.end_lineno:
+                        out.add(".".join(s))
+
+    walk(tree, [])
+    # keep only the INNERMOST qualname per line: drop strict prefixes
+    return {
+        q
+        for q in out
+        if not any(o != q and o.startswith(q + ".") for o in out)
+    }
+
+
+def test_declared_transfers_mirror_matches_pragmas(repo_analysis):
+    """Satellite gate (ISSUE 15): ``residency.DECLARED_TRANSFERS`` and
+    the in-code ``allow(value-flow)`` pragmas mirror each other — every
+    suppressed value-flow finding sits in a declared function, and
+    every declared entry still covers at least one suppressed finding
+    (a stale table entry is rot, exactly like a stale pragma)."""
+    from pathway_tpu.analysis import residency
+
+    findings, _pragmas = repo_analysis
+    by_path: dict = {}
+    for f in findings:
+        if f.rule == "value-flow" and f.suppressed:
+            by_path.setdefault(f.path, set()).add(f.line)
+
+    declared = dict(residency.DECLARED_TRANSFERS)
+    matched = set()
+    undeclared = []
+    for path, lines in sorted(by_path.items()):
+        real = os.path.join(_REPO_ROOT, path)
+        quals = _enclosing_qualnames(real, lines)
+        norm = path.replace(os.sep, "/")
+        per_module = residency.declared_transfers_for(norm)
+        for qual in sorted(quals):
+            if qual in per_module:
+                matched.update(
+                    (suffix, q)
+                    for (suffix, q) in declared
+                    if q == qual and norm.endswith(suffix)
+                )
+            else:
+                undeclared.append(f"{path}: {qual}")
+    assert undeclared == [], (
+        "suppressed value-flow crossings with no DECLARED_TRANSFERS "
+        f"entry (add the reviewed mirror): {undeclared}"
+    )
+    stale = sorted(set(declared) - matched)
+    assert stale == [], (
+        "DECLARED_TRANSFERS entries whose crossing was fixed or moved "
+        f"(delete the stale mirror): {stale}"
+    )
+
+
+def test_analysis_cache_per_family_keys(tmp_path, monkeypatch):
+    """ISSUE 15 satellite: per-family content-hash keys — ADDING a rule
+    family re-parses modules to run the NEW family but reuses the other
+    families' cached findings (their ``run`` is never invoked), and a
+    fully-warm run parses nothing."""
+    from pathway_tpu.analysis import core
+    from pathway_tpu.analysis.hidden_sync import HiddenSyncRule
+    from pathway_tpu.analysis.lock_discipline import LockDisciplineRule
+    from pathway_tpu.analysis.lock_order import LockOrderRule
+    from pathway_tpu.analysis.recompile_hazard import RecompileHazardRule
+    from pathway_tpu.analysis.value_flow import ValueFlowRule
+
+    tree = tmp_path / "pathway_tpu" / "serve"
+    tree.mkdir(parents=True)
+    (tree / "a.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            import jax
+
+            @jax.jit
+            def _score(x):
+                return x
+
+            class A:
+                def __init__(self):
+                    self._alock = threading.Lock()
+
+                def f(self, q):
+                    with self._alock:
+                        return _score(q)
+            """
+        )
+    )
+    (tree / "b.py").write_text("x = 1\n")
+    monkeypatch.setenv("PATHWAY_ANALYSIS_CACHE", str(tmp_path / "cache"))
+
+    parses = []
+    orig = core._run_module
+
+    def counting_run(source, display, rules, real_path=None):
+        parses.append(display)
+        return orig(source, display, rules, real_path)
+
+    monkeypatch.setattr(core, "_run_module", counting_run)
+
+    def fresh_four():
+        return [
+            LockDisciplineRule(), HiddenSyncRule(),
+            RecompileHazardRule(), LockOrderRule(),
+        ]
+
+    cold = analyze_paths([str(tmp_path / "pathway_tpu")], rules=fresh_four())
+    assert len(parses) == 2
+    cold_by_rule = {
+        rule: [f.__dict__ for f in cold if f.rule == rule]
+        for rule in ("lock-discipline", "hidden-sync", "recompile-hazard",
+                     "lock-order")
+    }
+    assert cold_by_rule["lock-discipline"], "fixture lost its finding"
+
+    # adding the 5th family: modules re-parse (the new family must run)
+    # but the four cached families are NOT re-run
+    four = fresh_four()
+    runs = {rule.name: 0 for rule in four}
+    for rule in four:
+        orig_run = rule.run
+        rule.run = (
+            lambda ctx, _r=rule, _o=orig_run: (
+                runs.__setitem__(_r.name, runs[_r.name] + 1), _o(ctx)
+            )
+        )
+    five = four + [ValueFlowRule()]
+    second = analyze_paths([str(tmp_path / "pathway_tpu")], rules=five)
+    assert len(parses) == 4  # both modules parsed again for the new family
+    assert runs == {name: 0 for name in runs}, (
+        f"cached families re-ran after adding a family: {runs}"
+    )
+    for rule, cold_findings in cold_by_rule.items():
+        got = [f.__dict__ for f in second if f.rule == rule]
+        assert got == cold_findings, f"{rule} findings drifted via cache"
+
+    # fully warm: nothing parses, findings bit-identical
+    third = analyze_paths(
+        [str(tmp_path / "pathway_tpu")],
+        rules=fresh_four() + [ValueFlowRule()],
+    )
+    assert len(parses) == 4, "fully-warm run re-parsed a module"
+    assert [f.__dict__ for f in third] == [f.__dict__ for f in second]
+
+
 # -- --check-pragmas (stale waivers) ----------------------------------------
 
 def test_stale_pragma_detection(tmp_path):
@@ -1454,12 +1966,18 @@ def test_sarif_output_matches_golden(tmp_path, capsys):
         textwrap.dedent(
             """
             import threading
+            from functools import partial
 
             import jax
+            import numpy as np
 
             @jax.jit
             def _score(x):
                 return x
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def _scatter(buf, upd):
+                return buf + upd
 
             def f(lock, q):
                 with lock:
@@ -1468,6 +1986,10 @@ def test_sarif_output_matches_golden(tmp_path, capsys):
             def g(lock, q):
                 with lock:  # pathway: allow(lock-discipline): fixture — reviewed
                     return _score(q)
+
+            def h(buf, upd):
+                out = _scatter(buf, upd)
+                return np.asarray(buf)
             """
         )
     )

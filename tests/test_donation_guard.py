@@ -1,0 +1,197 @@
+"""Runtime donation tripwire (ops/donation_guard.py) — the dynamic
+half of the value-flow analyzer's use-after-donate rule.
+
+The acceptance pairing from ISSUE 15: a planted use-after-donate is
+caught STATICALLY by the value-flow family, and the SAME pattern
+executed under ``PATHWAY_DONATION_GUARD=1`` raises under pytest
+(strict mode) while production mode only logs + counts
+``pathway_donation_violations_total{site}`` and keeps producing
+correct results through the donation-free twin.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe
+from pathway_tpu.ops import donation_guard
+
+
+@pytest.fixture(autouse=True)
+def _armed(monkeypatch):
+    """Arm the guard (strict by default under pytest) with clean
+    counters for every test; tests that want production mode override
+    PATHWAY_DONATION_GUARD_STRICT themselves."""
+    monkeypatch.setenv("PATHWAY_DONATION_GUARD", "1")
+    monkeypatch.delenv("PATHWAY_DONATION_GUARD_STRICT", raising=False)
+    donation_guard._reset_for_tests()
+    yield
+    donation_guard._reset_for_tests()
+
+
+def _kernel():
+    return donation_guard.donating_jit(
+        lambda buf, upd: buf + upd,
+        site="test.scatter",
+        donate_argnums=(0,),
+    )
+
+
+def test_guard_off_is_passthrough(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DONATION_GUARD", "0")
+    fn = _kernel()
+    a = jnp.zeros((4,), jnp.float32)
+    out = fn(a, jnp.ones((4,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    assert donation_guard.stats()["poisoned"] == {}
+    assert donation_guard.check(a) is None
+
+
+def test_poisoned_reference_is_tracked_and_deleted_strict():
+    fn = _kernel()
+    a = jnp.zeros((4,), jnp.float32)
+    out = fn(a, jnp.ones((4,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    # the donated ref is site-attributed…
+    assert donation_guard.check(a) == "test.scatter"
+    assert donation_guard.stats()["poisoned"] == {"test.scatter": 1}
+    # …and strict mode retro-fits TPU semantics: ANY host touch raises
+    with pytest.raises(RuntimeError):
+        np.asarray(a)
+
+
+def test_redispatch_of_donated_ref_raises_strict():
+    fn = _kernel()
+    a = jnp.zeros((4,), jnp.float32)
+    fn(a, jnp.ones((4,), jnp.float32))
+    with pytest.raises(donation_guard.DonationViolation) as exc:
+        fn(a, jnp.ones((4,), jnp.float32))
+    msg = str(exc.value)
+    assert "test.scatter" in msg and "use-after-donate" in msg
+    assert donation_guard.stats()["violations"] == {"test.scatter": 1}
+
+
+def test_production_mode_logs_counts_and_survives(monkeypatch):
+    """PATHWAY_DONATION_GUARD=1 without strict: the guarded call runs a
+    donation-FREE twin, so a detected use-after-donate is a counted log
+    line and the results stay correct — never a crash."""
+    monkeypatch.setenv("PATHWAY_DONATION_GUARD_STRICT", "0")
+    fn = _kernel()
+    a = jnp.zeros((4,), jnp.float32)
+    out1 = fn(a, jnp.ones((4,), jnp.float32))
+    # production poisoning does NOT delete: the buffer stays live
+    assert donation_guard.check(a) == "test.scatter"
+    assert not a.is_deleted()
+    out2 = fn(a, jnp.full((4,), 2.0, jnp.float32))  # use-after-donate
+    np.testing.assert_allclose(np.asarray(out1), 1.0)
+    np.testing.assert_allclose(np.asarray(out2), 2.0)  # still correct
+    assert donation_guard.stats()["violations"] == {"test.scatter": 1}
+
+
+def test_rebind_from_results_is_clean():
+    """The sanctioned commit shape: rebinding the donated names from the
+    call's results leaves nothing poisoned to touch."""
+    fn = _kernel()
+    a = jnp.zeros((4,), jnp.float32)
+    a = fn(a, jnp.ones((4,), jnp.float32))
+    out = fn(a, jnp.ones((4,), jnp.float32))  # fresh ref each round
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert donation_guard.stats()["violations"] == {}
+
+
+def test_wrap_guards_precompiled_callable():
+    raw = jax.jit(lambda buf, upd: buf + upd)
+    fn = donation_guard.wrap("test.wrapped", raw, donate_argnums=(0,))
+    a = jnp.zeros((2,), jnp.float32)
+    fn(a, jnp.ones((2,), jnp.float32))
+    assert donation_guard.check(a) == "test.wrapped"
+    with pytest.raises(donation_guard.DonationViolation):
+        fn(a, jnp.ones((2,), jnp.float32))
+
+
+def test_metric_families_render():
+    fn = _kernel()
+    fn(jnp.zeros((2,), jnp.float32), jnp.ones((2,), jnp.float32))
+    body = "\n".join(observe.render_prometheus())
+    assert 'pathway_donation_poisoned_total{site="test.scatter"} 1' in body
+    # the violations family renders at ZERO — a silent counter must be
+    # distinguishable from a dead one
+    assert 'pathway_donation_violations_total{site="test.scatter"} 0' in body
+
+
+def test_ivf_absorb_poisons_under_guard():
+    """The real ``ivf.absorb_scatter`` site: absorbing the tail under
+    the armed guard poisons the retired slab/bias refs and the index
+    keeps serving correct results (the commit rebinds from the call's
+    outputs, so nothing ever touches the poisoned pair)."""
+    from pathway_tpu.ops.ivf import IvfKnnIndex
+
+    rng = np.random.default_rng(0)
+    n, dim = 512, 16
+    data = rng.normal(size=(n, dim)).astype(np.float32)
+    index = IvfKnnIndex(
+        dimension=dim, metric="cos", n_clusters=4, n_probe=4,
+        absorb_threshold=64, seed=0,
+    )
+    index.add(range(n), data)
+    index.build()
+    # stream adds until at least one absorb commit fires (absorb runs
+    # on the background maintenance thread — poll for its commit)
+    import time
+
+    extra = rng.normal(size=(256, dim)).astype(np.float32)
+    index.add(range(n, n + 256), extra)
+    deadline = time.monotonic() + 20.0
+    while (
+        donation_guard.stats()["poisoned"].get("ivf.absorb_scatter", 0) == 0
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    index.search(data[:4], k=5)
+    assert donation_guard.stats()["poisoned"].get(
+        "ivf.absorb_scatter", 0
+    ) > 0, "absorb commit never hit the guarded scatter"
+    got = index.search(extra[:1], k=1)
+    assert got[0] and got[0][0][0] == n  # the absorbed row is findable
+
+
+def test_planted_pattern_caught_statically_and_dynamically():
+    """THE acceptance pairing: one planted use-after-donate, flagged by
+    the static value-flow family AND raised by the runtime tripwire."""
+    from pathway_tpu.analysis import analyze_source
+
+    planted = textwrap.dedent("""
+        import jax
+        import numpy as np
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _scatter(buf, upd):
+            return buf + upd
+
+        def commit(buf, upd):
+            out = _scatter(buf, upd)
+            return out, np.asarray(buf)  # use-after-donate
+    """)
+    live = [
+        f
+        for f in analyze_source(planted, "fixtures/planted_donate.py")
+        if f.rule == "value-flow" and not f.suppressed
+    ]
+    assert len(live) == 1 and "use-after-donate" in live[0].message
+
+    # the SAME pattern at runtime, through the tripwire
+    fn = donation_guard.wrap(
+        "test.planted",
+        jax.jit(lambda buf, upd: buf + upd, donate_argnums=(0,)),
+        donate_argnums=(0,),
+    )
+    buf = jnp.zeros((4,), jnp.float32)
+    fn(buf, jnp.ones((4,), jnp.float32))
+    with pytest.raises(RuntimeError):  # strict: the touch raises
+        np.asarray(buf)

@@ -230,6 +230,24 @@ def rendered_families():
     if not was_installed:
         sanitizer.uninstall()
 
+    # donation tripwire (ISSUE 15): one guarded donating call with the
+    # guard armed registers the site so BOTH pathway_donation_* families
+    # render (violations stay 0 — the workload is clean)
+    from pathway_tpu.ops import donation_guard
+
+    os.environ["PATHWAY_DONATION_GUARD"] = "1"
+    try:
+        donate_probe = donation_guard.donating_jit(
+            lambda buf, upd: buf + upd,
+            site="inventory.donate",
+            donate_argnums=(0,),
+        )
+        donate_probe(
+            jnp.zeros((2,), jnp.float32), jnp.ones((2,), jnp.float32)
+        )
+    finally:
+        os.environ.pop("PATHWAY_DONATION_GUARD", None)
+
     # profiler drain + SLO evaluation so every derived family is fresh
     assert profile.drain()
     slo.evaluate(max_age_s=0.0)

@@ -732,6 +732,15 @@ _BASELINE_SUPPRESSIONS = sorted(
         ("pathway_tpu/ops/serving.py", "lock-order"),
         ("pathway_tpu/ops/serving.py", "lock-order"),
         ("pathway_tpu/ops/serving.py", "lock-order"),
+        # ISSUE 15 value-flow: deliberate host↔device crossings, each
+        # waived with a reviewed pragma mirrored in
+        # residency.DECLARED_TRANSFERS (gated both directions by
+        # tests/test_analysis.py) — clip's sync encode APIs (2), ivf's
+        # train/build/plan fetches + the reference search's host
+        # completion (13), serving's per-shard d2d embedding scatter (1)
+        *[("pathway_tpu/models/clip.py", "value-flow")] * 2,
+        *[("pathway_tpu/ops/ivf.py", "value-flow")] * 13,
+        ("pathway_tpu/ops/serving.py", "value-flow"),
     ]
 )
 
