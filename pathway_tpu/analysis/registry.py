@@ -58,10 +58,11 @@ __all__ = [
 # _encode_fn/_shard_search_fn/_merge_fn/_table_fn/_scatter_fn are the
 # sharded-serve family (ops/serving.py scatter-dispatch fan-out + tree
 # merge, index/forward.py per-shard tables + max-merge, ops/knn.py
-# sharded scatters); _slot_prefill_fn/_slot_step_fn are the continuous-
-# decode slot pool (models/generator.py compiled join/step chunks driven
-# by serve/decode.py — the slot-pool lock convention: allocating a slot
-# under the pool lock is fine, CALLING one of these under it is a
+# sharded scatters); _slot_prefill_fn/_slot_step_fn/_slot_verify_fn/
+# _slot_draft_fn are the continuous-decode slot pool (models/generator.py
+# compiled join/step chunks plus the speculative draft→verify pair,
+# driven by serve/decode.py — the slot-pool lock convention: allocating
+# a slot under the pool lock is fine, CALLING one of these under it is a
 # lock-discipline finding).  Tuple-returning getters (e.g.
 # _shard_search_fn -> (fn, n_slotspace)) bind only their FIRST unpack
 # target as the callee.
@@ -69,7 +70,7 @@ _CACHE_GETTER_RE = re.compile(
     r"^_(compiled\w*|forward_fn|packed_fn|search_fn"
     r"|token_fn|pool_fn|maxsim_fn|audit_fn"
     r"|encode_fn|shard_search_fn|merge_fn|table_fn|scatter_fn"
-    r"|slot_prefill_fn|slot_step_fn)$"
+    r"|slot_prefill_fn|slot_step_fn|slot_verify_fn|slot_draft_fn)$"
 )
 _LOCK_NAME_RE = re.compile(r"lock|mutex|cv\b|cond", re.IGNORECASE)
 # donation_guard.donating_jit is the guard-aware jit constructor

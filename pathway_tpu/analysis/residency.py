@@ -128,6 +128,12 @@ DECLARED_TRANSFERS: Dict[Tuple[str, str], str] = {
         "slot's tokens (the int() below it reads the HOST copy — a "
         "name-level tracking limit, not a crossing)"
     ),
+    ("serve/decode.py", "ContinuousDecoder._spec_round"): (
+        "the speculative round's 2 deliberate fetches: draft proposals "
+        "(host state seeding the verify's token operand) and the "
+        "accepted-token matrix — the spec-flavor decode-loop sync, "
+        "within the per-round 2-dispatch + 2-fetch budget"
+    ),
     ("xpacks/llm/embedders.py", "SentenceTransformerEmbedder.__init__.embed"): (
         "SentenceTransformer is a host-side model: its .encode matches "
         "the device-producer spelling but returns numpy rows"

@@ -55,7 +55,15 @@ from .transformer import (
     resolve_heads,
 )
 
-__all__ = ["TextGenerator", "decode_step_bucket", "eos_id_from_env"]
+__all__ = [
+    "TextGenerator",
+    "decode_draft_layers",
+    "decode_draft_source",
+    "decode_kv_quant",
+    "decode_spec_k",
+    "decode_step_bucket",
+    "eos_id_from_env",
+]
 
 # flight recorder: submit→ready latency of a full decode (dispatch
 # through host fetch) + batch occupancy per dispatch
@@ -75,6 +83,53 @@ def decode_step_bucket() -> int:
     except ValueError:
         c = 8
     return max(1, c)
+
+
+def decode_spec_k() -> int:
+    """Speculation depth from ``PATHWAY_DECODE_SPEC_K`` (default 0 =
+    speculation OFF): how many positions one verify dispatch scores per
+    active slot — 1 committed token + up to ``k-1`` accepted draft
+    tokens per round.  ``k <= 1`` is the plain one-token-per-step
+    continuous decode; the ceiling keeps the verify forward (an
+    ``Ln = k`` attention) from dwarfing the steps it replaces."""
+    try:
+        k = int(os.environ.get("PATHWAY_DECODE_SPEC_K", "0") or 0)
+    except ValueError:
+        k = 0
+    return max(0, min(k, 16))
+
+
+def decode_kv_quant() -> str:
+    """Slot-pool K/V storage from ``PATHWAY_DECODE_KV_QUANT``: ``bf16``
+    (default, bit-identical to solo decode) or ``int8`` (per-(layer,
+    head, channel) stored scales, 2x slots×context at fixed HBM,
+    bounded token drift — ops/kv_quant.py)."""
+    raw = os.environ.get("PATHWAY_DECODE_KV_QUANT", "bf16").strip().lower()
+    return "int8" if raw == "int8" else "bf16"
+
+
+def decode_draft_source() -> str:
+    """Draft proposal source from ``PATHWAY_DECODE_DRAFT``: ``auto``
+    (default: n-gram mining first, reduced-layer trunk when the n-gram
+    well runs dry), ``ngram`` (mining only — lanes without a match
+    advance one token per round), or ``trunk`` (always the reduced-
+    layer draft dispatch)."""
+    raw = os.environ.get("PATHWAY_DECODE_DRAFT", "auto").strip().lower()
+    return raw if raw in ("auto", "ngram", "trunk") else "auto"
+
+
+def decode_draft_layers(n_layers: int) -> int:
+    """Reduced-layer draft-trunk depth from
+    ``PATHWAY_DECODE_DRAFT_LAYERS`` (default 0 = half the trunk,
+    minimum 1): the draft forwards only the FIRST ``D`` blocks of the
+    same params — cheap proposals, exactness restored by the verify."""
+    try:
+        d = int(os.environ.get("PATHWAY_DECODE_DRAFT_LAYERS", "0") or 0)
+    except ValueError:
+        d = 0
+    if d <= 0:
+        d = max(1, n_layers // 2)
+    return min(d, n_layers)
 
 
 def eos_id_from_env() -> Optional[int]:
@@ -120,6 +175,10 @@ class TextGenerator:
         self.module = TransformerEncoder(self.config)
         self._kv_module = KVTransformerDecoder(self.config)
         self._slot_module = SlotKVDecoder(self.config)
+        # int8 twins (same params; ops/kv_quant.py scales as operands)
+        self._kv_module_q = KVTransformerDecoder(self.config, quant=True)
+        self._slot_module_q = SlotKVDecoder(self.config, quant=True)
+        self._kv_scales = None  # lazy (params exist below)
         # EOS handling: a row that emits this token is FINISHED — further
         # sampling work is masked to PAD and the legacy decode returns as
         # soon as every row has finished (chunked dispatch).  None (the
@@ -370,7 +429,25 @@ class TextGenerator:
         return self.kv_cache.bucket_tokens(P), matches
 
     # -- continuous-decode slot pool (serve/decode.py) -----------------------
-    def _slot_prefill_fn(self, S: int, T: int, B: int, L_sfx: int, P: int):
+    def kv_pool_scales(self):
+        """Per-(layer, head, channel) int8 K/V scales ``[L, H, hd]``
+        for THIS generator's params (ops/kv_quant.py) — computed once,
+        shared by every quantized pool over the instance."""
+        if self._kv_scales is None:
+            from ..ops.kv_quant import kv_pool_scales
+
+            # compute OFF the lock (device math must never run under
+            # it); the assignment races benignly — both winners hold
+            # identical values derived from the same params
+            scales = kv_pool_scales(self.params, self.config)
+            with self._lock:
+                if self._kv_scales is None:
+                    self._kv_scales = scales
+        return self._kv_scales
+
+    def _slot_prefill_fn(
+        self, S: int, T: int, B: int, L_sfx: int, P: int, quant: bool = False
+    ):
         """Compiled JOIN batch for ``B`` slots of a ``[S, L, H, T, d]``
         K/V pool: ``(params, pool_k, pool_v, slots [B], suffix_ids
         [B, L_sfx], n_len [B], prefix_k, prefix_v, rngs [B, 2],
@@ -387,30 +464,49 @@ class TextGenerator:
         masked attention is width-invariant (extra key slots carry
         exact-zero probability), which is what keeps a pooled decode
         bit-identical to a solo one whose buffer is exactly
-        prompt+steps wide."""
-        key = ("slot_prefill", S, T, B, L_sfx, P)
+        prompt+steps wide.
+
+        ``quant=True`` (int8 pool): the fn takes two trailing operands
+        ``k_scales``/``v_scales`` ``[L, H, hd]``, prefills through the
+        quant KV twin — every attention read is dequant(int8), the SAME
+        values a later warm join will read back, which is what keeps
+        warm and cold int8 joins deterministic — and scatters int8
+        rows; the bf16 prefix rows passed in are (re)quantized on
+        insert (idempotent: ops/kv_quant.py)."""
+        key = ("slot_prefill_q" if quant else "slot_prefill", S, T, B, L_sfx, P)
         fn = self._fns.get(key)
         if fn is not None:
             return fn
         self._tripwire.observe(key)
         cfg = self.config
-        decoder = self._kv_module
+        decoder = self._kv_module_q if quant else self._kv_module
         H = cfg.n_heads
         hd = cfg.d_model // H
+        buf_dtype = jnp.int8 if quant else cfg.dtype
 
         def prefill(
             params, pool_k, pool_v, slots, suffix_ids, n_len,
-            prefix_k, prefix_v, rngs, temps,
+            prefix_k, prefix_v, rngs, temps, k_scales=None, v_scales=None,
         ):
+            from ..ops.kv_quant import quantize_kv
+
             emb = params["tok_embed"]["embedding"]
-            kbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
-            vbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), cfg.dtype)
+            kbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), buf_dtype)
+            vbuf = jnp.zeros((B, cfg.n_layers, T, H, hd), buf_dtype)
             if P:
+                pfx_k = (
+                    quantize_kv(prefix_k, k_scales)
+                    if quant else prefix_k.astype(cfg.dtype)
+                )
+                pfx_v = (
+                    quantize_kv(prefix_v, v_scales)
+                    if quant else prefix_v.astype(cfg.dtype)
+                )
                 kbuf = jax.lax.dynamic_update_slice(
-                    kbuf, prefix_k.astype(cfg.dtype), (0, 0, 0, 0, 0)
+                    kbuf, pfx_k, (0, 0, 0, 0, 0)
                 )
                 vbuf = jax.lax.dynamic_update_slice(
-                    vbuf, prefix_v.astype(cfg.dtype), (0, 0, 0, 0, 0)
+                    vbuf, pfx_v, (0, 0, 0, 0, 0)
                 )
             positions = jnp.broadcast_to(
                 (P + jnp.arange(L_sfx, dtype=jnp.int32))[None, :], (B, L_sfx)
@@ -418,7 +514,7 @@ class TextGenerator:
             write_pos = jnp.full((B,), P, jnp.int32)
             hidden, kbuf, vbuf = decoder.apply(
                 {"params": params}, suffix_ids, positions, kbuf, vbuf,
-                write_pos, positions,
+                write_pos, positions, k_scales, v_scales,
             )
             logits = jnp.einsum(
                 "bld,vd->blv", hidden.astype(jnp.float32), emb.astype(jnp.float32)
@@ -455,7 +551,7 @@ class TextGenerator:
         self._fns[key] = fn
         return fn
 
-    def _slot_step_fn(self, S: int, T: int, chunk: int):
+    def _slot_step_fn(self, S: int, T: int, chunk: int, quant: bool = False):
         """Compiled decode-step CHUNK over the whole slot pool:
         ``(params, pool_k, pool_v, tok [S], pos [S], active [S],
         left [S], rngs [S, 2], temps [S], eos [S]) -> (pool_k, pool_v,
@@ -466,15 +562,21 @@ class TextGenerator:
         chain: requests are batch-composition-independent), emits ``-1``
         for inactive lanes, and retires lanes that emit their EOS or
         exhaust their budget.  ONE compile signature per engine — the
-        shapes are (S, T, chunk), all static per pool."""
-        key = ("slot_step", S, T, chunk)
+        shapes are (S, T, chunk), all static per pool.
+
+        ``quant=True``: int8 pool, trailing ``k_scales``/``v_scales``
+        operands, reads dequantized in-kernel (ops/kv_quant.py)."""
+        key = ("slot_step_q" if quant else "slot_step", S, T, chunk)
         fn = self._fns.get(key)
         if fn is not None:
             return fn
         self._tripwire.observe(key)
-        decoder = self._slot_module
+        decoder = self._slot_module_q if quant else self._slot_module
 
-        def run(params, pool_k, pool_v, tok, pos, active, left, rngs, temps, eos):
+        def run(
+            params, pool_k, pool_v, tok, pos, active, left, rngs, temps, eos,
+            k_scales=None, v_scales=None,
+        ):
             emb = params["tok_embed"]["embedding"]
 
             def one(carry, _):
@@ -483,6 +585,7 @@ class TextGenerator:
                 h, pool_k, pool_v = decoder.apply(
                     {"params": params}, tok[:, None], pos[:, None],
                     pool_k, pool_v, pos, pos[:, None], live,
+                    k_scales, v_scales,
                 )
                 logits = jnp.einsum(
                     "bld,vd->blv", h.astype(jnp.float32), emb.astype(jnp.float32)
@@ -529,6 +632,163 @@ class TextGenerator:
             return pool_k, pool_v, rngs, em
 
         fn = profile.wrap("generator.slot_step", jax.jit(run))
+        self._fns[key] = fn
+        return fn
+
+    def _slot_verify_fn(self, S: int, T: int, k: int, quant: bool = False):
+        """Compiled speculative VERIFY over the whole slot pool — the
+        single batched dispatch that scores all ``k`` draft positions at
+        once: ``(params, pool_k, pool_v, toks [S, k], pos [S],
+        active [S], left [S], rngs [S, 2], temps [S], eos [S]) ->
+        (pool_k, pool_v, rngs, emitted [k, S])``.
+
+        ``toks[:, 0]`` is each lane's last emitted token (what a plain
+        step would forward) and ``toks[:, 1:]`` its k-1 draft proposals.
+        One ``SlotKVDecoder`` forward with ``Ln = k`` writes K/V for all
+        k positions and yields logits at each; an in-kernel scan then
+        walks the positions replaying EXACTLY the plain-step sampling
+        (same per-lane rng chain, one split per EMITTED token, the
+        pool-level all-greedy gate) and accepts while the sampled token
+        agrees with the next forwarded input.  On the first disagreement
+        the lane's own sampled token is still emitted (it was drawn from
+        the true distribution at a position whose K/V is valid — the
+        prefix up to it matched), and later positions emit ``-1``.
+        Greedy and temperature>0 are both EXACT: acceptance only keeps
+        tokens the plain chain would have drawn with the same splits, so
+        spec-on == spec-off == solo bit-for-bit.  Rejected positions'
+        K/V rows are garbage but UNREACHABLE: the pool is
+        write-before-read (next dispatch re-writes position ``pos``
+        before anything attends it) and masked attention zeroes keys
+        past each row's ``q_pos``.
+
+        ``quant=True``: int8 pool + trailing scales operands, same as
+        the step fn."""
+        key = ("slot_verify_q" if quant else "slot_verify", S, T, k)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+        decoder = self._slot_module_q if quant else self._slot_module
+
+        def run(
+            params, pool_k, pool_v, toks, pos, active, left, rngs, temps, eos,
+            k_scales=None, v_scales=None,
+        ):
+            emb = params["tok_embed"]["embedding"]
+            live0 = active & (left > 0)
+            positions = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+            # ONE forward for all k positions pool-wide; write_pos=pos
+            # so the k rows land at [pos, pos+k) (inactive lanes'
+            # writes are masked off by ``live0`` as in the plain step)
+            h, pool_k, pool_v = decoder.apply(
+                {"params": params}, toks, positions,
+                pool_k, pool_v, pos, positions, live0,
+                k_scales, v_scales,
+            )
+            logits = jnp.einsum(
+                "bld,vd->blv", h.astype(jnp.float32), emb.astype(jnp.float32)
+            )  # [S, k, V]
+            # follow[:, i] = the token forwarded at position i+1 — what
+            # the sampled token at i must equal for acceptance to
+            # continue; -1 (never a vocab id) past the last draft
+            follow = jnp.concatenate(
+                [toks[:, 1:], jnp.full((S, 1), -1, jnp.int32)], axis=1
+            )
+
+            def one(carry, xs):
+                acc, pos_c, left_c, rngs = carry
+                lg, fol = xs
+                live = acc & (left_c > 0)
+                greedy = jnp.argmax(lg, axis=-1)
+
+                def sample(rngs):
+                    pairs = jax.vmap(jax.random.split)(rngs)
+                    drawn = jax.vmap(jax.random.categorical)(
+                        pairs[:, 1], lg / jnp.maximum(temps, 1e-4)[:, None]
+                    )
+                    return pairs[:, 0], jnp.where(temps <= 0.0, greedy, drawn)
+
+                def greedy_only(rngs):
+                    return rngs, greedy
+
+                rngs2, nxt = jax.lax.cond(
+                    jnp.all(temps <= 0.0), greedy_only, sample, rngs
+                )
+                nxt = nxt.astype(jnp.int32)
+                emitted = jnp.where(live, nxt, -1)
+                # keep accepting only while the draw agrees with the
+                # next forwarded draft AND the lane didn't just finish
+                acc2 = live & (nxt != eos) & (nxt == fol)
+                pos2 = jnp.where(live, pos_c + 1, pos_c)
+                left2 = jnp.where(live, left_c - 1, left_c)
+                # one split per EMITTED token — the solo chain position
+                rngs3 = jnp.where(live[:, None], rngs2, rngs)
+                return (acc2, pos2, left2, rngs3), emitted
+
+            xs = (jnp.swapaxes(logits, 0, 1), follow.T)
+            (_, _, _, rngs), em = jax.lax.scan(
+                one, (live0, pos, left, rngs), xs
+            )
+            return pool_k, pool_v, rngs, em
+
+        fn = profile.wrap("generator.slot_verify", jax.jit(run))
+        self._fns[key] = fn
+        return fn
+
+    def _slot_draft_fn(
+        self, S: int, T: int, k_draft: int, D: int, quant: bool = False
+    ):
+        """Compiled reduced-layer TRUNK draft — the fallback proposer
+        when a lane's n-gram well runs dry: ``(params, pool_k, pool_v,
+        tok [S], pos [S], active [S]) -> drafts [S, k_draft]``.  Runs
+        only the first ``D`` trunk blocks (plus ``final_ln``) over the
+        SAME params — no second model — greedily rolling ``k_draft``
+        tokens forward on a sliced ``[S, D, T, H, hd]`` view of the
+        pool.  The slice is a functional copy: the real pool is NEVER
+        written (drafts are proposals; the verify dispatch is what
+        commits K/V), so a wrong draft can't poison anything.  Greedy
+        on purpose — drafts only seed verification, and the verify
+        scan's exact sampling decides acceptance, so draft quality
+        affects speed, never tokens."""
+        key = ("slot_draft_q" if quant else "slot_draft", S, T, k_draft, D)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        self._tripwire.observe(key)
+        cfg = self.config
+        decoder = SlotKVDecoder(cfg, quant=quant, layers=D)
+
+        def run(
+            params, pool_k, pool_v, tok, pos, active,
+            k_scales=None, v_scales=None,
+        ):
+            emb = params["tok_embed"]["embedding"]
+            pk = pool_k[:, :D]
+            pv = pool_v[:, :D]
+            ks = None if k_scales is None else k_scales[:D]
+            vs = None if v_scales is None else v_scales[:D]
+
+            def one(carry, _):
+                pk, pv, tok, pos_c = carry
+                h, pk, pv = decoder.apply(
+                    {"params": params}, tok[:, None], pos_c[:, None],
+                    pk, pv, pos_c, pos_c[:, None], active,
+                    ks, vs,
+                )
+                logits = jnp.einsum(
+                    "bld,vd->blv", h.astype(jnp.float32),
+                    emb.astype(jnp.float32),
+                )[:, 0, :]
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                pos2 = jnp.where(active, pos_c + 1, pos_c)
+                return (pk, pv, nxt, pos2), nxt
+
+            (_, _, _, _), toks = jax.lax.scan(
+                one, (pk, pv, tok, pos), None, length=k_draft
+            )
+            return jnp.swapaxes(toks, 0, 1)
+
+        fn = profile.wrap("generator.slot_draft", jax.jit(run))
         self._fns[key] = fn
         return fn
 

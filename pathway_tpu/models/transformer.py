@@ -191,12 +191,21 @@ class KVSelfAttention(nn.Module):
     under causal attention a position's K/V depends only on tokens at or
     before it, so for real query positions the score rows here are
     bit-identical to the full re-attend — the parity test in
-    tests/test_serve_cache.py holds token-for-token."""
+    tests/test_serve_cache.py holds token-for-token.
+
+    ``quant=True`` (ops/kv_quant.py): the cache buffers are int8 with
+    per-(head, channel) stored scales — new K/V quantize at the write
+    and EVERY read dequantizes inside this kernel, so prefill and
+    decode attend identical values and warm joins stay deterministic."""
 
     config: TransformerConfig
+    quant: bool = False
 
     @nn.compact
-    def __call__(self, x, k_cache, v_cache, write_pos, q_pos):
+    def __call__(
+        self, x, k_cache, v_cache, write_pos, q_pos,
+        k_scales=None, v_scales=None,
+    ):
         cfg = self.config
         B, Ln, D = x.shape
         T = k_cache.shape[1]
@@ -218,6 +227,11 @@ class KVSelfAttention(nn.Module):
         q = q.reshape(B, Ln, cfg.n_heads, head_dim)
         k_new = k_new.reshape(B, Ln, cfg.n_heads, head_dim)
         v_new = v_new.reshape(B, Ln, cfg.n_heads, head_dim)
+        if self.quant:
+            from ..ops.kv_quant import dequantize_kv, quantize_kv
+
+            k_new = quantize_kv(k_new, k_scales)
+            v_new = quantize_kv(v_new, v_scales)
         # insert the new tokens' K/V at each row's write position (rows
         # decode at different offsets: prompts have different lengths)
         insert = jax.vmap(
@@ -227,7 +241,12 @@ class KVSelfAttention(nn.Module):
         )
         k_cache = insert(k_cache, k_new, write_pos)
         v_cache = insert(v_cache, v_new, write_pos)
-        scores = jnp.einsum("blhd,bmhd->bhlm", q, k_cache) / np.sqrt(head_dim)
+        if self.quant:
+            k_att = dequantize_kv(k_cache, k_scales, cfg.dtype)
+            v_att = dequantize_kv(v_cache, v_scales, cfg.dtype)
+        else:
+            k_att, v_att = k_cache, v_cache
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k_att) / np.sqrt(head_dim)
         big_neg = jnp.finfo(jnp.float32).min
         # query at global position q_pos[b, l] attends key slot t iff
         # t <= q_pos — slots past the write frontier are either unwritten
@@ -237,7 +256,7 @@ class KVSelfAttention(nn.Module):
         attn_mask = key_pos[None, None, :] <= q_pos[:, :, None]
         scores = jnp.where(attn_mask[:, None, :, :], scores, big_neg)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhlm,bmhd->blhd", probs, v_cache).reshape(
+        out = jnp.einsum("bhlm,bmhd->blhd", probs, v_att).reshape(
             B, Ln, cfg.d_model
         )
         return proj("out", ("heads", "embed"))(out), k_cache, v_cache
@@ -248,14 +267,18 @@ class KVEncoderBlock(nn.Module):
     submodule names pin the param tree to the trunk's layout."""
 
     config: TransformerConfig
+    quant: bool = False
 
     @nn.compact
-    def __call__(self, x, k_cache, v_cache, write_pos, q_pos):
+    def __call__(
+        self, x, k_cache, v_cache, write_pos, q_pos,
+        k_scales=None, v_scales=None,
+    ):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_0")(x)
         attn, k_cache, v_cache = KVSelfAttention(
-            cfg, name="SelfAttention_0"
-        )(h, k_cache, v_cache, write_pos, q_pos)
+            cfg, name="SelfAttention_0", quant=self.quant
+        )(h, k_cache, v_cache, write_pos, q_pos, k_scales, v_scales)
         x = x + attn
         h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_1")(x)
         x = x + MlpBlock(cfg, name="MlpBlock_0")(h)
@@ -277,12 +300,20 @@ class KVTransformerDecoder(nn.Module):
     This is what turns the generator's O(steps × L²) re-attend decode
     into O(steps × L) — and, with the prefix cache
     (pathway_tpu/cache/prefix.py), lets prompts sharing a prefix skip
-    its prefill entirely."""
+    its prefill entirely.
+
+    ``quant=True``: the per-layer buffers are int8 and ``k_scales``/
+    ``v_scales`` ``[n_layers, H, hd]`` must be passed — each layer's
+    attention quantizes its writes and dequantizes its reads."""
 
     config: TransformerConfig
+    quant: bool = False
 
     @nn.compact
-    def __call__(self, ids_new, positions, k_caches, v_caches, write_pos, q_pos):
+    def __call__(
+        self, ids_new, positions, k_caches, v_caches, write_pos, q_pos,
+        k_scales=None, v_scales=None,
+    ):
         cfg = self.config
         tok = nn.Embed(
             cfg.vocab_size,
@@ -306,8 +337,12 @@ class KVTransformerDecoder(nn.Module):
         new_k = []
         new_v = []
         for i in range(cfg.n_layers):
-            x, ki, vi = KVEncoderBlock(cfg, name=f"block_{i}")(
-                x, k_caches[:, i], v_caches[:, i], write_pos, q_pos
+            x, ki, vi = KVEncoderBlock(
+                cfg, name=f"block_{i}", quant=self.quant
+            )(
+                x, k_caches[:, i], v_caches[:, i], write_pos, q_pos,
+                None if k_scales is None else k_scales[i],
+                None if v_scales is None else v_scales[i],
             )
             new_k.append(ki)
             new_v.append(vi)
@@ -326,12 +361,20 @@ class SlotSelfAttention(nn.Module):
     in-place-friendly for XLA's loop optimizer.  For active lanes the
     inserted values (and therefore scores, probs, outputs) are
     line-for-line ``KVSelfAttention``'s — the twin relation the
-    token-identity tests pin down."""
+    token-identity tests pin down.
+
+    ``quant=True``: int8 pool with per-(head, channel) stored scales —
+    same write-masking over int8 values, reads dequantized in-kernel
+    (ops/kv_quant.py)."""
 
     config: TransformerConfig
+    quant: bool = False
 
     @nn.compact
-    def __call__(self, x, k_cache, v_cache, write_pos, q_pos, active):
+    def __call__(
+        self, x, k_cache, v_cache, write_pos, q_pos, active,
+        k_scales=None, v_scales=None,
+    ):
         cfg = self.config
         B, Ln, D = x.shape
         T = k_cache.shape[1]
@@ -353,6 +396,11 @@ class SlotSelfAttention(nn.Module):
         q = q.reshape(B, Ln, cfg.n_heads, head_dim)
         k_new = k_new.reshape(B, Ln, cfg.n_heads, head_dim)
         v_new = v_new.reshape(B, Ln, cfg.n_heads, head_dim)
+        if self.quant:
+            from ..ops.kv_quant import dequantize_kv, quantize_kv
+
+            k_new = quantize_kv(k_new, k_scales)
+            v_new = quantize_kv(v_new, v_scales)
         # masked write: inactive lanes re-insert what the buffer already
         # holds at their write position — their K/V is bit-frozen
         read = jax.vmap(
@@ -370,13 +418,18 @@ class SlotSelfAttention(nn.Module):
         )
         k_cache = insert(k_cache, k_ins, write_pos)
         v_cache = insert(v_cache, v_ins, write_pos)
-        scores = jnp.einsum("blhd,bmhd->bhlm", q, k_cache) / np.sqrt(head_dim)
+        if self.quant:
+            k_att = dequantize_kv(k_cache, k_scales, cfg.dtype)
+            v_att = dequantize_kv(v_cache, v_scales, cfg.dtype)
+        else:
+            k_att, v_att = k_cache, v_cache
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k_att) / np.sqrt(head_dim)
         big_neg = jnp.finfo(jnp.float32).min
         key_pos = jnp.arange(T, dtype=jnp.int32)
         attn_mask = key_pos[None, None, :] <= q_pos[:, :, None]
         scores = jnp.where(attn_mask[:, None, :, :], scores, big_neg)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhlm,bmhd->blhd", probs, v_cache).reshape(
+        out = jnp.einsum("bhlm,bmhd->blhd", probs, v_att).reshape(
             B, Ln, cfg.d_model
         )
         return proj("out", ("heads", "embed"))(out), k_cache, v_cache
@@ -387,14 +440,18 @@ class SlotEncoderBlock(nn.Module):
     pin the param tree to the trunk's layout."""
 
     config: TransformerConfig
+    quant: bool = False
 
     @nn.compact
-    def __call__(self, x, k_cache, v_cache, write_pos, q_pos, active):
+    def __call__(
+        self, x, k_cache, v_cache, write_pos, q_pos, active,
+        k_scales=None, v_scales=None,
+    ):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_0")(x)
         attn, k_cache, v_cache = SlotSelfAttention(
-            cfg, name="SelfAttention_0"
-        )(h, k_cache, v_cache, write_pos, q_pos, active)
+            cfg, name="SelfAttention_0", quant=self.quant
+        )(h, k_cache, v_cache, write_pos, q_pos, active, k_scales, v_scales)
         x = x + attn
         h = nn.LayerNorm(dtype=cfg.dtype, name="LayerNorm_1")(x)
         x = x + MlpBlock(cfg, name="MlpBlock_0")(h)
@@ -422,13 +479,21 @@ class SlotKVDecoder(nn.Module):
       past a row's ``q_pos`` to exact-zero probability, and a joining
       request's prefill (re)writes every position it will ever attend —
       so a reused slot can never see its previous occupant.
-    """
+
+    ``quant=True``: int8 pool + ``[n_layers, H, hd]`` stored scales
+    (ops/kv_quant.py).  ``layers=D`` runs only the FIRST ``D`` trunk
+    blocks (plus ``final_ln``) over the same param tree — the reduced-
+    layer DRAFT trunk of the speculative decode path: its pool slice is
+    ``[S, D, T, H, hd]`` and its proposals need no second model."""
 
     config: TransformerConfig
+    quant: bool = False
+    layers: Optional[int] = None
 
     @nn.compact
     def __call__(
-        self, ids_new, positions, k_pool, v_pool, write_pos, q_pos, active
+        self, ids_new, positions, k_pool, v_pool, write_pos, q_pos, active,
+        k_scales=None, v_scales=None,
     ):
         cfg = self.config
         tok = nn.Embed(
@@ -452,9 +517,14 @@ class SlotKVDecoder(nn.Module):
         x = tok + pos
         new_k = []
         new_v = []
-        for i in range(cfg.n_layers):
-            x, ki, vi = SlotEncoderBlock(cfg, name=f"block_{i}")(
-                x, k_pool[:, i], v_pool[:, i], write_pos, q_pos, active
+        n_layers = cfg.n_layers if self.layers is None else self.layers
+        for i in range(n_layers):
+            x, ki, vi = SlotEncoderBlock(
+                cfg, name=f"block_{i}", quant=self.quant
+            )(
+                x, k_pool[:, i], v_pool[:, i], write_pos, q_pos, active,
+                None if k_scales is None else k_scales[i],
+                None if v_scales is None else v_scales[i],
             )
             new_k.append(ki)
             new_v.append(vi)

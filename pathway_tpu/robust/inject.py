@@ -21,7 +21,14 @@ that request to an empty flagged result the QA ladder's
 every in-flight request with its tokens emitted so far, flagged, and a
 slot-free fault QUARANTINES the slot; the step loop never stalls and no
 other slot's K/V is touched — ``slot_free`` even fires under an
-already-spent deadline so an armed hang releases immediately), and
+already-spent deadline so an armed hang releases immediately), the
+speculative-decode pair ``generator.draft`` / ``generator.verify``
+(serve/decode.py — a faulted draft or verify round falls back to the
+plain non-speculative step chunk, TOKEN-IDENTICAL, counted on
+``pathway_serve_degraded_total{reason="speculation_disabled"}``, with
+a cooldown so a persistent fault never pays the retry ladder per
+chunk; pure-ngram rounds fire ``generator.draft`` too, so a fault
+disables all speculation uniformly), and
 the tracing pair ``trace.record`` / ``trace.export``
 (pathway_tpu/observe/trace.py — ANY armed fault in the tracing path,
 raise/delay/hang alike, degrades to dropped spans counted on

@@ -42,6 +42,31 @@ regardless of join order, batch-mates, or which slot it lands in:
   (re)writes every position the request will ever attend, and inactive
   lanes' buffers are bit-frozen by ``SlotKVDecoder``'s select.
 
+**Speculative decode** (``PATHWAY_DECODE_SPEC_K`` ≥ 2): instead of one
+token per pool step, each round drafts ``k-1`` proposal tokens per
+active slot — mined host-side from the slot's OWN context (prompt +
+emitted tokens: RAG prompts quote their retrieved passages, so the
+generation frequently re-walks n-grams the prompt already contains),
+falling back to a reduced-layer trunk dispatch over the same params
+(``TextGenerator._slot_draft_fn``) — then ONE batched verify dispatch
+(``_slot_verify_fn``) scores all ``k`` positions pool-wide and accepts
+each lane's longest agreeing prefix.  The verify replays EXACTLY the
+plain step's sampling (same per-lane rng chain, one split per emitted
+token), so acceptance only keeps tokens the plain path would have
+drawn: spec-on, spec-off and solo ``generate()`` stay bit-identical at
+any temperature, and a faulted draft/verify path degrades to the plain
+step chunk — token-identical, counted on
+``pathway_serve_degraded_total{reason="speculation_disabled"}``.
+Per-round cost stays inside the 2+2 dispatch budget: at most two
+dispatches (draft + verify) and two host fetches (draft tokens +
+emitted tokens).
+
+**int8 KV pool** (``PATHWAY_DECODE_KV_QUANT=int8``): the slot pool is
+stored int8 with per-(layer, head, channel) scales (ops/kv_quant.py),
+dequantized inside the fused attention reads — slots×context per HBM
+byte doubles, witnessed by the HBM ledger's ``kv_pool`` component and
+the ``decode_slots`` exhaustion ETA.
+
 Admission reuses the coalescing machinery from ``scheduler.py``
 (``_CoalescerBase``): queue + tickets + deadline-preemption (a request
 too tight for any queueing serves SOLO through the legacy path on its
@@ -101,6 +126,13 @@ _H_STEP = observe.histogram("pathway_generator_phase_seconds", phase="step")
 # time-to-last-token per request, admission → completion at the waiter —
 # the series the SLO engine's decode_ttlt objective reads
 _H_TTLT = observe.histogram("pathway_generator_ttlt_seconds")
+# accepted tokens per speculative round, PER LANE — token-valued on the
+# seconds axis (observe_s(count): 1 token → the (0.5,1]s bucket, 2 →
+# (1,2], 3-4 → (2,4], ...), so the power-of-two buckets resolve small
+# counts exactly and _sum/_count recover the true mean acceptance
+_H_DRAFT_ACCEPT = observe.histogram(
+    "pathway_generator_draft_accepted_tokens"
+)
 
 
 class DecodeResult(str):
@@ -137,7 +169,7 @@ class _SlotState:
 
     __slots__ = (
         "req", "budget", "temperature", "seed", "eos", "tokens", "pos",
-        "left", "t_join_ns",
+        "left", "t_join_ns", "prompt_ids",
     )
 
     def __init__(self, req, budget: int, temperature: float, seed: int, eos: int):
@@ -150,6 +182,8 @@ class _SlotState:
         self.pos = 0     # next K/V write position (= current length)
         self.left = 0    # decode-step tokens still allowed
         self.t_join_ns = time.perf_counter_ns()
+        # prompt token ids (host copy) — the n-gram draft mining corpus
+        self.prompt_ids: List[int] = []
 
 
 def _spent_deadline() -> Deadline:
@@ -186,16 +220,54 @@ class ContinuousDecoder(_CoalescerBase):
         autostart: bool = True,
         eos_id: Any = "inherit",
         kv_width: Optional[int] = None,
+        spec_k: Optional[int] = None,
+        draft: Optional[str] = None,
+        kv_quant: Optional[str] = None,
     ):
         import jax.numpy as jnp
 
-        from ..models.generator import decode_step_bucket
+        from ..models.generator import (
+            decode_draft_layers,
+            decode_draft_source,
+            decode_kv_quant,
+            decode_spec_k,
+            decode_step_bucket,
+        )
 
         self.generator = generator
         cfg = generator.config
         self.slots = max(1, int(slots or decode_slots()))
         self.chunk = max(1, int(step_bucket or decode_step_bucket()))
         self.eos_id = generator.eos_id if eos_id == "inherit" else eos_id
+        # speculative decode + KV-quant knobs — constructor args win,
+        # env (PATHWAY_DECODE_SPEC_K / _DRAFT / _KV_QUANT) is the default
+        self.spec_k = (
+            decode_spec_k() if spec_k is None
+            else max(0, min(int(spec_k), 16))
+        )
+        self.draft_source = (
+            decode_draft_source() if draft is None
+            else (draft if draft in ("auto", "ngram", "trunk") else "auto")
+        )
+        self.kv_quant = (
+            decode_kv_quant() if kv_quant is None
+            else ("int8" if kv_quant == "int8" else "bf16")
+        )
+        self._quant = self.kv_quant == "int8"
+        self._draft_layers = decode_draft_layers(cfg.n_layers)
+        # cooldown: after a draft/verify fault degrades a round to the
+        # plain step, skip speculation for this many rounds so a
+        # persistent fault doesn't pay the retry ladder on every chunk
+        self._spec_hold = 0
+        self._draft_sources = {"ngram": 0, "trunk": 0, "none": 0}
+        # cross-request suffix corpus (the "prefix-cache blocks" half of
+        # the n-gram well): every cleanly finished request feeds its full
+        # token stream (prompt + emitted) into an n-gram → continuation
+        # index, so a repeated or near-duplicate request drafts its whole
+        # continuation from the previous run's output.  Greedy repeats
+        # verify-accept wholesale; per-request sampling seeds reject
+        # safely.  Engine-loop-thread only — no lock.
+        self._suffix_idx: Dict[Tuple[int, ...], List[int]] = {}
         # pool buffer width: defaults to the position-embedding bound —
         # any prompt + budget the generator accepts fits (prompts are
         # tokenized to max_len - max_new_tokens), and masked attention
@@ -214,8 +286,17 @@ class ContinuousDecoder(_CoalescerBase):
         self._T = min(cfg.max_len, kv_width) if kv_width else cfg.max_len
         H = cfg.n_heads
         hd = cfg.d_model // H
+        if self._quant:
+            # int8 pool + per-(layer, head, channel) stored scales — the
+            # scales are derived from the generator's params off the
+            # engine locks (generator.kv_pool_scales memoizes them)
+            self._kscale, self._vscale = generator.kv_pool_scales()
+            pool_dtype = jnp.int8
+        else:
+            self._kscale = self._vscale = None
+            pool_dtype = cfg.dtype
         self._pk = jnp.zeros(
-            (self.slots, cfg.n_layers, self._T, H, hd), cfg.dtype
+            (self.slots, cfg.n_layers, self._T, H, hd), pool_dtype
         )
         self._pv = jnp.zeros_like(self._pk)
         self._rngs = jnp.zeros((self.slots, 2), jnp.uint32)
@@ -233,6 +314,10 @@ class ContinuousDecoder(_CoalescerBase):
             "chunks": 0,           # step-chunk dispatches
             "steps": 0,            # decode steps executed (chunks × chunk)
             "occupancy_sum": 0,    # Σ active slots per chunk (avg = /chunks)
+            "spec_rounds": 0,      # speculative draft→verify rounds
+            "spec_fallbacks": 0,   # rounds degraded to the plain step
+            "draft_offered": 0,    # draft tokens proposed (Σ lanes × k-1)
+            "draft_accepted": 0,   # draft tokens accepted by the verify
         }
         super().__init__(
             name=name or f"decode-{observe.next_id()}",
@@ -243,7 +328,7 @@ class ContinuousDecoder(_CoalescerBase):
         # HBM ledger (observe/hbm.py): the slot KV pool is the
         # generator-side HBM owner; slot exhaustion-ETA derives from the
         # observed join rate vs frees at sample time
-        hbm.track("decode", self, lambda d: {"kv_pool": d.hbm_bytes()})
+        hbm.track("decode", self, lambda d: d.hbm_components())
         hbm.track_resource(
             "decode_slots",
             self,
@@ -258,6 +343,19 @@ class ContinuousDecoder(_CoalescerBase):
             int(getattr(buf, "nbytes", 0))
             for buf in (self._pk, self._pv, self._rngs)
         )
+
+    def hbm_components(self) -> Dict[str, int]:
+        """HBM-ledger components: the pool itself plus, under int8, the
+        stored dequant scales — so the ledger shows the quantized pool's
+        true footprint (int8 pool bytes + the tiny f32 scale arrays)
+        next to the bf16 baseline's."""
+        comp = {"kv_pool": self.hbm_bytes()}
+        if self._quant:
+            comp["kv_scales"] = sum(
+                int(getattr(s, "nbytes", 0))
+                for s in (self._kscale, self._vscale)
+            )
+        return comp
 
     # -- public surface ------------------------------------------------------
     def submit(
@@ -311,7 +409,10 @@ class ContinuousDecoder(_CoalescerBase):
                 if reqs:
                     self._join_group(reqs)
                 if self._active:
-                    self._step_chunk()
+                    if self._spec_ready():
+                        self._spec_round()
+                    else:
+                        self._step_chunk()
             except Exception as exc:  # pragma: no cover - defensive
                 # the loop must outlive any one bad iteration: resolve
                 # every in-flight request with what it has, and any
@@ -511,7 +612,10 @@ class ContinuousDecoder(_CoalescerBase):
                 prefix_k = jnp.zeros((B, cfg.n_layers, 0, H, hd), cfg.dtype)
                 prefix_v = jnp.zeros((B, cfg.n_layers, 0, H, hd), cfg.dtype)
             with gen._lock:
-                fn = gen._slot_prefill_fn(self.slots, self._T, B, L_sfx, P)
+                fn = gen._slot_prefill_fn(
+                    self.slots, self._T, B, L_sfx, P, self._quant
+                )
+            sc = (self._kscale, self._vscale) if self._quant else ()
             deadline = self._batch_deadline([rec["req"] for rec in grp])
             t0 = time.perf_counter_ns()
             # pathway: allow(recompile-hazard): prefill shapes are bucketed upstream — the tokenizer pads suffix length to /16 multiples, the prefix split is a power-of-two block multiple (PrefixKVCache.bucket_tokens) and the join batch is a power-of-two bucket; the census test bounds the signature set
@@ -528,6 +632,7 @@ class ContinuousDecoder(_CoalescerBase):
                 prefix_v,
                 jnp.asarray(np.stack(rng_rows)),
                 jnp.asarray(temps),
+                *sc,
                 deadline=deadline,
             )
             firsts = np.asarray(toks)  # pathway: allow(value-flow): the prefill JOIN's one deliberate host fetch — first tokens must reach the riders' tickets before the step loop takes over
@@ -568,14 +673,31 @@ class ContinuousDecoder(_CoalescerBase):
             if gen.kv_cache is not None:
                 blk = gen.kv_cache.block
                 matched, _blocks, chain = rec["match"]
-                gen.kv_cache.admit(
-                    chain,
-                    matched // blk,
-                    lambda jb, _s=slot: (
-                        pk_now[_s, :, jb * blk : (jb + 1) * blk],
-                        pv_now[_s, :, jb * blk : (jb + 1) * blk],
-                    ),
-                )
+                if self._quant:
+                    # int8 pool: captured blocks dequantize back to the
+                    # cache's bf16 convention; a warm join re-quantizes
+                    # them — idempotent (ops/kv_quant.py), so warm pool
+                    # bytes match cold ones bit-for-bit
+                    from ..ops.kv_quant import dequantize_kv
+
+                    def capture(jb, _s=slot):
+                        return (
+                            dequantize_kv(
+                                pk_now[_s, :, jb * blk : (jb + 1) * blk],
+                                self._kscale, cfg.dtype,
+                            ),
+                            dequantize_kv(
+                                pv_now[_s, :, jb * blk : (jb + 1) * blk],
+                                self._vscale, cfg.dtype,
+                            ),
+                        )
+                else:
+                    def capture(jb, _s=slot):
+                        return (
+                            pk_now[_s, :, jb * blk : (jb + 1) * blk],
+                            pv_now[_s, :, jb * blk : (jb + 1) * blk],
+                        )
+                gen.kv_cache.admit(chain, matched // blk, capture)
                 gen.kv_cache.note_prefill(reused=P, computed=rec["n"] - P)
             self.pool_stats["tokens_prefill"] += rec["n"] - P
             self.pool_stats["tokens_decode"] += 1
@@ -591,6 +713,8 @@ class ContinuousDecoder(_CoalescerBase):
             state.tokens = [first]
             state.pos = rec["n"]
             state.left = rec["steps"] - 1
+            # host copy of the prompt ids: the draft miner's corpus
+            state.prompt_ids = [int(t) for t in rec["ids"][0, : rec["n"]]]
             self._active[slot] = state
             if (rec["eos"] >= 0 and first == rec["eos"]) or state.left <= 0:
                 self._leave(slot, state)
@@ -615,7 +739,8 @@ class ContinuousDecoder(_CoalescerBase):
             temps[s] = st.temperature
             eos[s] = st.eos
         with gen._lock:
-            fn = gen._slot_step_fn(S, self._T, self.chunk)
+            fn = gen._slot_step_fn(S, self._T, self.chunk, self._quant)
+        sc = (self._kscale, self._vscale) if self._quant else ()
         deadline = self._batch_deadline(
             [st.req for st in self._active.values()]
         )
@@ -640,7 +765,7 @@ class ContinuousDecoder(_CoalescerBase):
             args = (
                 gen.params, self._pk, self._pv, jnp.asarray(tok),
                 jnp.asarray(pos), jnp.asarray(act), jnp.asarray(left),
-                self._rngs, jnp.asarray(temps), jnp.asarray(eos),
+                self._rngs, jnp.asarray(temps), jnp.asarray(eos), *sc,
             )
             if bctx is not None:
                 with trace.use(bctx):
@@ -705,6 +830,255 @@ class ContinuousDecoder(_CoalescerBase):
         for s, st, flags in leaves:
             self._leave(s, st, flags=flags)
 
+    # -- speculative decode: draft → verify → accept -------------------------
+    def _spec_ready(self) -> bool:
+        """Should this iteration run a speculative round?  Requires
+        ``spec_k >= 2`` (one committed token + at least one draft), no
+        active fault cooldown, and room for all ``k`` K/V writes in
+        every active lane (``dynamic_update_slice`` CLAMPS out-of-bounds
+        starts, so a lane with pos+k > T would silently clobber valid
+        rows — near the width frontier the engine takes plain steps)."""
+        k = self.spec_k
+        if k < 2:
+            return False
+        if self._spec_hold > 0:
+            self._spec_hold -= 1
+            return False
+        return all(
+            st.pos + k <= self._T for st in self._active.values()
+        )
+
+    @staticmethod
+    def _mine_ngram(hist: List[int], want: int) -> List[int]:
+        """Prompt-lookup draft mining: find the RIGHTMOST earlier
+        occurrence of the history's trailing n-gram (n = 3, then 2,
+        then 1) and propose the tokens that followed it.  RAG prompts
+        quote their evidence, so generations re-walk prompt n-grams
+        constantly — free drafts, no dispatch.  Host-side over a few
+        hundred ints; returns [] when the well is dry."""
+        L = len(hist)
+        for n in (3, 2, 1):
+            if L < n + 1:
+                continue
+            pat = hist[-n:]
+            for j in range(L - n - 1, -1, -1):
+                if hist[j : j + n] == pat:
+                    cont = hist[j + n : j + n + want]
+                    if cont:
+                        return cont
+        return []
+
+    def _remember(self, st: _SlotState) -> None:
+        """Feed a finished request's token stream into the suffix
+        index.  Every n-gram (n = 1..6) of the stream maps
+        to the (up to 16) tokens that followed it; the most recent
+        writer wins, so the index tracks live traffic.  O(len) dict
+        writes per finished request, bounded by a clear-on-overflow."""
+        seq = st.prompt_ids + st.tokens
+        if len(seq) < 2:
+            return
+        idx = self._suffix_idx
+        if len(idx) > 100_000:
+            idx.clear()  # bounded memory: rebuilt by ongoing traffic
+        # WITHIN a sequence the FIRST occurrence wins (a later
+        # overlapping occurrence inside a repeated-token run would
+        # otherwise skip the rest of the run); ACROSS sequences the
+        # most recent request wins, tracking live traffic
+        fresh: Dict[Tuple[int, ...], List[int]] = {}
+        for n in range(1, 7):
+            for i in range(len(seq) - n):
+                fresh.setdefault(
+                    tuple(seq[i : i + n]), seq[i + n : i + n + 16]
+                )
+        idx.update(fresh)
+
+    def _mine_corpus(self, hist: List[int], want: int) -> List[int]:
+        """Cross-request half of ``_mine_ngram``: look the history's
+        trailing n-gram up in the suffix index, longest context first —
+        near-duplicate requests (shared RAG prefixes) collide on short
+        n-grams, and the deeper context disambiguates which stream to
+        continue.  O(1) per lane per round."""
+        for n in (6, 5, 4, 3, 2, 1):
+            if len(hist) < n:
+                continue
+            cont = self._suffix_idx.get(tuple(hist[-n:]))
+            if cont:
+                return cont[:want]
+        return []
+
+    def _spec_round(self) -> None:
+        """One draft→verify→accept round over the pool: propose ``k-1``
+        tokens per lane (n-gram mining, trunk fallback), verify all
+        ``k`` positions in ONE batched dispatch, commit each lane's
+        longest agreeing prefix.  Tokens are EXACTLY the plain path's
+        (the verify replays its sampling rng-for-rng); only the number
+        of dispatches per token changes.  Any draft/verify fault falls
+        back to the plain step chunk for this round — pool untouched,
+        token-identical — and arms a cooldown."""
+        import jax.numpy as jnp
+
+        gen = self.generator
+        S = self.slots
+        k = self.spec_k
+        toks = np.zeros((S, k), np.int32)
+        pos = np.zeros(S, np.int32)
+        act = np.zeros(S, bool)
+        left = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        eos = np.full(S, -1, np.int32)
+        src_of: Dict[int, str] = {}
+        need_trunk: List[int] = []
+        for s, st in self._active.items():
+            toks[s, 0] = st.tokens[-1]
+            pos[s] = st.pos
+            act[s] = True
+            left[s] = st.left
+            temps[s] = st.temperature
+            eos[s] = st.eos
+            mined: List[int] = []
+            if self.draft_source in ("auto", "ngram"):
+                hist = st.prompt_ids + st.tokens
+                mined = self._mine_ngram(hist, k - 1)
+                pooled = self._mine_corpus(hist, k - 1)
+                if len(pooled) > len(mined):
+                    mined = pooled
+            if mined:
+                toks[s, 1 : 1 + len(mined)] = mined
+                src_of[s] = "ngram"
+            elif self.draft_source in ("auto", "trunk"):
+                need_trunk.append(s)
+                src_of[s] = "trunk"
+            else:
+                src_of[s] = "none"
+        sc = (self._kscale, self._vscale) if self._quant else ()
+        with gen._lock:
+            vfn = gen._slot_verify_fn(S, self._T, k, self._quant)
+            dfn = gen._slot_draft_fn(
+                S, self._T, k - 1, self._draft_layers, self._quant
+            )
+        deadline = self._batch_deadline(
+            [st.req for st in self._active.values()]
+        )
+        riders = [
+            st for st in self._active.values() if st.req.trace is not None
+        ]
+        bctx = None
+        if riders:
+            bctx = trace.start_trace(
+                "decode.batch", deadline=deadline, kind="batch", sample=False
+            )
+            if bctx is not None:
+                bctx.annotate(
+                    engine=self.name, slots=len(self._active),
+                    spec_k=k, spec=True,
+                )
+        t0 = time.perf_counter_ns()
+        try:
+            # draft phase: ONE reduced-trunk dispatch covers every lane
+            # that needs it; pure-ngram rounds still fire the chaos site
+            # so a faulted draft path degrades ALL speculation uniformly
+            if need_trunk:
+                # pathway: allow(recompile-hazard): every operand shape is static per engine — [S] / [S, k] with S = the pool size and k = spec_k, fixed at construction; the census test pins the signature count
+                dr = retry_call(
+                    "generator.draft",
+                    dfn,
+                    gen.params, self._pk, self._pv,
+                    jnp.asarray(toks[:, 0]), jnp.asarray(pos),
+                    jnp.asarray(act), *sc,
+                    deadline=deadline,
+                )
+                dr = np.asarray(dr)  # pathway: allow(value-flow): the draft fetch — proposals are host state (they seed the verify's token operand), one deliberate sync per speculative round
+                for s in need_trunk:
+                    toks[s, 1:] = dr[s]
+            else:
+                inject.fire("generator.draft", deadline=deadline)
+            # verify phase: ONE batched dispatch scores all k positions
+            args = (
+                gen.params, self._pk, self._pv, jnp.asarray(toks),
+                jnp.asarray(pos), jnp.asarray(act), jnp.asarray(left),
+                self._rngs, jnp.asarray(temps), jnp.asarray(eos), *sc,
+            )
+            if bctx is not None:
+                with trace.use(bctx):
+                    pk, pv, rngs, em = retry_call(
+                        "generator.verify", vfn, *args, deadline=deadline
+                    )
+            else:
+                pk, pv, rngs, em = retry_call(
+                    "generator.verify", vfn, *args, deadline=deadline
+                )
+            em = np.asarray(em)  # [k, S]  # pathway: allow(value-flow): THE decode-loop fetch (speculative flavor) — one deliberate sync per round delivers every slot's accepted tokens to its rider
+        except Exception as exc:
+            if bctx is not None:
+                trace.finish(bctx, statuses=("speculation_disabled",))
+            # degrade-never-fail: the pool was NOT rebound (functional
+            # updates — a failed dispatch leaves no partial state), so
+            # the plain chunk below produces exactly the tokens the
+            # spec round would have committed
+            log_once(
+                f"decode.spec:{type(exc).__name__}",
+                "speculative round failed (%r); falling back to the "
+                "plain step chunk (token-identical) and cooling down",
+                exc,
+            )
+            self.pool_stats["spec_fallbacks"] += 1
+            record_degraded("speculation_disabled")
+            self._spec_hold = 8
+            self._step_chunk()
+            return
+        t1 = time.perf_counter_ns()
+        _H_STEP.observe_ns(t1 - t0)
+        self._pk, self._pv, self._rngs = pk, pv, rngs
+        self.pool_stats["chunks"] += 1
+        self.pool_stats["steps"] += k
+        self.pool_stats["spec_rounds"] += 1
+        self.pool_stats["occupancy_sum"] += len(self._active)
+        if bctx is not None:
+            trace.finish(bctx)
+            for st in riders:
+                rt = st.req.trace
+                rt.add_link(bctx.trace_id)
+                rt.add_span(
+                    "decode.step", t0, t1,
+                    linked_trace=bctx.trace_id, slots=len(self._active),
+                    spec_k=k,
+                )
+        # replay: commit each lane's accepted prefix — ``-1`` marks the
+        # first rejected position (acceptance is a PREFIX by
+        # construction); EOS inside the accepted prefix truncates it
+        # there and frees the slot THIS round, exactly like a plain
+        # chunk whose lane hits EOS mid-chunk
+        leaves: List[Tuple[int, _SlotState, Tuple[str, ...]]] = []
+        for s, st in list(self._active.items()):
+            emitted = 0
+            flags: Tuple[str, ...] = ()
+            finished = False
+            for i in range(k):
+                t = int(em[i, s])  # pathway: allow(value-flow): `em` was rebound to its HOST copy at the fetch above — no device touch here
+                if t < 0:
+                    break
+                st.tokens.append(t)
+                st.pos += 1
+                st.left -= 1
+                emitted += 1
+                self.pool_stats["tokens_decode"] += 1
+                if (st.eos >= 0 and t == st.eos) or st.left <= 0:
+                    finished = True
+                    break
+            _H_DRAFT_ACCEPT.observe_s(float(emitted))
+            self.pool_stats["draft_offered"] += k - 1
+            self.pool_stats["draft_accepted"] += max(0, emitted - 1)
+            self._draft_sources[src_of.get(s, "none")] += 1
+            if not finished and (
+                st.req.deadline is not None and st.req.deadline.expired()
+            ):
+                finished = True
+                flags = (EXTRACTIVE_ANSWER,)
+            if finished:
+                leaves.append((s, st, flags))
+        for s, st, flags in leaves:
+            self._leave(s, st, flags=flags)
+
     # -- leave / resolve -----------------------------------------------------
     def _leave(
         self, slot: int, st: _SlotState, flags: Tuple[str, ...] = ()
@@ -718,6 +1092,8 @@ class ContinuousDecoder(_CoalescerBase):
                 record_degraded(f)
         else:
             self.pool_stats["finished"] += 1
+            if self.spec_k >= 2:
+                self._remember(st)
         if st.req.trace is not None:
             st.req.trace.add_span(
                 "decode", st.t_join_ns, time.perf_counter_ns(),
@@ -864,3 +1240,19 @@ class ContinuousDecoder(_CoalescerBase):
             "counter", "pathway_generator_chunks_total", labels,
             self.pool_stats["chunks"],
         )
+        # speculative decode: acceptance rate (accepted draft tokens /
+        # offered draft tokens — 0.0 before any round) + which proposer
+        # produced each lane-round's drafts.  All three sources render
+        # even at zero so dashboards see the full label space
+        offered = self.pool_stats["draft_offered"]
+        yield (
+            "gauge", "pathway_generator_draft_acceptance_rate", labels,
+            (self.pool_stats["draft_accepted"] / offered) if offered else 0.0,
+        )
+        for source in ("ngram", "trunk", "none"):
+            yield (
+                "counter",
+                "pathway_generator_draft_source_total",
+                {**labels, "source": source},
+                self._draft_sources[source],
+            )

@@ -444,6 +444,51 @@ def test_lock_discipline_knows_slot_pool_getters():
     assert _live(_run(good), "lock-discipline") == []
 
 
+def test_lock_discipline_knows_speculative_getters():
+    """ISSUE 16: the speculative-decode compiled-fn getters
+    (``_slot_verify_fn`` / ``_slot_draft_fn``) join the slot-pool
+    cache-getter convention — fetching one under a lock is fine (the
+    getter only touches the fn cache), DISPATCHING it under the pool
+    lock is a lock-discipline finding, same as prefill/step."""
+    bad = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._pool_lock = threading.Lock()
+
+            def spec(self, toks, pos):
+                with self._pool_lock:
+                    vfn = self._slot_verify_fn(8, 64, 4)
+                    return vfn(self._pk, self._pv, toks, pos)
+
+            def draft(self, tok, pos):
+                with self._pool_lock:
+                    dfn = self._slot_draft_fn(8, 64, 3, 1)
+                    return dfn(self._pk, self._pv, tok, pos)
+    """
+    live = _live(_run(bad), "lock-discipline")
+    assert len(live) == 2, "\n".join(f.message for f in live)
+    assert all("jitted dispatch" in f.message for f in live)
+    good = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._pool_lock = threading.Lock()
+
+            def spec(self, toks, pos):
+                # fetch the compiled pair under the fn-cache lock, then
+                # dispatch OFF it — the engine's _spec_round shape
+                with self._pool_lock:
+                    vfn = self._slot_verify_fn(8, 64, 4)
+                    dfn = self._slot_draft_fn(8, 64, 3, 1)
+                drafts = dfn(self._pk, self._pv, toks, pos)
+                return vfn(self._pk, self._pv, drafts, pos)
+    """
+    assert _live(_run(good), "lock-discipline") == []
+
+
 def test_lock_discipline_flags_observability_callback_under_lock():
     """ISSUE 12: a profiler/ledger/SLO callback taken under a serve-path
     lock is a lock-discipline finding — the pull-based samplers walk

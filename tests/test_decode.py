@@ -459,3 +459,415 @@ def test_decode_traces_link_rider_to_step_batches(monkeypatch):
     linked = {s[6]["linked_trace"] for s in step_spans}
     assert linked <= {b.trace_id for b in batches}
     assert ctx.finished
+
+
+# -- speculative decode (ISSUE 16) -------------------------------------------
+
+def test_spec_token_identity_matrix_greedy_and_sampled():
+    """The tentpole oracle: spec-on == spec-off == solo, bit-for-bit,
+    greedy AND temperature>0, under staggered joins and mixed budgets —
+    the verify replays the plain step's sampling rng-for-rng, so
+    acceptance can only keep tokens the plain chain would have drawn."""
+    gen = make_generator()
+    cases = [
+        (p, 0.0 if i % 2 == 0 else 0.7 + 0.1 * (i % 3), i)
+        for i, p in enumerate(PROMPTS)
+    ]
+    solo = {
+        (p, temp, seed): gen.generate(
+            [p], max_new_tokens=8, temperature=temp, seed=seed,
+            use_kv=False,
+        )[0]
+        for p, temp, seed in cases
+    }
+    for spec_k in (3, 4):
+        eng = ContinuousDecoder(
+            gen, slots=3, step_bucket=4, name=f"dec-spec{spec_k}",
+            spec_k=spec_k,
+        )
+        try:
+            tickets = []
+            for i, (p, temp, seed) in enumerate(cases):
+                tickets.append(
+                    eng.submit(p, max_new_tokens=8, temperature=temp,
+                               seed=seed)
+                )
+                if i in (2, 5):
+                    time.sleep(0.02)  # staggered joins mid-flight
+            got = [t() for t in tickets]
+        finally:
+            eng.stop()
+        for out, key in zip(got, cases):
+            assert out == solo[key], (spec_k, key)
+            assert not out.degraded
+        assert eng.pool_stats["spec_rounds"] > 0
+        assert eng.pool_stats["spec_fallbacks"] == 0
+
+
+def test_spec_slot_reuse_after_eos_token_identical():
+    gen = make_generator()
+    base = gen.generate(["hello world"], max_new_tokens=10, use_kv=False)[0]
+    eos = ids_of(base)[1]
+    eng = ContinuousDecoder(
+        gen, slots=1, step_bucket=4, name="dec-spec-reuse", spec_k=4
+    )
+    try:
+        seq = ["hello world", "the quick brown fox jumps over", "short",
+               "hello world"]
+        outs = [
+            eng.submit(p, max_new_tokens=10, eos_id=eos)() for p in seq
+        ]
+        for out, p in zip(outs, seq):
+            assert out == gen.generate(
+                [p], max_new_tokens=10, use_kv=False, eos_id=eos
+            )[0], p
+        assert eng.pool_stats["finished"] == len(seq)
+        assert eng.pool_stats["spec_rounds"] > 0
+    finally:
+        eng.stop()
+
+
+def test_spec_warm_prefix_join_identical_to_cold_and_solo():
+    kv = PrefixKVCache(block=8)
+    gen = make_generator(max_length=96, kv_cache=kv)
+    shared = (
+        "system prompt answer strictly from the retrieved context "
+        "chunk one about dataflow chunk two about serving "
+    )
+    p1 = shared + "what is incremental computation"
+    p2 = shared + "how does the scheduler coalesce"
+    eng = ContinuousDecoder(
+        gen, slots=2, step_bucket=4, name="dec-spec-warm", spec_k=3
+    )
+    try:
+        cold = eng.submit(p2, max_new_tokens=5)()
+        kv.clear()
+        kv.stats_tokens.update(reused=0, computed=0)
+        eng.submit(p1, max_new_tokens=5)()
+        warm = eng.submit(p2, max_new_tokens=5)()
+        assert warm == cold
+        assert kv.stats_tokens["reused"] > 0
+        assert warm == gen.generate([p2], max_new_tokens=5, use_kv=False)[0]
+    finally:
+        eng.stop()
+
+
+def test_eos_inside_verify_chunk_frees_slot_and_accounting_matches():
+    """EOS landing mid-accepted-prefix truncates the acceptance there:
+    the slot frees THAT round (a queued request takes it), and the
+    token accounting (tokens emitted, finished count) matches the
+    plain spec-off engine exactly — the EOS-inside-chunk satellite."""
+    gen = make_generator()
+    base = gen.generate(["hello world"], max_new_tokens=12, use_kv=False)[0]
+    eos = ids_of(base)[2]  # 3rd emitted token: EOS lands mid-round at k=4
+    counts = {}
+    for spec_k in (0, 4):
+        eng = ContinuousDecoder(
+            gen, slots=1, step_bucket=2, name=f"dec-eosv{spec_k}",
+            spec_k=spec_k,
+        )
+        try:
+            t_short = eng.submit("hello world", max_new_tokens=12,
+                                 eos_id=eos)
+            t_long = eng.submit("the quick brown fox jumps over",
+                                max_new_tokens=6)
+            short, long_ = t_short(), t_long()
+        finally:
+            eng.stop()
+        assert short == gen.generate(
+            ["hello world"], max_new_tokens=12, use_kv=False, eos_id=eos
+        )[0]
+        assert long_ == gen.generate(
+            ["the quick brown fox jumps over"], max_new_tokens=6,
+            use_kv=False,
+        )[0]
+        assert eng.pool_stats["finished"] == 2
+        counts[spec_k] = eng.pool_stats["tokens_decode"]
+        if spec_k:
+            assert eng.pool_stats["spec_rounds"] > 0
+    # emitted-token accounting is speculation-invariant: both engines
+    # charged exactly the tokens the requests actually received
+    assert counts[0] == counts[4]
+
+
+def test_spec_census_one_verify_and_draft_signature():
+    gen = make_generator()
+    eng = ContinuousDecoder(
+        gen, slots=2, step_bucket=4, name="dec-spec-census", spec_k=3
+    )
+    try:
+        for i, p in enumerate(PROMPTS):
+            eng.submit(p, max_new_tokens=3 + (i % 3))()
+        verify_keys = [
+            k for k in gen._fns
+            if isinstance(k, tuple) and k[0] == "slot_verify"
+        ]
+        draft_keys = [
+            k for k in gen._fns
+            if isinstance(k, tuple) and k[0] == "slot_draft"
+        ]
+        # ONE verify program per engine — (slots, T, k) all static —
+        # and at most one reduced-trunk draft program
+        assert len(verify_keys) == 1, verify_keys
+        assert len(draft_keys) <= 1, draft_keys
+        sigs_before = gen._tripwire.signatures
+        eng.submit(PROMPTS[0], max_new_tokens=4)()
+        assert gen._tripwire.signatures == sigs_before
+    finally:
+        eng.stop()
+
+
+def test_spec_metrics_surface_acceptance_and_sources():
+    gen = make_generator()
+    eng = ContinuousDecoder(
+        gen, slots=2, step_bucket=4, name="dec-spec-obs", spec_k=4
+    )
+    try:
+        for p in PROMPTS[:4]:
+            eng.submit(p, max_new_tokens=8)()
+        assert eng.pool_stats["spec_rounds"] > 0
+        assert eng.pool_stats["draft_offered"] > 0
+        text = "\n".join(observe.render_prometheus())
+        for needle in (
+            "pathway_generator_draft_accepted_tokens_bucket",
+            'pathway_generator_draft_acceptance_rate{generator="dec-spec-obs"}',
+            'pathway_generator_draft_source_total{generator="dec-spec-obs",source="ngram"}',
+            'pathway_generator_draft_source_total{generator="dec-spec-obs",source="trunk"}',
+            'pathway_generator_draft_source_total{generator="dec-spec-obs",source="none"}',
+        ):
+            assert needle in text, needle
+        # every lane-round attributed to exactly one draft source (>=
+        # one lane per round, possibly several)
+        assert sum(eng._draft_sources.values()) >= eng.pool_stats["spec_rounds"]
+    finally:
+        eng.stop()
+
+
+def test_ngram_miner_prefers_longest_suffix_match():
+    mine = ContinuousDecoder._mine_ngram
+    # trailing 3-gram (7 8 9) recurs: propose what followed it
+    assert mine([7, 8, 9, 1, 2, 7, 8, 9], 2) == [1, 2]
+    # rightmost earlier occurrence wins
+    assert mine([5, 1, 5, 2, 5], 3) == [2, 5]
+    # no recurrence at any n: dry well
+    assert mine([1, 2, 3, 4], 2) == []
+    assert mine([], 2) == []
+    # proposals never exceed `want`
+    assert len(mine([3, 3, 3, 3, 3, 3], 2)) <= 2
+
+
+def test_spec_env_knobs(monkeypatch):
+    from pathway_tpu.models.generator import (
+        decode_draft_layers,
+        decode_draft_source,
+        decode_kv_quant,
+        decode_spec_k,
+    )
+
+    monkeypatch.setenv("PATHWAY_DECODE_SPEC_K", "6")
+    monkeypatch.setenv("PATHWAY_DECODE_KV_QUANT", "int8")
+    monkeypatch.setenv("PATHWAY_DECODE_DRAFT", "ngram")
+    monkeypatch.setenv("PATHWAY_DECODE_DRAFT_LAYERS", "1")
+    assert decode_spec_k() == 6
+    assert decode_kv_quant() == "int8"
+    assert decode_draft_source() == "ngram"
+    assert decode_draft_layers(4) == 1
+    gen = make_generator()
+    eng = ContinuousDecoder(gen, name="dec-envk", autostart=False)
+    assert eng.spec_k == 6 and eng.kv_quant == "int8"
+    assert eng.draft_source == "ngram" and eng._draft_layers == 1
+    eng.stop()
+    monkeypatch.setenv("PATHWAY_DECODE_SPEC_K", "junk")
+    monkeypatch.setenv("PATHWAY_DECODE_KV_QUANT", "fp4")
+    monkeypatch.setenv("PATHWAY_DECODE_DRAFT", "oracle")
+    monkeypatch.setenv("PATHWAY_DECODE_DRAFT_LAYERS", "0")
+    assert decode_spec_k() == 0          # off by default
+    assert decode_kv_quant() == "bf16"   # unknown -> baseline
+    assert decode_draft_source() == "auto"
+    assert decode_draft_layers(4) == 2   # 0 -> half the trunk
+    assert decode_draft_layers(1) == 1   # never below one block
+
+
+# -- int8 KV slot pool (ISSUE 16) --------------------------------------------
+
+def test_int8_quantization_idempotent_and_bounded():
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.kv_quant import (
+        dequantize_kv, kv_pool_scales, quantize_kv,
+    )
+
+    gen = make_generator()
+    ks, vs = gen.kv_pool_scales()
+    cfg = gen.config
+    L, H = cfg.n_layers, cfg.n_heads
+    hd = cfg.d_model // H
+    assert ks.shape == (L, H, hd) and vs.shape == (L, H, hd)
+    assert float(ks.min()) > 0 and float(vs.min()) > 0
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (3, L, 16, H, hd), jnp.float32
+    ) * 0.05
+    q = quantize_kv(x, ks)
+    assert q.dtype == jnp.int8
+    # idempotence: re-quantizing a dequantized pool is a no-op — the
+    # property that makes warm prefix joins byte-identical to cold
+    assert bool((quantize_kv(dequantize_kv(q, ks), ks) == q).all())
+    # round-trip error bounded by half a quantization step per channel
+    err = jnp.abs(dequantize_kv(q, ks) - x)
+    assert float((err <= 0.5 * ks[None, :, None] + 1e-6).all())
+
+
+def test_int8_pool_halves_bytes_and_ledger_shows_scales():
+    gen = make_generator()
+    bf16 = ContinuousDecoder(
+        gen, slots=4, step_bucket=4, name="dec-bf16-hbm", autostart=False
+    )
+    int8 = ContinuousDecoder(
+        gen, slots=4, step_bucket=4, name="dec-int8-hbm", autostart=False,
+        kv_quant="int8",
+    )
+    try:
+        c_bf, c_i8 = bf16.hbm_components(), int8.hbm_components()
+        # >= 2x slots×context at fixed HBM: the int8 pool stores half
+        # the bytes per cached token (bf16 -> int8)
+        assert c_bf["kv_pool"] >= 2 * (c_i8["kv_pool"] - int8._rngs.nbytes)
+        assert c_i8["kv_scales"] > 0
+        assert "kv_scales" not in c_bf
+    finally:
+        bf16.stop()
+        int8.stop()
+
+
+def test_int8_decode_deterministic_and_spec_invariant():
+    """int8 drops bf16 bit-identity (documented drift vs the bf16
+    oracle) but keeps every OTHER invariant: deterministic across
+    engines, spec-on == spec-off, and slot reuse safe."""
+    outs = {}
+    for spec_k in (0, 3):
+        gen = make_generator()
+        eng = ContinuousDecoder(
+            gen, slots=3, step_bucket=4, name=f"dec-i8-{spec_k}",
+            kv_quant="int8", spec_k=spec_k,
+        )
+        try:
+            outs[spec_k] = [
+                str(o) for o in eng.generate(
+                    PROMPTS[:6], max_new_tokens=8, temperature=0.0, seed=1
+                )
+            ]
+        finally:
+            eng.stop()
+    assert outs[0] == outs[3]
+
+
+def test_int8_warm_prefix_join_identical_to_cold():
+    """Warm int8 joins re-quantize captured (dequantized) blocks back
+    to the SAME pool bytes — idempotence end-to-end through the prefix
+    cache, so warm == cold under int8 exactly like bf16."""
+    shared = (
+        "system prompt answer strictly from the retrieved context "
+        "chunk one about dataflow chunk two about serving "
+    )
+    p1 = shared + "what is incremental computation"
+    p2 = shared + "how does the scheduler coalesce"
+    kv = PrefixKVCache(block=8)
+    gen = make_generator(max_length=96, kv_cache=kv)
+    eng = ContinuousDecoder(
+        gen, slots=2, step_bucket=4, name="dec-i8-warm", kv_quant="int8"
+    )
+    try:
+        cold = eng.submit(p2, max_new_tokens=5)()
+        kv.clear()
+        kv.stats_tokens.update(reused=0, computed=0)
+        eng.submit(p1, max_new_tokens=5)()
+        warm = eng.submit(p2, max_new_tokens=5)()
+        assert str(warm) == str(cold)
+        assert kv.stats_tokens["reused"] > 0
+    finally:
+        eng.stop()
+
+
+def test_int8_pinned_golden():
+    """The int8 drift contract: the exact CPU token output for a fixed
+    config/prompt/seed is PINNED (tests/goldens/int8_decode.json) — a
+    quantization change that moves tokens must re-pin the golden
+    deliberately, with the drift reviewed."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "goldens", "int8_decode.json"
+    )
+    with open(path) as fh:
+        golden = json.load(fh)
+    gen = make_generator()
+    eng = ContinuousDecoder(
+        gen, slots=2, step_bucket=4, name="dec-i8-golden",
+        kv_quant="int8", spec_k=3,
+    )
+    try:
+        got = [
+            str(o) for o in eng.generate(
+                golden["prompts"],
+                max_new_tokens=golden["max_new_tokens"],
+                temperature=0.0, seed=golden["seed"],
+            )
+        ]
+    finally:
+        eng.stop()
+    assert got == golden["outputs"]
+
+
+def test_suffix_corpus_drafts_repeat_requests_wholesale():
+    """Cross-request suffix corpus: a cleanly finished request feeds
+    its token stream into the n-gram → continuation index, so a REPEAT
+    of the same request drafts its continuation from the previous run
+    and the verify accepts it wholesale (greedy) — far fewer rounds,
+    identical tokens.  Within a stream the FIRST occurrence of an
+    n-gram must win (a later overlapping occurrence inside a repeated-
+    token run would skip the rest of the run)."""
+    gen = make_generator()
+    eng = ContinuousDecoder(gen, slots=2, step_bucket=4, spec_k=8)
+    try:
+        solo = gen.generate(["corpus repeat probe"], max_new_tokens=12)[0]
+        assert eng._suffix_idx == {}
+        cold = eng.submit("corpus repeat probe", max_new_tokens=12)()
+        st_cold = dict(eng.pool_stats)
+        assert eng._suffix_idx, "finished request must feed the corpus"
+        warm = eng.submit("corpus repeat probe", max_new_tokens=12)()
+        st_warm = eng.pool_stats
+        assert str(cold) == solo == str(warm)
+        cold_rounds = st_cold["spec_rounds"]
+        warm_rounds = st_warm["spec_rounds"] - cold_rounds
+        cold_acc = st_cold["draft_accepted"]
+        warm_acc = st_warm["draft_accepted"] - cold_acc
+        # the warm repeat drafts from the remembered stream: strictly
+        # fewer rounds and strictly more accepted tokens than cold
+        assert warm_rounds < cold_rounds
+        assert warm_acc >= cold_acc + 4
+    finally:
+        eng.stop()
+
+
+def test_suffix_corpus_first_occurrence_wins_within_stream():
+    """The index maps an n-gram to the tokens after its FIRST
+    occurrence in a stream: inside a repeated-token run (a a a b) the
+    trailing (x, a) bigram must continue the run, not jump past it."""
+    gen = make_generator()
+    eng = ContinuousDecoder(gen, slots=1, step_bucket=2, spec_k=4)
+    try:
+
+        class _St:
+            prompt_ids = [7, 9]
+            tokens = [5, 5, 5, 3, 5, 8]
+
+        eng._remember(_St())
+        # first occurrence of (9, 5) continues the run: 5 5 3 5 8
+        assert eng._mine_corpus([1, 9, 5], 4) == [5, 5, 3, 5]
+        # trigram beats bigram: most specific context first
+        assert eng._mine_corpus([9, 5, 5], 3) == [5, 3, 5]
+        # dry: unseen context
+        assert eng._mine_corpus([42, 43, 44], 3) == []
+    finally:
+        eng.stop()

@@ -200,6 +200,21 @@ def rendered_families():
     finally:
         engine.stop()
 
+    # speculative decode over the int8 pool (draft_* families + the
+    # quantized-pool HBM component)
+    spec_engine = ContinuousDecoder(
+        gen, slots=2, step_bucket=2, window_us=0, spec_k=3,
+        kv_quant="int8",
+    )
+    try:
+        spec_engine.generate(
+            ["speculative inventory probe one",
+             "speculative inventory probe two"],
+            max_new_tokens=4,
+        )
+    finally:
+        spec_engine.stop()
+
     # exchange plane pair
     kv = _FakeKV()
     planes = [None, None]

@@ -1378,3 +1378,117 @@ def test_robust_package_is_analyzer_clean():
     )
     live = [f for f in analyze_paths([root]) if not f.suppressed]
     assert live == [], "\n".join(f.format() for f in live)
+
+
+# -- chaos: speculative decode (ISSUE 16) ------------------------------------
+
+
+def _spec_stack(**kw):
+    from pathway_tpu.models.generator import TextGenerator
+    from pathway_tpu.serve import ContinuousDecoder
+
+    gen = TextGenerator(
+        dimension=32, n_layers=2, n_heads=4, max_length=64, vocab_size=512,
+        kv_cache=None,
+    )
+    args = dict(slots=2, step_bucket=4, name=None, spec_k=4)
+    args.update(kw)
+    return gen, ContinuousDecoder(gen, **args)
+
+
+def test_spec_draft_chaos_triple_degrades_never_fails():
+    """``generator.draft`` raise/delay/hang: every fault degrades the
+    round to the PLAIN step chunk — token-identical to solo, the
+    request never flagged — counted on
+    ``pathway_serve_degraded_total{reason="speculation_disabled"}``."""
+    gen, eng = _spec_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=8, use_kv=False)[0]
+        # transient raise: the retry ladder absorbs it — the round
+        # completes speculatively, no fallback needed
+        with inject.armed("generator.draft", "raise", times=1):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+        # persistent raise: the round degrades to the plain chunk,
+        # token-identical, counted on the degrade ledger
+        before = _degraded("speculation_disabled")
+        with inject.armed("generator.draft", "raise"):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+        assert _degraded("speculation_disabled") >= before + 1
+        assert eng.pool_stats["spec_fallbacks"] >= 1
+        # delay: the draft dispatch lands late but clean — a full
+        # speculative round, same tokens
+        with inject.armed("generator.draft", "delay", delay_s=0.05, times=1):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+        # hang: bounded by the hang cap, then the round degrades to the
+        # plain chunk — still token-identical, never a stall
+        with inject.armed("generator.draft", "hang", hang_s=0.2):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+    finally:
+        eng.stop()
+
+
+def test_spec_verify_chaos_triple_degrades_never_fails():
+    """``generator.verify`` raise/delay/hang: the verify dispatch is
+    the round's commit point — a fault there leaves the pool UNTOUCHED
+    (functional updates), so the plain-chunk fallback reproduces the
+    exact tokens the round would have committed."""
+    gen, eng = _spec_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=8, use_kv=False)[0]
+        with inject.armed("generator.verify", "raise", times=1):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded  # retry absorbed it
+        before = _degraded("speculation_disabled")
+        with inject.armed("generator.verify", "raise"):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+        assert _degraded("speculation_disabled") >= before + 1
+        with inject.armed("generator.verify", "delay", delay_s=0.05, times=1):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+        with inject.armed("generator.verify", "hang", hang_s=0.2):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+    finally:
+        eng.stop()
+
+
+def test_spec_persistent_fault_cools_down_loop_survives():
+    """A draft path that stays down: EVERY speculative attempt falls
+    back token-identically, the cooldown keeps the retry ladder off the
+    per-round budget, and once the fault clears speculation resumes —
+    the loop never stops serving."""
+    gen, eng = _spec_stack()
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=8, use_kv=False)[0]
+        with inject.armed("generator.draft", "raise"):
+            for _ in range(2):
+                got = eng.submit("hello world", max_new_tokens=8)()
+                assert got == solo and not got.degraded
+        assert eng.pool_stats["spec_fallbacks"] >= 1
+        # fault cleared: serving continues clean (and speculation may
+        # resume once the cooldown drains)
+        assert eng.submit("hello world", max_new_tokens=8)() == solo
+    finally:
+        eng.stop()
+
+
+def test_spec_ngram_only_rounds_still_honor_draft_faults():
+    """Pure-ngram rounds have no trunk dispatch, but the chaos site
+    still fires: a faulted draft path disables ALL speculation
+    uniformly, whatever the proposer — same degrade-never-fail
+    contract."""
+    gen, eng = _spec_stack(draft="ngram")
+    try:
+        solo = gen.generate(["hello world"], max_new_tokens=8, use_kv=False)[0]
+        before = _degraded("speculation_disabled")
+        with inject.armed("generator.draft", "raise"):
+            got = eng.submit("hello world", max_new_tokens=8)()
+        assert got == solo and not got.degraded
+        assert _degraded("speculation_disabled") >= before + 1
+    finally:
+        eng.stop()
