@@ -20,14 +20,12 @@ from __future__ import annotations
 
 # the runtime lock-order sanitizer must patch the threading constructors
 # BEFORE any pathway module creates its locks — this import chain is
-# where they all get created, so the hook runs first.  The env test is
-# inlined (mirrors sanitizer.enabled_from_env) so the analysis package
-# (pure stdlib, but six modules) loads only when the knob is ON.
-import os as _os
+# where they all get created, so the hook runs first.  The knob registry
+# is pure stdlib and import-cycle-free, so it loads before everything;
+# the analysis package (six modules) loads only when the knob is ON.
+from . import config
 
-if _os.environ.get("PATHWAY_LOCK_SANITIZER", "").strip() not in (
-    "", "0", "false", "off",
-):
+if config.get("analysis.lock_sanitizer"):
     from .analysis.sanitizer import install as _sanitizer_install
 
     _sanitizer_install()

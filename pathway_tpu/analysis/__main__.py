@@ -110,7 +110,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pathway_tpu.analysis",
         description="Hot-path lint: lock-discipline, hidden-sync, "
-        "recompile-hazard, lock-order, value-flow.",
+        "recompile-hazard, lock-order, value-flow, knob-discipline.",
     )
     parser.add_argument(
         "paths", nargs="*", default=["pathway_tpu"],

@@ -226,6 +226,7 @@ def _attach_spans(pragmas: List[_Pragma], tree: ast.Module) -> None:
 
 def default_rules() -> List[Rule]:
     from .hidden_sync import HiddenSyncRule
+    from .knob_discipline import KnobDisciplineRule
     from .lock_discipline import LockDisciplineRule
     from .lock_order import LockOrderRule
     from .recompile_hazard import RecompileHazardRule
@@ -237,6 +238,7 @@ def default_rules() -> List[Rule]:
         RecompileHazardRule(),
         LockOrderRule(),
         ValueFlowRule(),
+        KnobDisciplineRule(),
     ]
 
 
@@ -395,7 +397,9 @@ def _family_salt(rule: Rule) -> str:
 
 
 def _cache_dir() -> Optional[str]:
-    return os.environ.get("PATHWAY_ANALYSIS_CACHE") or None
+    from .. import config
+
+    return config.get("analysis.cache_dir") or None
 
 
 def _cache_key(display: str, source: bytes) -> str:

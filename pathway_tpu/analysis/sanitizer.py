@@ -94,27 +94,22 @@ _provider = None
 
 
 def _hold_budget_ns() -> Optional[int]:
-    raw = os.environ.get("PATHWAY_LOCK_HOLD_MS", "").strip()
-    if not raw:
-        return None
-    try:
-        ms = float(raw)
-    except ValueError:
-        return None
+    ms = _config().get("analysis.lock_hold_ms")
     return int(ms * 1e6) if ms > 0 else None
 
 
 def enabled_from_env() -> bool:
-    return os.environ.get("PATHWAY_LOCK_SANITIZER", "").strip() not in (
-        "", "0", "false", "off",
-    )
+    return _config().get("analysis.lock_sanitizer")
 
 
 def _should_raise() -> bool:
-    override = os.environ.get("PATHWAY_LOCK_SANITIZER_RAISE", "").strip()
-    if override:
-        return override not in ("0", "false", "off")
-    return "PYTEST_CURRENT_TEST" in os.environ
+    return _config().get("analysis.lock_sanitizer_raise")
+
+
+def _config():
+    from .. import config
+
+    return config
 
 
 def _stack() -> List["_Held"]:

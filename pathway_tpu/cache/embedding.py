@@ -27,8 +27,9 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import config
 from .keys import token_ids_key
-from .store import CacheTier, cache_enabled, env_bytes, env_float
+from .store import CacheTier, cache_enabled
 
 __all__ = ["EmbeddingCache", "embedding_cache_from_env"]
 
@@ -47,9 +48,9 @@ class EmbeddingCache:
         max_entries: Optional[int] = None,
     ):
         if max_bytes is None:
-            max_bytes = env_bytes("PATHWAY_CACHE_EMBED_BYTES", 64 << 20)
+            max_bytes = config.get("cache.embed_bytes")
         if ttl_s is None:
-            ttl = env_float("PATHWAY_CACHE_EMBED_TTL_S", 0.0)
+            ttl = config.get("cache.embed_ttl_s")
             ttl_s = ttl if ttl > 0 else None
         self._tier = CacheTier(
             "embedding",
@@ -133,10 +134,8 @@ def embedding_cache_from_env() -> Optional[EmbeddingCache]:
     low-order score bits across compositions — it defaults off and is
     enabled deliberately (bench/serving configs), while ``ServeScheduler``
     callers get the bit-stable result tier by default."""
-    import os
-
     if not cache_enabled():
         return None
-    if os.environ.get("PATHWAY_CACHE_EMBED", "0") in ("1", "true", "on"):
+    if config.get("cache.embed"):
         return EmbeddingCache()
     return None

@@ -29,8 +29,9 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
+from .. import config
 from .keys import block_chain_keys
-from .store import CacheTier, cache_enabled, env_bytes, env_float
+from .store import CacheTier, cache_enabled
 
 __all__ = ["PrefixKVCache", "prefix_kv_cache_from_env"]
 
@@ -43,11 +44,11 @@ class PrefixKVCache:
         ttl_s: Optional[float] = None,
     ):
         if block is None:
-            block = int(env_bytes("PATHWAY_CACHE_KV_BLOCK", 32))
+            block = config.get("cache.kv_block")
         if max_bytes is None:
-            max_bytes = env_bytes("PATHWAY_CACHE_KV_BYTES", 256 << 20)
+            max_bytes = config.get("cache.kv_bytes")
         if ttl_s is None:
-            ttl = env_float("PATHWAY_CACHE_KV_TTL_S", 0.0)
+            ttl = config.get("cache.kv_ttl_s")
             ttl_s = ttl if ttl > 0 else None
         self.block = max(1, int(block))
         self._tier = CacheTier("generator_kv", max_bytes=max_bytes, ttl_s=ttl_s)
@@ -171,10 +172,8 @@ def prefix_kv_cache_from_env() -> Optional[PrefixKVCache]:
     ``PATHWAY_CACHE_KV=0`` (pure reuse of bit-reproducible K/V — the
     warm decode is bit-identical to the cold one, see
     models/generator.py)."""
-    import os
-
     if not cache_enabled():
         return None
-    if os.environ.get("PATHWAY_CACHE_KV", "1") in ("0", "false", "off"):
+    if not config.get("cache.kv"):
         return None
     return PrefixKVCache()

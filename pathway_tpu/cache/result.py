@@ -33,8 +33,9 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence, Tuple
 
+from .. import config
 from .keys import result_key
-from .store import CacheTier, cache_enabled, env_bytes, env_float
+from .store import CacheTier, cache_enabled
 
 __all__ = ["ResultCache", "result_cache_from_env"]
 
@@ -63,9 +64,9 @@ class ResultCache:
         max_entries: Optional[int] = None,
     ):
         if max_bytes is None:
-            max_bytes = env_bytes("PATHWAY_CACHE_RESULT_BYTES", 32 << 20)
+            max_bytes = config.get("cache.result_bytes")
         if ttl_s is None:
-            ttl = env_float("PATHWAY_CACHE_RESULT_TTL_S", 60.0)
+            ttl = config.get("cache.result_ttl_s")
             ttl_s = ttl if ttl > 0 else None
         self._tier = CacheTier(
             "result",
@@ -133,10 +134,8 @@ class ResultCache:
 def result_cache_from_env() -> Optional[ResultCache]:
     """The scheduler's default tier-0 construction: enabled unless
     ``PATHWAY_CACHE=0`` or ``PATHWAY_CACHE_RESULT=0``."""
-    import os
-
     if not cache_enabled():
         return None
-    if os.environ.get("PATHWAY_CACHE_RESULT", "1") in ("0", "false", "off"):
+    if not config.get("cache.result"):
         return None
     return ResultCache()

@@ -35,37 +35,33 @@ the dominant serving speedup once the dispatch path itself is tight.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
+import weakref
 from collections import OrderedDict
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
-from .. import observe
+from .. import config, observe
 from ..observe import trace
 from ..robust import log_once
 from ..robust import inject
 
-__all__ = ["CacheTier", "cache_enabled", "env_bytes", "env_float"]
+__all__ = ["CacheTier", "cache_enabled", "live_tiers"]
+
+# every live tier, weakly: the online tuner (serve/tuner.py) walks this
+# to retarget byte budgets on RUNNING tiers — a registry lookup at
+# construction time only would strand long-lived caches on stale budgets
+_LIVE_TIERS: "weakref.WeakSet[CacheTier]" = weakref.WeakSet()
+
+
+def live_tiers() -> "List[CacheTier]":
+    """Snapshot of every live ``CacheTier`` (tuner discovery surface)."""
+    return list(_LIVE_TIERS)
 
 
 def cache_enabled() -> bool:
     """Global kill switch: ``PATHWAY_CACHE=0`` disables every tier."""
-    return os.environ.get("PATHWAY_CACHE", "1") not in ("0", "false", "off")
-
-
-def env_bytes(name: str, default: int) -> int:
-    try:
-        return max(0, int(os.environ.get(name, str(default)) or default))
-    except ValueError:
-        return default
-
-
-def env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)) or default)
-    except ValueError:
-        return default
+    return config.get("cache.enabled")
 
 
 def _default_nbytes(value: Any) -> int:
@@ -136,6 +132,7 @@ class CacheTier:
         # per-instance series; see observe.next_id)
         self.labels = {"tier": self.tier, "id": str(observe.next_id())}
         observe.register_provider(self)
+        _LIVE_TIERS.add(self)
 
     def _trace_note(self, op: str, outcome: str) -> None:
         """Hit/miss annotation on the active trace (observe/trace.py):

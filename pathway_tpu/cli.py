@@ -24,6 +24,8 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Sequence
 
+from . import config
+
 __all__ = ["main", "spawn_program"]
 
 
@@ -231,7 +233,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_template(args.template, host=args.host, port=args.port)
 
     if args.command == "spawn-from-env":
-        spawn_args = shlex.split(os.environ.get("PATHWAY_SPAWN_ARGS", ""))
+        spawn_args = shlex.split(config.get("cli.spawn_args"))
         extra = [args.program] if args.program else []
         return main(["spawn", *spawn_args, *extra, *args.arguments])
 

@@ -44,7 +44,6 @@ sites: ``forward.absorb`` (plan), ``forward.upload`` (commit scatter),
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from functools import partial
@@ -54,7 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import observe
+from .. import config, observe
 from ..observe import hbm, profile
 from ..ops import donation_guard
 from ..ops.dispatch_counter import record_dispatch, record_fetch
@@ -95,18 +94,13 @@ def forward_tokens_per_doc(default: int = 16) -> int:
     Every stored document occupies exactly ``T'`` rows (fewer real
     tokens leave trailing rows invalid), so HBM per doc is a constant
     ``T' * d`` int8 + ``d`` f32 scales."""
-    try:
-        n = int(os.environ.get("PATHWAY_FORWARD_TOKENS", str(default)) or default)
-    except ValueError:
-        n = default
-    return max(1, n)
+    return config.get("forward.tokens", fallback=default)
 
 
 def forward_quant_mode(default: str = "int8") -> str:
     """``PATHWAY_FORWARD_QUANT``: ``int8`` (per-channel scales, 4x
     smaller than f32) or ``none`` (float32 rows, the parity oracle)."""
-    mode = (os.environ.get("PATHWAY_FORWARD_QUANT", default) or default).lower()
-    return mode if mode in ("int8", "none") else default
+    return config.get("forward.quant", fallback=default)
 
 
 class ForwardUnavailable(RuntimeError):

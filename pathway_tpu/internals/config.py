@@ -1,53 +1,76 @@
 """Environment-first configuration
 (reference: python/pathway/internals/config.py:58-80 — PathwayConfig env
-fields; src/engine/dataflow/config.rs — topology env vars)."""
+fields; src/engine/dataflow/config.rs — topology env vars).
+
+All env parsing goes through the declarative registry
+(``pathway_tpu/config.py``) — field defaults are ``default_factory``
+thunks, so each ``PathwayConfig()`` construction reads the CURRENT knob
+values instead of whatever the env held at class-definition time."""
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Optional
 
+from .. import config
+
 __all__ = ["PathwayConfig", "get_config", "set_license_key", "local_config"]
-
-
-def _env_bool(name: str, default: bool = False) -> bool:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.lower() in ("1", "true", "yes", "on")
 
 
 @dataclass
 class PathwayConfig:
     # mesh/topology (the TPU analog of PATHWAY_THREADS/PROCESSES)
-    mesh_data_axis: int = int(os.environ.get("PATHWAY_TPU_DATA_SHARDS", "0") or 0)
-    mesh_model_axis: int = int(os.environ.get("PATHWAY_TPU_MODEL_SHARDS", "0") or 0)
+    mesh_data_axis: int = field(
+        default_factory=lambda: config.get("parallel.data_shards")
+    )
+    mesh_model_axis: int = field(
+        default_factory=lambda: config.get("parallel.model_shards")
+    )
     # engine
-    commit_duration_ms: int = int(os.environ.get("PATHWAY_COMMIT_DURATION_MS", "100"))
-    terminate_on_error: bool = _env_bool("PATHWAY_TERMINATE_ON_ERROR", True)
-    runtime_typechecking: bool = _env_bool("PATHWAY_RUNTIME_TYPECHECKING", False)
+    commit_duration_ms: int = field(
+        default_factory=lambda: config.get("engine.commit_duration_ms")
+    )
+    terminate_on_error: bool = field(
+        default_factory=lambda: config.get("engine.terminate_on_error")
+    )
+    runtime_typechecking: bool = field(
+        default_factory=lambda: config.get("engine.runtime_typechecking")
+    )
     # persistence
-    persistence_mode: str = os.environ.get("PATHWAY_PERSISTENCE_MODE", "")
-    replay_storage: Optional[str] = os.environ.get("PATHWAY_REPLAY_STORAGE")
-    persistent_storage: Optional[str] = os.environ.get("PATHWAY_PERSISTENT_STORAGE")
-    snapshot_interval_ms: int = int(
-        os.environ.get("PATHWAY_SNAPSHOT_INTERVAL_MS", "60000")
+    persistence_mode: str = field(
+        default_factory=lambda: config.get("persistence.mode")
+    )
+    replay_storage: Optional[str] = field(
+        default_factory=lambda: config.get("persistence.replay_storage") or None
+    )
+    persistent_storage: Optional[str] = field(
+        default_factory=lambda: config.get("persistence.storage") or None
+    )
+    snapshot_interval_ms: int = field(
+        default_factory=lambda: config.get("persistence.snapshot_interval_ms")
     )
     # observability
-    monitoring_server: Optional[str] = os.environ.get("PATHWAY_MONITORING_SERVER")
-    metrics_port: int = int(os.environ.get("PATHWAY_METRICS_PORT", "20000"))
-    metrics_host: str = os.environ.get("PATHWAY_METRICS_HOST", "127.0.0.1")
+    monitoring_server: Optional[str] = field(
+        default_factory=lambda: config.get("observe.monitoring_server") or None
+    )
+    metrics_port: int = field(
+        default_factory=lambda: config.get("observe.metrics_port")
+    )
+    metrics_host: str = field(
+        default_factory=lambda: config.get("observe.metrics_host")
+    )
     # licensing: this framework is fully open — accepted and ignored
-    license_key: Optional[str] = os.environ.get("PATHWAY_LICENSE_KEY")
+    license_key: Optional[str] = field(
+        default_factory=lambda: config.get("license.key") or None
+    )
 
     @property
     def process_id(self) -> int:
-        return int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        return config.get("parallel.process_id")
 
     @property
     def processes(self) -> int:
-        return int(os.environ.get("PATHWAY_PROCESSES", "1"))
+        return config.get("parallel.processes")
 
 
 _config = PathwayConfig()

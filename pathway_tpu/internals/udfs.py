@@ -84,8 +84,10 @@ class DiskCache(CacheStrategy):
 
     def __init__(self, name: Optional[str] = None, directory: Optional[str] = None):
         self.name = name
-        self.directory = directory or os.environ.get(
-            "PATHWAY_PERSISTENT_STORAGE", "./Cache"
+        from .. import config
+
+        self.directory = (
+            directory or config.get("persistence.storage") or "./Cache"
         )
 
     def _path(self, fun: Callable, key: str) -> str:

@@ -32,7 +32,6 @@ compile behavior are the product."""
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -41,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import observe
+from .. import config, observe
 from ..observe import hbm, profile
 from ..robust import retry_call
 from ._params import unbox as _unbox
@@ -74,15 +73,11 @@ _UNSET = object()
 
 
 def decode_step_bucket() -> int:
-    """Decode-step chunk size from ``PATHWAY_DECODE_STEP_BUCKET``
-    (default 8): how many single-token decode steps one compiled chunk
-    dispatch advances.  Shared by the legacy EOS-chunked decode and the
-    continuous engine (serve/decode.py) — ONE knob, one compile shape."""
-    try:
-        c = int(os.environ.get("PATHWAY_DECODE_STEP_BUCKET", "8") or 8)
-    except ValueError:
-        c = 8
-    return max(1, c)
+    """Decode-step chunk size from ``decode.step_bucket`` (default 8,
+    tuner-adjustable): how many single-token decode steps one compiled
+    chunk dispatch advances.  Shared by the legacy EOS-chunked decode and
+    the continuous engine (serve/decode.py) — ONE knob, one compile shape."""
+    return config.get("decode.step_bucket")
 
 
 def decode_spec_k() -> int:
@@ -92,11 +87,7 @@ def decode_spec_k() -> int:
     tokens per round.  ``k <= 1`` is the plain one-token-per-step
     continuous decode; the ceiling keeps the verify forward (an
     ``Ln = k`` attention) from dwarfing the steps it replaces."""
-    try:
-        k = int(os.environ.get("PATHWAY_DECODE_SPEC_K", "0") or 0)
-    except ValueError:
-        k = 0
-    return max(0, min(k, 16))
+    return config.get("decode.spec_k")
 
 
 def decode_kv_quant() -> str:
@@ -104,8 +95,7 @@ def decode_kv_quant() -> str:
     (default, bit-identical to solo decode) or ``int8`` (per-(layer,
     head, channel) stored scales, 2x slots×context at fixed HBM,
     bounded token drift — ops/kv_quant.py)."""
-    raw = os.environ.get("PATHWAY_DECODE_KV_QUANT", "bf16").strip().lower()
-    return "int8" if raw == "int8" else "bf16"
+    return config.get("decode.kv_quant")
 
 
 def decode_draft_source() -> str:
@@ -114,8 +104,7 @@ def decode_draft_source() -> str:
     well runs dry), ``ngram`` (mining only — lanes without a match
     advance one token per round), or ``trunk`` (always the reduced-
     layer draft dispatch)."""
-    raw = os.environ.get("PATHWAY_DECODE_DRAFT", "auto").strip().lower()
-    return raw if raw in ("auto", "ngram", "trunk") else "auto"
+    return config.get("decode.draft")
 
 
 def decode_draft_layers(n_layers: int) -> int:
@@ -123,10 +112,7 @@ def decode_draft_layers(n_layers: int) -> int:
     ``PATHWAY_DECODE_DRAFT_LAYERS`` (default 0 = half the trunk,
     minimum 1): the draft forwards only the FIRST ``D`` blocks of the
     same params — cheap proposals, exactness restored by the verify."""
-    try:
-        d = int(os.environ.get("PATHWAY_DECODE_DRAFT_LAYERS", "0") or 0)
-    except ValueError:
-        d = 0
+    d = config.get("decode.draft_layers")
     if d <= 0:
         d = max(1, n_layers // 2)
     return min(d, n_layers)
@@ -136,7 +122,7 @@ def eos_id_from_env() -> Optional[int]:
     """``PATHWAY_GENERATOR_EOS`` (a token id, e.g. 2 for the tokenizer's
     SEP) — unset/empty means no EOS handling, byte-for-byte the
     pre-EOS decode behavior."""
-    raw = os.environ.get("PATHWAY_GENERATOR_EOS", "").strip()
+    raw = config.get("generator.eos").strip()
     if not raw or raw in ("0", "none", "off"):
         return None
     try:
@@ -214,9 +200,7 @@ class TextGenerator:
 
             kv_cache = prefix_kv_cache_from_env()
         self.kv_cache = kv_cache
-        self._use_kv = os.environ.get("PATHWAY_GENERATOR_KV", "1") not in (
-            "0", "false", "off",
-        )
+        self._use_kv = config.get("generator.kv")
         # HBM ledger (observe/hbm.py): parameter tree bytes
         hbm.track_params("generator", self)
 

@@ -20,6 +20,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import config
+
 __all__ = [
     "available",
     "lib",
@@ -151,7 +153,7 @@ def lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("PATHWAY_TPU_DISABLE_NATIVE", "") not in ("", "0"):
+        if config.get("native.disable"):
             return None
         if not build():
             return None

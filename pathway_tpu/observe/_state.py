@@ -9,13 +9,6 @@ check" the package docstring promises for the disabled path.
 
 from __future__ import annotations
 
-import os
+from .. import config
 
-
-def _truthy(raw, default: bool = True) -> bool:
-    if raw is None:
-        return default
-    return raw.strip().lower() not in ("", "0", "false", "no", "off")
-
-
-enabled = _truthy(os.environ.get("PATHWAY_OBSERVE"))
+enabled = config.get("observe.enabled")

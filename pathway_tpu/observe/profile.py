@@ -47,11 +47,11 @@ as a ``profile`` column.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .. import config
 from . import _state
 from .histogram import LatencyHistogram
 from .recorder import counter, histogram
@@ -65,13 +65,6 @@ __all__ = [
 ]
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)) or default)
-    except ValueError:
-        return default
-
-
 def _stride_of(fraction: float) -> int:
     """Sampled fraction -> deterministic 1-in-N stride (0 = off)."""
     if fraction <= 0.0:
@@ -81,9 +74,7 @@ def _stride_of(fraction: float) -> int:
     return max(1, int(round(1.0 / fraction)))
 
 
-_stride = _stride_of(
-    min(1.0, max(0.0, _env_float("PATHWAY_PROFILE_SAMPLE", 0.25)))
-)
+_stride = _stride_of(config.get("observe.profile_sample"))
 
 _C_DROPPED = counter("pathway_profile_samples_dropped_total")
 

@@ -48,11 +48,11 @@ last-known (stale) document, counted on
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import config
 from .histogram import N_BUCKETS, bucket_bounds_s
 from .recorder import counter, register_provider
 from . import recorder as _recorder
@@ -66,19 +66,6 @@ __all__ = [
     "should_shed",
     "shed_advisory_enabled",
 ]
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)) or default)
-    except ValueError:
-        return default
-
-
-def _truthy(raw: Optional[str], default: bool = True) -> bool:
-    if raw is None:
-        return default
-    return raw.strip().lower() not in ("", "0", "false", "no", "off")
 
 
 _C_EVALS = counter("pathway_slo_evaluations_total")
@@ -215,22 +202,16 @@ def default_specs() -> List[SloSpec]:
         SloSpec(
             "serve_latency",
             "latency",
-            objective=min(
-                0.9999,
-                max(0.5, _env_float("PATHWAY_SLO_LATENCY_OBJECTIVE", 0.99)),
-            ),
+            objective=config.get("observe.slo_latency_objective"),
             hist="pathway_serve_request_seconds",
-            threshold_s=_env_float("PATHWAY_SLO_LATENCY_MS", 500.0) * 1e-3,
+            threshold_s=config.get("observe.slo_latency_ms") * 1e-3,
             shed=True,
             description="serve requests at/under the latency threshold",
         ),
         SloSpec(
             "serve_availability",
             "availability",
-            objective=min(
-                0.9999,
-                max(0.5, _env_float("PATHWAY_SLO_AVAILABILITY", 0.999)),
-            ),
+            objective=config.get("observe.slo_availability"),
             bad="pathway_serve_degraded_total",
             total_hist="pathway_serve_request_seconds",
             shed=True,
@@ -241,7 +222,7 @@ def default_specs() -> List[SloSpec]:
             "latency",
             objective=0.99,
             hist="pathway_generator_ttlt_seconds",
-            threshold_s=_env_float("PATHWAY_SLO_TTLT_MS", 2000.0) * 1e-3,
+            threshold_s=config.get("observe.slo_ttlt_ms") * 1e-3,
             description="decode requests at/under the TTLT threshold",
         ),
     ]
@@ -256,14 +237,12 @@ class SloEngine:
 
     def __init__(self, specs: Optional[List[SloSpec]] = None):
         self.specs = list(specs) if specs is not None else default_specs()
-        self.fast_window_s = max(
-            0.05, _env_float("PATHWAY_SLO_FAST_WINDOW_S", 300.0)
-        )
+        self.fast_window_s = config.get("observe.slo_fast_window_s")
         self.slow_window_s = max(
-            self.fast_window_s, _env_float("PATHWAY_SLO_SLOW_WINDOW_S", 3600.0)
+            self.fast_window_s, config.get("observe.slo_slow_window_s")
         )
-        self.burn_threshold = max(0.1, _env_float("PATHWAY_SLO_BURN", 14.4))
-        self.tick_s = max(0.0, _env_float("PATHWAY_SLO_TICK_S", 1.0))
+        self.burn_threshold = config.get("observe.slo_burn")
+        self.tick_s = config.get("observe.slo_tick_s")
         self._lock = threading.Lock()
         self._rings: Dict[str, List[Tuple[float, int, int]]] = {
             s.name: [] for s in self.specs
@@ -404,7 +383,7 @@ class SloEngine:
 
 _engine_lock = threading.Lock()
 _engine: Optional[SloEngine] = None
-_shed_on = _truthy(os.environ.get("PATHWAY_SLO"))
+_shed_on = config.get("observe.slo")
 
 
 def engine() -> SloEngine:

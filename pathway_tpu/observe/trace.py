@@ -68,7 +68,6 @@ prove that a faulted tracing path degrades to DROPPED spans (counted on
 from __future__ import annotations
 
 import itertools
-import os
 import random
 import threading
 import time
@@ -76,6 +75,7 @@ from collections import OrderedDict
 from contextvars import ContextVar
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .. import config
 from . import _state
 from .recorder import counter, histogram, register_provider
 
@@ -95,26 +95,12 @@ __all__ = [
 ]
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return max(1, int(os.environ.get(name, str(default)) or default))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)) or default)
-    except ValueError:
-        return default
-
-
-_KEEP_CAPACITY = _env_int("PATHWAY_TRACE_KEEP", 256)
-_PENDING_CAPACITY = _env_int("PATHWAY_TRACE_PENDING", 128)
-_MAX_SPANS = _env_int("PATHWAY_TRACE_MAX_SPANS", 192)
-_SLOW_PCT = min(0.9999, max(0.5, _env_float("PATHWAY_TRACE_SLOW_PCT", 0.99)))
+_KEEP_CAPACITY = config.get("observe.trace_keep")
+_PENDING_CAPACITY = config.get("observe.trace_pending")
+_MAX_SPANS = config.get("observe.trace_max_spans")
+_SLOW_PCT = config.get("observe.trace_slow_pct")
 _SLOW_MIN_COUNT = 64
-_sample = min(1.0, max(0.0, _env_float("PATHWAY_TRACE_SAMPLE", 1.0)))
+_sample = config.get("observe.trace_sample")
 
 # the request-level end-to-end latency histogram: observed at rider
 # finish, it is BOTH the tail sampler's "slow" threshold source and the

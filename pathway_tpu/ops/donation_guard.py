@@ -32,10 +32,11 @@ semantics untouched.
 
 from __future__ import annotations
 
-import os
 import threading
 import weakref
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from .. import config
 
 __all__ = [
     "DonationViolation",
@@ -54,9 +55,7 @@ class DonationViolation(RuntimeError):
 
 
 def enabled() -> bool:
-    return os.environ.get("PATHWAY_DONATION_GUARD", "") not in (
-        "", "0", "false", "no",
-    )
+    return config.get("ops.donation_guard")
 
 
 def strict_mode() -> bool:
@@ -64,10 +63,7 @@ def strict_mode() -> bool:
     via ``PATHWAY_DONATION_GUARD_STRICT=1`` / off via ``=0``; defaults
     to on under pytest so a use-after-donate is a red test, never a
     silent garbage read."""
-    flag = os.environ.get("PATHWAY_DONATION_GUARD_STRICT")
-    if flag is not None:
-        return flag not in ("", "0", "false", "no")
-    return "PYTEST_CURRENT_TEST" in os.environ
+    return config.get("ops.donation_guard_strict")
 
 
 # id(buffer) -> (site, finalizer): site-attributed poison registry.  A

@@ -19,10 +19,11 @@ buckets) — and tunable via ``PATHWAY_RECOMPILE_LIMIT``.
 
 from __future__ import annotations
 
-import os
 import threading
 import warnings
 from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+from .. import config
 
 __all__ = [
     "RecompileBudgetExceeded",
@@ -43,17 +44,14 @@ class RecompileBudgetExceeded(RuntimeError):
 
 
 def _default_limit() -> int:
-    return int(os.environ.get("PATHWAY_RECOMPILE_LIMIT", "128"))
+    return config.get("ops.recompile_limit")
 
 
 def strict_mode() -> bool:
     """Fail (raise) instead of warn: explicitly via
     ``PATHWAY_RECOMPILE_STRICT=1`` / off via ``=0``; defaults to on under
     pytest so a recompile leak is a red test, never a silent slowdown."""
-    flag = os.environ.get("PATHWAY_RECOMPILE_STRICT")
-    if flag is not None:
-        return flag not in ("", "0", "false", "no")
-    return "PYTEST_CURRENT_TEST" in os.environ
+    return config.get("ops.recompile_strict")
 
 
 class RecompileTripwire:

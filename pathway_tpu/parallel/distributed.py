@@ -48,6 +48,8 @@ from typing import Optional
 
 import jax
 
+from .. import config
+
 __all__ = [
     "topology_from_env",
     "maybe_initialize",
@@ -68,11 +70,11 @@ _initialized = False
 def topology_from_env() -> tuple[int, int, Optional[str]]:
     """(processes, process_id, coordinator_address) from PATHWAY_* env
     (reference: Config::from_env, src/engine/dataflow/config.rs:88-121)."""
-    processes = int(os.environ.get("PATHWAY_PROCESSES", "1") or 1)
-    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
-    addr = os.environ.get("PATHWAY_COORDINATOR_ADDRESS") or None
+    processes = config.get("parallel.processes")
+    pid = config.get("parallel.process_id")
+    addr = config.get("parallel.coordinator_address") or None
     if addr is None:
-        first_port = os.environ.get("PATHWAY_FIRST_PORT")
+        first_port = config.get("parallel.first_port")
         if first_port:
             addr = f"127.0.0.1:{first_port}"
     return processes, pid, addr

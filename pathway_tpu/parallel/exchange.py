@@ -42,7 +42,6 @@ from __future__ import annotations
 
 import errno
 import hmac
-import os
 import pickle
 import secrets
 import socket
@@ -51,7 +50,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from .. import observe
+from .. import config, observe
 from ..robust import RetryPolicy, inject
 
 __all__ = ["ExchangePlane", "get_plane", "close_plane"]
@@ -75,11 +74,11 @@ _SEND_RETRY = RetryPolicy(attempts=3, base_delay_s=0.005, max_delay_s=0.1)
 
 
 def _hb_interval() -> float:
-    return float(os.environ.get("PATHWAY_EXCHANGE_HEARTBEAT", "2.0"))
+    return config.get("parallel.exchange_heartbeat_s")
 
 
 def _hb_timeout() -> float:
-    return float(os.environ.get("PATHWAY_EXCHANGE_HEARTBEAT_TIMEOUT", "8.0"))
+    return config.get("parallel.exchange_heartbeat_timeout_s")
 
 
 class PeerLost(RuntimeError):
@@ -725,12 +724,10 @@ def _advertise_host() -> str:
     PATHWAY_EXCHANGE_HOST overrides; otherwise use the local interface that
     routes toward the cluster coordinator (loopback for single-host
     clusters, the reachable NIC for multi-host ones)."""
-    import os
-
-    override = os.environ.get("PATHWAY_EXCHANGE_HOST")
+    override = config.get("parallel.exchange_host")
     if override:
         return override
-    coord = os.environ.get("PATHWAY_COORDINATOR_ADDRESS") or ""
+    coord = config.get("parallel.coordinator_address")
     host = coord.rsplit(":", 1)[0] if ":" in coord else coord
     if host in ("", "localhost", "127.0.0.1", "0.0.0.0"):
         return "127.0.0.1"

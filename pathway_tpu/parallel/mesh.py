@@ -9,13 +9,14 @@ devices (DCN between hosts, ICI within a slice).
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import config
 
 __all__ = [
     "make_mesh",
@@ -49,9 +50,9 @@ def make_mesh(
     Defaults: all devices on the data axis (index sharding), model axis 1.
     Env overrides: PATHWAY_TPU_DATA_SHARDS / PATHWAY_TPU_MODEL_SHARDS."""
     devices = list(devices if devices is not None else jax.devices())
-    n_model = int(os.environ.get("PATHWAY_TPU_MODEL_SHARDS", "0") or 0) or n_model
+    n_model = config.get("parallel.model_shards") or n_model
     if n_data is None:
-        n_data = int(os.environ.get("PATHWAY_TPU_DATA_SHARDS", "0") or 0) or (
+        n_data = config.get("parallel.data_shards") or (
             len(devices) // n_model
         )
     needed = n_data * n_model

@@ -28,13 +28,12 @@ chips is a capacity-planning knob, not an error.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import List, Optional, Sequence
 
 import jax
 
-from .. import observe
+from .. import config, observe
 from ..observe import trace
 from ..robust import CircuitBreaker
 
@@ -42,13 +41,8 @@ __all__ = ["ShardGroup", "serve_shards"]
 
 
 def serve_shards(default: int = 0) -> int:
-    """Shard count from ``PATHWAY_SERVE_SHARDS`` (0 = every local
-    device)."""
-    try:
-        n = int(os.environ.get("PATHWAY_SERVE_SHARDS", str(default)) or default)
-    except ValueError:
-        n = default
-    return max(0, n)
+    """Shard count from ``serve.shards`` (0 = every local device)."""
+    return config.get("serve.shards", fallback=default)
 
 
 class ShardGroup:

@@ -24,9 +24,10 @@ of the exception propagating (ops/retrieve_rerank.py).
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Optional
+
+from .. import config
 
 __all__ = ["Deadline", "DeadlineExceeded"]
 
@@ -64,9 +65,9 @@ class Deadline:
 
     @classmethod
     def from_env(cls) -> Optional["Deadline"]:
-        """Per-serve default budget from ``PATHWAY_SERVE_DEADLINE_MS``;
+        """Per-serve default budget from ``serve.deadline_ms``;
         None (no deadline) when unset or <= 0."""
-        ms = float(os.environ.get("PATHWAY_SERVE_DEADLINE_MS", "0") or 0)
+        ms = config.get("serve.deadline_ms")
         return cls.after_ms(ms) if ms > 0 else None
 
     # -- queries ------------------------------------------------------------
@@ -99,9 +100,6 @@ class Deadline:
 
 def stage1_fraction() -> float:
     """Share of a serve budget granted to stage 1 (retrieval); stage 2
-    runs on whatever remains of the parent budget.  Clamped to (0, 1]."""
-    try:
-        frac = float(os.environ.get("PATHWAY_SERVE_STAGE1_FRACTION", "0.6"))
-    except ValueError:
-        frac = 0.6
-    return min(1.0, max(0.05, frac))
+    runs on whatever remains of the parent budget.  Clamped to (0, 1]
+    by the registry's declared bounds."""
+    return config.get("serve.stage1_fraction")

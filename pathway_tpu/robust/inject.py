@@ -57,7 +57,6 @@ fetch/maintenance paths.
 from __future__ import annotations
 
 import contextlib
-import os
 import random
 import threading
 import time
@@ -261,7 +260,12 @@ def load_env(value: Optional[str] = None) -> List[str]:
     the list of armed site names (tests use it to assert parsing)."""
     global _env_loaded
     _env_loaded = True
-    raw = value if value is not None else os.environ.get("PATHWAY_FAULTS", "")
+    if value is not None:
+        raw = value
+    else:
+        from .. import config
+
+        raw = config.get("robust.faults")
     armed_sites: List[str] = []
     for entry in raw.replace(",", ";").split(";"):
         entry = entry.strip()

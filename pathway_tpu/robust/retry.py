@@ -27,13 +27,12 @@ lock-clean").
 from __future__ import annotations
 
 import logging
-import os
 import random
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Set, Tuple, Type
 
-from .. import observe
+from .. import config, observe
 from . import inject
 from .deadline import Deadline, DeadlineExceeded
 
@@ -102,19 +101,15 @@ class RetryPolicy:
 
     @classmethod
     def from_env(cls, site: str) -> "RetryPolicy":
-        """Global knobs ``PATHWAY_RETRY_{ATTEMPTS,BASE_MS,MAX_MS,SEED}``
+        """Global knobs ``robust.retry_{attempts,base_ms,max_ms,seed}``
         with per-site attempt overrides ``PATHWAY_RETRY_ATTEMPTS_<SITE>``
-        (site upper-cased, dots → underscores)."""
-        env = os.environ
-        site_key = site.upper().replace(".", "_").replace("-", "_")
-        attempts = env.get(f"PATHWAY_RETRY_ATTEMPTS_{site_key}") or env.get(
-            "PATHWAY_RETRY_ATTEMPTS", "3"
-        )
+        (site upper-cased, dots → underscores — the registry's
+        ``get_site`` resolution)."""
         return cls(
-            attempts=int(attempts),
-            base_delay_s=float(env.get("PATHWAY_RETRY_BASE_MS", "5")) * 1e-3,
-            max_delay_s=float(env.get("PATHWAY_RETRY_MAX_MS", "200")) * 1e-3,
-            seed=int(env.get("PATHWAY_RETRY_SEED", "0")),
+            attempts=config.get_site("robust.retry_attempts", site),
+            base_delay_s=config.get("robust.retry_base_ms") * 1e-3,
+            max_delay_s=config.get("robust.retry_max_ms") * 1e-3,
+            seed=config.get("robust.retry_seed"),
         )
 
 
@@ -221,17 +216,16 @@ class CircuitBreaker:
         failure_threshold: Optional[int] = None,
         reset_s: Optional[float] = None,
     ):
-        env = os.environ
         self.name = name
         self.failure_threshold = int(
             failure_threshold
             if failure_threshold is not None
-            else env.get("PATHWAY_BREAKER_THRESHOLD", "5")
+            else config.get("robust.breaker_threshold")
         )
         self.reset_s = float(
             reset_s
             if reset_s is not None
-            else env.get("PATHWAY_BREAKER_RESET_S", "30")
+            else config.get("robust.breaker_reset_s")
         )
         self._lock = threading.Lock()
         self._failures = 0  # consecutive

@@ -18,13 +18,16 @@ cascade's listwise LLM rerank stage and the chat/QA path.
 
 from .decode import ContinuousDecoder, DecodeResult, decode_slots
 from .scheduler import ServeScheduler, SharedBatcher, coalesce_window_s, max_batch_queries
+from .tuner import Tuner, tuner_from_env
 
 __all__ = [
     "ContinuousDecoder",
     "DecodeResult",
     "ServeScheduler",
     "SharedBatcher",
+    "Tuner",
     "coalesce_window_s",
     "decode_slots",
     "max_batch_queries",
+    "tuner_from_env",
 ]

@@ -92,7 +92,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import observe
+from .. import config, observe
 from ..observe import hbm, trace
 from ..robust import (
     Deadline,
@@ -108,15 +108,11 @@ __all__ = ["ContinuousDecoder", "DecodeResult", "decode_slots"]
 
 
 def decode_slots() -> int:
-    """Slot-pool size from ``PATHWAY_DECODE_SLOTS`` (default 8): the
-    max number of requests decoding concurrently in one step dispatch.
+    """Slot-pool size from ``decode.slots`` (default 8): the max
+    number of requests decoding concurrently in one step dispatch.
     More slots = more sharing per chunk but a larger resident pool
     (``slots × n_layers × max_len × d_model`` K/V elements × 2)."""
-    try:
-        n = int(os.environ.get("PATHWAY_DECODE_SLOTS", "8") or 8)
-    except ValueError:
-        n = 8
-    return max(1, n)
+    return config.get("decode.slots")
 
 
 # queue wait (enqueue → slot join) + per-phase device round trips
@@ -277,12 +273,7 @@ class ContinuousDecoder(_CoalescerBase):
         # traffic scale with the width, and a request that does not fit
         # (prompt + budget > width) simply serves solo
         if kv_width is None:
-            try:
-                kv_width = int(
-                    os.environ.get("PATHWAY_DECODE_KV_WIDTH", "0") or 0
-                )
-            except ValueError:
-                kv_width = 0
+            kv_width = config.get("decode.kv_width")
         self._T = min(cfg.max_len, kv_width) if kv_width else cfg.max_len
         H = cfg.n_heads
         hd = cfg.d_model // H

@@ -64,7 +64,6 @@ from __future__ import annotations
 
 import contextlib
 import inspect
-import os
 import threading
 import time
 from collections import deque
@@ -72,7 +71,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import observe
+from .. import config, observe
 from ..cache import query_key, result_cache_from_env
 from ..observe import slo as slo_mod
 from ..observe import trace
@@ -87,28 +86,20 @@ __all__ = [
 
 
 def coalesce_window_s() -> float:
-    """Coalescing window from ``PATHWAY_SERVE_COALESCE_US`` (default
-    2000 µs); 0 disables waiting (batches still form from whatever is
-    queued when the scheduler thread comes around)."""
-    try:
-        us = float(os.environ.get("PATHWAY_SERVE_COALESCE_US", "2000") or 0)
-    except ValueError:
-        us = 2000.0
-    return max(0.0, us) * 1e-6
+    """Coalescing window from ``serve.coalesce_us`` (default 2000 µs,
+    tuner-adjustable); 0 disables waiting (batches still form from
+    whatever is queued when the scheduler thread comes around)."""
+    return config.get("serve.coalesce_us") * 1e-6
 
 
 def max_batch_queries() -> int:
-    """Per-batch cap on UNIQUE queries from ``PATHWAY_SERVE_MAX_BATCH``
+    """Per-batch cap on UNIQUE queries from ``serve.max_batch``
     (default 64 — the second-largest stage-1 batch bucket, so one
     coalesced dispatch never jumps to a cold compile shape under a
     traffic spike).  The cap bounds the DEVICE batch, not admissions:
     duplicate queries ride a batch for free, so hot traffic packs many
     more requests than ``max_batch`` into one bucket-aligned dispatch."""
-    try:
-        n = int(os.environ.get("PATHWAY_SERVE_MAX_BATCH", "64") or 64)
-    except ValueError:
-        n = 64
-    return max(1, n)
+    return config.get("serve.max_batch")
 
 
 # time-in-queue: enqueue → handoff of the shared batch to the waiters
@@ -270,6 +261,10 @@ class _CoalescerBase:
         autostart: bool = True,
     ):
         self.name = name or f"serve-{observe.next_id()}"
+        # window_us=None -> LIVE registry read per batch window: the
+        # online tuner (serve/tuner.py) adjusts ``serve.coalesce_us``
+        # while the batcher runs; an explicit window_us pins it
+        self._window_pinned = window_us is not None
         self._window_s = (
             coalesce_window_s() if window_us is None else max(0.0, window_us) * 1e-6
         )
@@ -433,6 +428,8 @@ class _CoalescerBase:
             # the raw queued count is past it — those riders dedup in
             while self._running and self._queued_unique_locked() < self._max_batch:
                 now = time.perf_counter_ns()
+                if not self._window_pinned:
+                    self._window_s = coalesce_window_s()
                 end_s = (anchor_ns - now) * 1e-9 + self._window_s
                 for r in self._queue:
                     if r.deadline is not None:

@@ -80,9 +80,9 @@ class TpuChat(BaseChat):
         )
         gen = self._generator
         if continuous is None:
-            continuous = os.environ.get(
-                "PATHWAY_CHAT_CONTINUOUS", "0"
-            ) not in ("0", "", "false", "off")
+            from ... import config
+
+            continuous = config.get("chat.continuous")
         self._decoder = decoder
         if decoder is None and continuous:
             from ...serve import ContinuousDecoder

@@ -8,7 +8,6 @@ from __future__ import annotations
 import asyncio
 import inspect
 import json
-import os
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
@@ -212,9 +211,9 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         # packed cross-encoder dispatch through a SharedBatcher fronting
         # the model's submit/complete contract
         if coalesce_rerank is None:
-            coalesce_rerank = os.environ.get(
-                "PATHWAY_QA_RERANK_COALESCE", ""
-            ).lower() in ("1", "true", "yes", "on")
+            from ... import config
+
+            coalesce_rerank = config.get("qa.rerank_coalesce")
         self._rerank_batcher = None
         if (
             coalesce_rerank

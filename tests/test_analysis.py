@@ -1870,6 +1870,7 @@ def test_analysis_cache_per_family_keys(tmp_path, monkeypatch):
     (tree / "a.py").write_text(
         textwrap.dedent(
             """
+            import os
             import threading
 
             import jax
@@ -1885,6 +1886,8 @@ def test_analysis_cache_per_family_keys(tmp_path, monkeypatch):
                 def f(self, q):
                     with self._alock:
                         return _score(q)
+
+            FIXTURE_KNOB = os.environ.get("PATHWAY_FIXTURE_KNOB", "0")
             """
         )
     )
@@ -1943,6 +1946,42 @@ def test_analysis_cache_per_family_keys(tmp_path, monkeypatch):
     )
     assert len(parses) == 4, "fully-warm run re-parsed a module"
     assert [f.__dict__ for f in third] == [f.__dict__ for f in second]
+
+    # adding the 6th family (knob-discipline, ISSUE 17): modules
+    # re-parse once more for the new family, the five cached families
+    # are not re-run, and the new family finds the fixture's raw read
+    from pathway_tpu.analysis.knob_discipline import KnobDisciplineRule
+
+    five_rules = fresh_four() + [ValueFlowRule()]
+    runs6 = {rule.name: 0 for rule in five_rules}
+    for rule in five_rules:
+        orig_run = rule.run
+        rule.run = (
+            lambda ctx, _r=rule, _o=orig_run: (
+                runs6.__setitem__(_r.name, runs6[_r.name] + 1), _o(ctx)
+            )
+        )
+    fourth = analyze_paths(
+        [str(tmp_path / "pathway_tpu")],
+        rules=five_rules + [KnobDisciplineRule()],
+    )
+    assert len(parses) == 6, "adding the 6th family must re-parse both"
+    assert runs6 == {name: 0 for name in runs6}, (
+        f"cached families re-ran after adding knob-discipline: {runs6}"
+    )
+    knob = [f for f in fourth if f.rule == "knob-discipline"]
+    assert any("PATHWAY_FIXTURE_KNOB" in f.message for f in knob)
+    for rule, cold_findings in cold_by_rule.items():
+        got = [f.__dict__ for f in fourth if f.rule == rule]
+        assert got == cold_findings, f"{rule} findings drifted via cache"
+
+    # fully warm at six families: nothing parses, bit-identical
+    fifth = analyze_paths(
+        [str(tmp_path / "pathway_tpu")],
+        rules=fresh_four() + [ValueFlowRule(), KnobDisciplineRule()],
+    )
+    assert len(parses) == 6, "fully-warm six-family run re-parsed"
+    assert [f.__dict__ for f in fifth] == [f.__dict__ for f in fourth]
 
 
 # -- --check-pragmas (stale waivers) ----------------------------------------
@@ -2010,6 +2049,7 @@ def test_sarif_output_matches_golden(tmp_path, capsys):
     fixture.write_text(
         textwrap.dedent(
             """
+            import os
             import threading
             from functools import partial
 
@@ -2035,6 +2075,9 @@ def test_sarif_output_matches_golden(tmp_path, capsys):
             def h(buf, upd):
                 out = _scatter(buf, upd)
                 return np.asarray(buf)
+
+            def k():
+                return os.environ.get("PATHWAY_FIXTURE_KNOB", "0")
             """
         )
     )
@@ -2178,3 +2221,313 @@ def test_repo_wide_zero_unsuppressed_findings(repo_analysis):
     # grows, a new allowance was added — make sure it was reviewed
     suppressed = [f for f in findings if f.suppressed]
     assert all(f.reason for f in suppressed)
+
+
+# -- knob-discipline (ISSUE 17) ----------------------------------------------
+
+def _knob_findings(src: str, path: str = "fixtures/mod.py"):
+    from pathway_tpu.analysis.knob_discipline import KnobDisciplineRule
+
+    return [
+        f
+        for f in analyze_source(
+            textwrap.dedent(src), path, rules=[KnobDisciplineRule()]
+        )
+        if f.rule == "knob-discipline"
+    ]
+
+
+def test_knob_raw_read_flagged():
+    """Every raw-read spelling is a finding: .get, getenv, subscript,
+    and membership tests against os.environ."""
+    live = _live(
+        _knob_findings(
+            """
+            import os
+            from os import getenv
+
+            A = os.environ.get("PATHWAY_FIXTURE_A", "0")
+            B = os.getenv("PATHWAY_FIXTURE_B")
+            C = getenv("PATHWAY_FIXTURE_C")
+            D = os.environ["PATHWAY_FIXTURE_D"]
+            E = "PATHWAY_FIXTURE_E" in os.environ
+            """
+        ),
+        "knob-discipline",
+    )
+    flagged = {f.message.split("`")[1] for f in live if "raw env read" in f.message}
+    assert {
+        'os.environ.get(\'PATHWAY_FIXTURE_A\')',
+        'os.getenv(\'PATHWAY_FIXTURE_B\')',
+        'getenv(\'PATHWAY_FIXTURE_C\')',
+        "os.environ['PATHWAY_FIXTURE_D']",
+        "'PATHWAY_FIXTURE_E' in os.environ",
+    } <= flagged, flagged
+
+
+def test_knob_raw_read_environ_alias_resolved():
+    live = _live(
+        _knob_findings(
+            """
+            import os
+
+            env = os.environ
+            X = env.get("PATHWAY_FIXTURE_ALIAS", "1")
+            """
+        ),
+        "knob-discipline",
+    )
+    assert any(
+        "raw env read" in f.message and "PATHWAY_FIXTURE_ALIAS" in f.message
+        for f in live
+    )
+
+
+def test_knob_helper_wrapped_read_flagged():
+    """A local helper forwarding its parameter into os.environ is a
+    trench coat — calling it with a PATHWAY_* literal is a raw read."""
+    live = _live(
+        _knob_findings(
+            """
+            import os
+
+            def _env_int(name, default):
+                try:
+                    return int(os.environ.get(name, str(default)))
+                except ValueError:
+                    return default
+
+            LIMIT = _env_int("PATHWAY_FIXTURE_LIMIT", 8)
+            """
+        ),
+        "knob-discipline",
+    )
+    assert any(
+        "raw env read" in f.message and "PATHWAY_FIXTURE_LIMIT" in f.message
+        for f in live
+    )
+
+
+def test_knob_raw_read_serve_path_escalates():
+    live = _live(
+        _knob_findings(
+            """
+            # pathway: serve-path
+            import os
+
+            def dispatch(q):
+                window = float(os.environ.get("PATHWAY_FIXTURE_WIN", "2000"))
+                return q, window
+            """
+        ),
+        "knob-discipline",
+    )
+    assert any("serve-path function" in f.message for f in live), [
+        f.message for f in live
+    ]
+
+
+def test_knob_raw_read_lock_body_escalates():
+    live = _live(
+        _knob_findings(
+            """
+            import os
+            import threading
+
+            _lock = threading.Lock()
+
+            def f():
+                with _lock:
+                    return os.environ.get("PATHWAY_FIXTURE_LOCKED", "0")
+            """
+        ),
+        "knob-discipline",
+    )
+    assert any("inside a lock body" in f.message for f in live), [
+        f.message for f in live
+    ]
+
+
+def test_knob_undeclared_env_flagged():
+    """A PATHWAY_* literal no declaration covers is a finding even
+    without a raw read (e.g. a doc/constant reference to a knob that
+    does not exist)."""
+    live = _live(
+        _knob_findings(
+            """
+            KNOB = "PATHWAY_FIXTURE_NOWHERE"
+            """
+        ),
+        "knob-discipline",
+    )
+    assert any(
+        "undeclared knob `PATHWAY_FIXTURE_NOWHERE`" in f.message
+        for f in live
+    )
+
+
+def test_knob_undeclared_config_key_flagged():
+    live = _live(
+        _knob_findings(
+            """
+            from pathway_tpu import config
+
+            X = config.get("serve.not_a_real_knob")
+            """
+        ),
+        "knob-discipline",
+    )
+    assert any(
+        "config key `serve.not_a_real_knob` is not declared" in f.message
+        for f in live
+    )
+
+
+def test_knob_declared_reads_stay_quiet():
+    """config.get on declared keys + declared env names in strings are
+    clean — the registry is the one sanctioned spelling."""
+    live = _live(
+        _knob_findings(
+            """
+            from pathway_tpu import config
+
+            W = config.get("serve.coalesce_us")
+            B = config.get("serve.max_batch")
+            NAME = "PATHWAY_SERVE_COALESCE_US"
+            SITE = config.get_site("robust.retry_attempts", "FIXTURE")
+            """
+        ),
+        "knob-discipline",
+    )
+    assert live == [], [f.format() for f in live]
+
+
+def test_knob_site_prefix_family_quiet():
+    """PATHWAY_RETRY_ATTEMPTS_<SITE> names are covered by the declared
+    site prefix, not per-site declarations."""
+    live = _live(
+        _knob_findings(
+            """
+            NAME = "PATHWAY_RETRY_ATTEMPTS_EXCHANGE"
+            """
+        ),
+        "knob-discipline",
+    )
+    assert live == [], [f.format() for f in live]
+
+
+def test_knob_registry_module_exempt_and_dead_knob(tmp_path):
+    """The module making ``_knob`` declarations IS the registry: its own
+    environ reads are exempt, and its declarations are checked for
+    liveness against the analyzed tree's config.get references."""
+    from pathway_tpu.analysis.knob_discipline import KnobDisciplineRule
+
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "registry.py").write_text(
+        textwrap.dedent(
+            """
+            import os
+
+            def _knob(key, env, kind, default, doc, **kw):
+                return os.environ.get(env)
+
+            _knob("fix.live", "PATHWAY_FIXTURE_LIVE", "int", 1, "read below")
+            _knob("fix.dead", "PATHWAY_FIXTURE_DEAD", "int", 1, "never read")
+            """
+        )
+    )
+    (tree / "reader.py").write_text(
+        textwrap.dedent(
+            """
+            from . import config
+
+            X = config.get("fix.live")
+            """
+        )
+    )
+    findings = [
+        f
+        for f in analyze_paths(
+            [str(tree)], rules=[KnobDisciplineRule()]
+        )
+        if f.rule == "knob-discipline" and not f.suppressed
+    ]
+    # the registry module's own os.environ.get is NOT a raw-read finding
+    assert not any("raw env read" in f.message for f in findings)
+    dead = [f for f in findings if "dead knob" in f.message]
+    assert len(dead) == 1 and "`fix.dead`" in dead[0].message, [
+        f.format() for f in findings
+    ]
+    assert not any("`fix.live`" in f.message for f in dead)
+
+
+def test_knob_docstring_mention_quiet():
+    live = _live(
+        _knob_findings(
+            '''
+            """Module doc: the old PATHWAY_FIXTURE_HISTORIC knob is gone."""
+
+            X = 1
+            '''
+        ),
+        "knob-discipline",
+    )
+    assert live == [], [f.format() for f in live]
+
+
+def test_knob_pragma_suppresses():
+    findings = _knob_findings(
+        """
+        import os
+
+        X = os.environ.get("PATHWAY_SERVE_COALESCE_US")  # pathway: allow(knob-discipline): fixture — reviewed
+        """
+    )
+    assert findings and all(f.suppressed for f in findings)
+    assert all(f.reason for f in findings)
+
+
+def test_knob_waivers_mirror_matches_pragmas(repo_analysis):
+    """Satellite gate (ISSUE 17): ``DECLARED_KNOB_WAIVERS`` and in-tree
+    ``allow(knob-discipline)`` pragmas mirror each other — every
+    suppressed knob finding has a declared waiver naming its knob, and
+    every declared waiver still covers a live suppression.  The tree
+    currently needs ZERO of either; this keeps both lists honest the
+    day one appears."""
+    import re as _re
+
+    from pathway_tpu.analysis.knob_discipline import (
+        DECLARED_KNOB_WAIVERS,
+        waiver_for,
+    )
+
+    findings, _pragmas = repo_analysis
+    suppressed = [
+        f for f in findings if f.rule == "knob-discipline" and f.suppressed
+    ]
+    unmirrored = []
+    matched = set()
+    for f in suppressed:
+        names = _re.findall(
+            r"(PATHWAY_[A-Z0-9_]+|[a-z][a-z0-9_]*(?:\.[a-z][a-z0-9_]*)+)",
+            f.message,
+        )
+        hits = [n for n in names if waiver_for(f.path, n)]
+        if not hits:
+            unmirrored.append(f.format())
+        norm = f.path.replace(os.sep, "/")
+        matched.update(
+            (suffix, waived)
+            for (suffix, waived) in DECLARED_KNOB_WAIVERS
+            if waived in hits and norm.endswith(suffix)
+        )
+    assert unmirrored == [], (
+        "suppressed knob-discipline findings with no DECLARED_KNOB_WAIVERS "
+        f"entry (add the reviewed mirror): {unmirrored}"
+    )
+    stale = sorted(set(DECLARED_KNOB_WAIVERS) - matched)
+    assert stale == [], (
+        "DECLARED_KNOB_WAIVERS entries with no matching suppression "
+        f"(delete the stale mirror): {stale}"
+    )

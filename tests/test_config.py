@@ -1,0 +1,283 @@
+"""The declarative knob registry (ISSUE 17): one declaration per
+PATHWAY_* env, typed cached reads, clamp-and-log-once on garbage, a
+single bool convention, and the static/dynamic mutability split the
+tuner's veto rides on.
+
+The regression heart is ``test_documented_defaults_pinned``: every
+knob's declared default is asserted against a CLEAN environment, so a
+default drifting (or a declaration changing type) fails here before it
+ships a silently different behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from pathway_tpu import config
+from pathway_tpu.config import StaticKnobError, UnknownKnobError
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    """Strip every PATHWAY_* env and tuner override so each test reads
+    declared defaults unless it sets its own."""
+    import os
+
+    for name in list(os.environ):
+        if name.startswith("PATHWAY_"):
+            monkeypatch.delenv(name)
+    config.clear_overrides()
+    yield
+    config.clear_overrides()
+
+
+def test_documented_defaults_pinned():
+    """Every declared knob returns its declared default on a clean env.
+    ``auto_pytest`` knobs are the exception by design: unset means "on
+    under pytest", and this suite runs under pytest."""
+    for knob in config.knobs():
+        got = config.get(knob.key)
+        if knob.auto_pytest:
+            assert got is True, f"{knob.key}: auto_pytest unset under pytest"
+        else:
+            assert got == knob.default, (
+                f"{knob.key} ({knob.env}): default drifted — "
+                f"declared {knob.default!r}, got {got!r}"
+            )
+
+
+def test_every_declaration_is_complete():
+    """Structural lint over the registry itself: docs non-empty, kinds
+    legal, enum choices present, bounds ordered, dynamic knobs numeric
+    (the tuner's step arithmetic assumes it)."""
+    assert len(config.knobs()) >= 70
+    for knob in config.knobs():
+        assert knob.doc.strip(), f"{knob.key}: empty doc"
+        assert knob.kind in ("bool", "int", "float", "str", "enum"), knob.key
+        if knob.kind == "enum":
+            assert knob.choices, f"{knob.key}: enum without choices"
+            assert knob.default in knob.choices, knob.key
+        if knob.lo is not None and knob.hi is not None:
+            assert knob.lo <= knob.hi, knob.key
+        if knob.mutability == config.DYNAMIC:
+            assert knob.kind in ("int", "float"), (
+                f"{knob.key}: dynamic knobs must be numeric"
+            )
+
+
+# -- the one bool convention -------------------------------------------------
+
+@pytest.mark.parametrize("raw,expect", [
+    ("1", True), ("true", True), ("TRUE", True), ("yes", True),
+    ("on", True), ("On", True),
+    ("0", False), ("", False), ("false", False), ("False", False),
+    ("no", False), ("off", False), ("OFF", False),
+])
+def test_bool_convention_unified(monkeypatch, raw, expect):
+    """One spelling set for every bool knob — including the knobs that
+    historically used `not in ("0","","false","off")` (chat.continuous)
+    or `in ("1","true","yes","on")` (qa.rerank_coalesce) conventions."""
+    for key in ("cache.enabled", "chat.continuous", "qa.rerank_coalesce",
+                "native.disable", "generator.kv", "tuner.enabled"):
+        knob = config.registry()[key]
+        monkeypatch.setenv(knob.env, raw)
+        assert config.get(key) is expect, (key, raw)
+
+
+def test_bool_garbage_degrades_to_default(monkeypatch):
+    monkeypatch.setenv("PATHWAY_CACHE", "maybe?")
+    assert config.get("cache.enabled") is True  # declared default
+    monkeypatch.setenv("PATHWAY_CACHE_EMBED", "42x")
+    assert config.get("cache.embed") is False
+
+
+# -- poisoned env: the unvalidated-parse crash class -------------------------
+
+def test_poisoned_float_never_raises(monkeypatch):
+    """The crash class this PR closes: ``float(os.environ.get(...))``
+    at cache/store.py:66 raised ValueError mid-serve on a poisoned env.
+    Through the registry it degrades to the declared default."""
+    monkeypatch.setenv("PATHWAY_CACHE_RESULT_TTL_S", "sixty")
+    assert config.get("cache.result_ttl_s") == 60.0
+    monkeypatch.setenv("PATHWAY_SERVE_COALESCE_US", "2,000")
+    assert config.get("serve.coalesce_us") == 2000.0
+
+
+def test_poisoned_int_never_raises(monkeypatch):
+    monkeypatch.setenv("PATHWAY_CACHE_RESULT_BYTES", "32MB")
+    assert config.get("cache.result_bytes") == 32 << 20
+    monkeypatch.setenv("PATHWAY_RECOMPILE_LIMIT", "lots")
+    assert config.get("ops.recompile_limit") == 128
+
+
+def test_poisoned_env_on_constructed_tiers(monkeypatch):
+    """End to end: a poisoned env must not fail tier construction."""
+    monkeypatch.setenv("PATHWAY_CACHE_RESULT_TTL_S", "NaNope")
+    monkeypatch.setenv("PATHWAY_CACHE_RESULT_BYTES", "huge")
+    from pathway_tpu.cache.result import ResultCache
+
+    tier = ResultCache()
+    assert tier._tier.max_bytes == 32 << 20
+    assert tier._tier.ttl_s == 60.0
+
+
+def test_out_of_bounds_clamps(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SERVE_COALESCE_US", "999999999")
+    assert config.get("serve.coalesce_us") == 100000.0
+    monkeypatch.setenv("PATHWAY_DECODE_STEP_BUCKET", "-3")
+    assert config.get("decode.step_bucket") == 1
+    monkeypatch.setenv("PATHWAY_TRACE_SAMPLE", "7.5")
+    assert config.get("observe.trace_sample") == 1.0
+
+
+def test_enum_garbage_degrades(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DECODE_KV_QUANT", "fp4")
+    assert config.get("decode.kv_quant") == "bf16"
+    monkeypatch.setenv("PATHWAY_FORWARD_QUANT", "INT8")  # case-folded
+    assert config.get("forward.quant") == "int8"
+
+
+def test_warn_once_per_poison(monkeypatch, caplog):
+    import logging
+
+    monkeypatch.setenv("PATHWAY_CACHE_KV_TTL_S", "forever")
+    config._warned.discard("num:PATHWAY_CACHE_KV_TTL_S:forever")
+    with caplog.at_level(logging.WARNING):
+        for _ in range(5):
+            config.get("cache.kv_ttl_s")
+    hits = [r for r in caplog.records if "PATHWAY_CACHE_KV_TTL_S" in r.getMessage()]
+    assert len(hits) == 1, "clamp warning must log once, not per read"
+
+
+# -- read-path semantics -----------------------------------------------------
+
+def test_cached_reparse_on_env_change(monkeypatch):
+    assert config.get("serve.max_batch") == 64
+    monkeypatch.setenv("PATHWAY_SERVE_MAX_BATCH", "128")
+    assert config.get("serve.max_batch") == 128
+    monkeypatch.delenv("PATHWAY_SERVE_MAX_BATCH")
+    assert config.get("serve.max_batch") == 64
+
+
+def test_fallback_for_caller_default_knobs(monkeypatch):
+    assert config.get("serve.shards", fallback=4) == 4
+    monkeypatch.setenv("PATHWAY_SERVE_SHARDS", "2")
+    assert config.get("serve.shards", fallback=4) == 2
+
+
+def test_get_site_family(monkeypatch):
+    assert config.get_site("robust.retry_attempts", "cache.get") == 3
+    monkeypatch.setenv("PATHWAY_RETRY_ATTEMPTS_CACHE_GET", "7")
+    assert config.get_site("robust.retry_attempts", "cache.get") == 7
+    assert config.get_site("robust.retry_attempts", "exchange.send") == 3
+    # site values clamp under the base declaration too
+    monkeypatch.setenv("PATHWAY_RETRY_ATTEMPTS_CACHE_GET", "0")
+    assert config.get_site("robust.retry_attempts", "cache.get") == 1
+
+
+def test_unknown_key_raises():
+    with pytest.raises(UnknownKnobError):
+        config.get("serve.not_a_knob")
+
+
+def test_static_knob_veto():
+    with pytest.raises(StaticKnobError):
+        config.set("decode.kv_quant", "int8")
+    with pytest.raises(StaticKnobError):
+        config.set("cache.enabled", False)
+
+
+def test_dynamic_set_clamps_and_layers(monkeypatch):
+    applied = config.set("serve.coalesce_us", 10**9)
+    assert applied == 100000.0
+    assert config.get("serve.coalesce_us") == 100000.0
+    # override beats env
+    monkeypatch.setenv("PATHWAY_SERVE_COALESCE_US", "1234")
+    assert config.get("serve.coalesce_us") == 100000.0
+    config.clear_override("serve.coalesce_us")
+    assert config.get("serve.coalesce_us") == 1234.0
+
+
+def test_auto_pytest_knobs(monkeypatch):
+    assert config.get("ops.donation_guard_strict") is True  # under pytest
+    monkeypatch.setenv("PATHWAY_DONATION_GUARD_STRICT", "0")
+    assert config.get("ops.donation_guard_strict") is False
+    monkeypatch.setenv("PATHWAY_DONATION_GUARD_STRICT", "1")
+    assert config.get("ops.donation_guard_strict") is True
+
+
+# -- the CLI / introspection surface ----------------------------------------
+
+def test_cli_text_and_json(capsys):
+    assert config.main(["--format", "text"]) == 0
+    out = capsys.readouterr().out
+    assert "serve.coalesce_us" in out and "PATHWAY_SERVE_COALESCE_US" in out
+
+    assert config.main(["--format", "json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    by_key = {r["key"]: r for r in rows}
+    assert by_key["serve.coalesce_us"]["mutability"] == "dynamic"
+    assert by_key["decode.kv_quant"]["mutability"] == "static"
+    assert len(rows) == len(config.knobs())
+
+
+def test_cli_markdown_matches_helper(capsys):
+    assert config.main(["--format", "markdown"]) == 0
+    out = capsys.readouterr().out
+    assert out.strip() == config.markdown_table().strip()
+
+
+# -- README drift gate (both directions) -------------------------------------
+
+def test_readme_knob_table_matches_registry():
+    """The README "Configuration" table is generated FROM the registry
+    (`python -m pathway_tpu.config --format markdown`) and gated in
+    both directions: a knob added/changed without regenerating the
+    table fails here, and a hand-edited table row that no declaration
+    backs fails the same assert."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "README.md")) as fh:
+        readme = fh.read()
+    begin = readme.index("<!-- knob-table:begin")
+    begin = readme.index("\n", begin) + 1
+    end = readme.index("<!-- knob-table:end -->")
+    block = readme[begin:end].strip()
+    assert block == config.markdown_table().strip(), (
+        "README knob table drifted from the registry — regenerate with "
+        "`python -m pathway_tpu.config --format markdown`"
+    )
+
+
+def test_readme_documents_every_env_name():
+    """Reverse direction at the ENV level: every declared env name
+    appears in README (the table provides it), and every PATHWAY_* name
+    README mentions is either declared, a site-prefix family member, or
+    a fixture name."""
+    import os
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "README.md")) as fh:
+        readme = fh.read()
+    declared = {k.env for k in config.knobs()}
+    prefixes = tuple(
+        k.site_prefix for k in config.knobs() if k.site_prefix
+    )
+    mentioned = set(re.findall(r"PATHWAY_[A-Z0-9_]+", readme))
+    missing = sorted(declared - mentioned)
+    assert missing == [], f"declared knobs absent from README: {missing}"
+    unknown = sorted(
+        n
+        for n in mentioned
+        if n not in declared
+        and not n.startswith(prefixes)
+        and not n.startswith("PATHWAY_FIXTURE_")
+        and not any(p.rstrip("_") == n for p in prefixes)
+    )
+    assert unknown == [], f"README mentions undeclared knobs: {unknown}"

@@ -263,6 +263,25 @@ def rendered_families():
     finally:
         os.environ.pop("PATHWAY_DONATION_GUARD", None)
 
+    # the online tuner (ISSUE 17): one vetoed proposal, one applied
+    # adjustment (reverted), one injected fault, and one config.load
+    # chaos reload — the pathway_tuner_* and config-load families render
+    from pathway_tpu import config as pwconfig
+    from pathway_tpu.serve.tuner import Tuner
+
+    tuner = Tuner(interval_s=0.01)
+    tuner.propose("decode.kv_quant", "int8", "up")    # vetoed: static
+    tuner.propose("serve.coalesce_us", 2500.0, "up")  # applied
+    tuner.revert()
+    with inject.armed("tuner.adjust", "raise"):
+        tuner.tick()  # contained: frozen + faults counter
+    with inject.armed("config.load", "raise"):
+        pwconfig._warned = {
+            t for t in pwconfig._warned if not t.startswith("load:")
+        }
+        pwconfig.load()  # degrades to last-good, counts the failure
+    pwconfig.clear_overrides()
+
     # profiler drain + SLO evaluation so every derived family is fresh
     assert profile.drain()
     slo.evaluate(max_age_s=0.0)

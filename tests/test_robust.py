@@ -1492,3 +1492,50 @@ def test_spec_ngram_only_rounds_still_honor_draft_faults():
         assert _degraded("speculation_disabled") >= before + 1
     finally:
         eng.stop()
+
+
+# -- chaos: the tuning loop (ISSUE 17) ---------------------------------------
+
+
+def test_config_load_chaos_serves_last_good_never_raises():
+    """An armed ``config.load`` site degrades a reload to the last-good
+    cached knob values: a warning and a counter, never an exception on
+    anyone's serve path."""
+    from pathway_tpu import config
+
+    clean = config.load()  # warm the cache with the real env
+    before = observe.counter("pathway_config_load_failures_total").value
+    inject.load_env("config.load=raise")
+    try:
+        config._warned = {t for t in config._warned if not t.startswith("load:")}
+        degraded = config.load()  # must NOT raise
+    finally:
+        inject.disarm()
+    assert degraded == clean  # last-good snapshot, not a partial parse
+    assert (
+        observe.counter("pathway_config_load_failures_total").value
+        == before + 1
+    )
+    assert config.load() == clean  # disarmed: the real reload works again
+
+
+def test_tuner_adjust_chaos_freezes_never_raises():
+    """An armed ``tuner.adjust`` site costs the TUNER (frozen, reverted,
+    counted) — the serve path keeps its static knob values and no
+    exception escapes ``tick``."""
+    from pathway_tpu import config
+    from pathway_tpu.serve.tuner import Tuner
+
+    config.clear_overrides()
+    t = Tuner(interval_s=0.01)
+    before = observe.counter("pathway_tuner_faults_total").value
+    inject.load_env("tuner.adjust=raise")
+    try:
+        assert t.tick() == 0  # contained
+    finally:
+        inject.disarm()
+    assert t.frozen
+    assert config.overrides() == {}
+    assert (
+        observe.counter("pathway_tuner_faults_total").value == before + 1
+    )
