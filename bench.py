@@ -2897,6 +2897,376 @@ def phase_ingest(backend: str, extras: dict) -> float:
     return rate
 
 
+def phase_live_ingest(backend: str, extras: dict) -> float:
+    """Ingest→retrievable freshness under live serve traffic (ISSUE 18:
+    serve/ingest.py + the real load-shed decision): the concurrent_serve
+    stack at c16 with a ``LiveIngestRunner`` absorbing connector commits
+    into the SAME index the fused retriever reads.  Measures staleness
+    (arrival → retrievable commit) p50/p99 and serve p50/p99 under the
+    combined load with a mid-run sentinel doc proven retrievable and its
+    ingest trace force-kept; asserts the per-batch 2+2 serve dispatch
+    budget with ingest absorbing around the burst (the counter hooks
+    only the serve sites, so any ingest work leaking onto the serve
+    dispatch path would trip it); A/Bs the freshness plane on/off
+    (budget < 3% added serve p50 — attribution must be free at the
+    serve path); and A/Bs shed-on vs shed-off under a REAL freshness
+    burn (a paused absorber's overdue backlog): low-priority load
+    turned away at admission must protect the surviving high-priority
+    p99, with every high-priority request served clean.  The phase
+    value is the staleness p99 in ms."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu import observe
+    from pathway_tpu.observe import slo as slo_mod
+    from pathway_tpu.observe import trace as trace_mod
+    from pathway_tpu.ops import dispatch_counter
+    from pathway_tpu.serve import LiveIngestRunner, ServeScheduler
+    from pathway_tpu.serve import ingest as ingest_mod
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_LI_DOCS", "20000" if on_tpu else "1000"))
+    k, candidates = 10, 32
+    pipe, _cross, docs, _queries = _build_rr_pipeline(
+        n_docs, 16, k, candidates, small=not on_tpu
+    )
+    encoder = pipe.retriever.encoder
+    index = pipe.retriever.index
+
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(32)
+    ]
+    # warm every compile shape the arms touch: solo + coalesced comps +
+    # the single-row ingest-embed shape (absorb batches re-bucket rows)
+    for q in pool:
+        pipe([q], k)
+    for b in range(2, 17):
+        pipe(sorted(set(pool))[:b], k)
+
+    conc = 16
+    window_us = float(os.environ.get("BENCH_LI_WINDOW_US", "5000"))
+    max_batch = int(
+        os.environ.get("BENCH_LI_MAX_BATCH", "16" if on_tpu else "4")
+    )
+    n_req = int(os.environ.get("BENCH_LI_REQUESTS", str(conc * 8)))
+    per_commit = 8
+    next_key = [n_docs]
+
+    def fresh_rows(n: int):
+        # new (key, text) rows in the corpus shape, registered with the
+        # pipeline up front so reranking can score them once retrievable
+        rows = []
+        for _ in range(n):
+            key = next_key[0]
+            next_key[0] += 1
+            text = f"fresh update {key} " + docs[key % n_docs]
+            pipe.doc_text[key] = text
+            rows.append((key, text))
+        return rows
+
+    def drive(sched, n: int, priority_of=None, feeder=None):
+        """c16 barrier workers (+ optional ingest feeder sharing the
+        barrier); returns (lats list indexed by request, shed flags,
+        priorities)."""
+        lats: list = [None] * n
+        sheds = [False] * n
+        prios = [priority_of(i) if priority_of else None for i in range(n)]
+        errs: list = []
+        barrier = threading.Barrier(conc + (1 if feeder is not None else 0))
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=60)
+                for i in range(t, n, conc):
+                    t0 = time.perf_counter()
+                    res = sched.serve([pool[(i * 7) % len(pool)]], k,
+                                      priority=prios[i])
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    shed = bool(getattr(res, "meta", {}).get("shed"))
+                    sheds[i] = shed
+                    assert shed or (res and res[0])
+            except Exception as exc:
+                errs.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        if feeder is not None:
+            threads.append(threading.Thread(target=feeder, args=(barrier,)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError(f"live_ingest drive failed: {errs[:3]}")
+        return lats, sheds, prios
+
+    env_enabled = observe.enabled()
+    observe.set_enabled(True)
+    staleness_p99_ms = 0.0
+    try:
+        # -- combined load: staleness + serve latency + mid-run sentinel --
+        runner = LiveIngestRunner(encoder, index, name="bench-live")
+        conn = runner.connector("bench-live-0")
+        sentinel = {"key": None}
+        sentinel_text = (
+            "the zanzibar quorum ledger reconciles nightly freshness audits"
+        )
+        ingest_commits = max(2, n_req // 16)
+        gen0 = index.generation
+
+        def feeder(barrier):
+            barrier.wait(timeout=60)
+            for i in range(ingest_commits):
+                conn.insert_rows(fresh_rows(per_commit))
+                conn.commit(offsets={"0": (i + 1) * per_commit})
+                if i == ingest_commits // 2:
+                    # mid-run sentinel: unique text; a 1 ms freshness
+                    # threshold around just this commit force-keeps the
+                    # batch's ingest trace
+                    key = next_key[0]
+                    next_key[0] += 1
+                    pipe.doc_text[key] = sentinel_text
+                    prev = os.environ.get("PATHWAY_SLO_FRESHNESS_MS")
+                    os.environ["PATHWAY_SLO_FRESHNESS_MS"] = "1"
+                    try:
+                        conn.insert(key, sentinel_text)
+                        conn.commit()
+                        runner.flush(timeout=30.0)
+                    finally:
+                        if prev is None:
+                            os.environ.pop("PATHWAY_SLO_FRESHNESS_MS", None)
+                        else:
+                            os.environ["PATHWAY_SLO_FRESHNESS_MS"] = prev
+                    sentinel["key"] = key
+                time.sleep(0.01)
+
+        sched = ServeScheduler(
+            pipe, window_us=window_us, max_batch=max_batch, result_cache=None
+        )
+        try:
+            drive(sched, 2 * conc)  # settle the scheduler's compositions
+            lats, _sheds, _prios = drive(sched, n_req, feeder=feeder)
+        finally:
+            sched.stop()
+        assert runner.flush(timeout=60.0), runner.stats
+        r_stats = runner.stats
+        assert r_stats["dropped"] == 0, r_stats
+        assert index.generation > gen0
+        done = np.asarray([l for l in lats if l is not None])
+        extras["live_serve_p50_ms"] = round(float(np.percentile(done, 50)), 3)
+        extras["live_serve_p99_ms"] = round(float(np.percentile(done, 99)), 3)
+        extras["live_ingest_docs"] = r_stats["docs"]
+        extras["live_ingest_batches"] = r_stats["batches"]
+        p50_s = ingest_mod._H_FRESH.quantile_s(0.5)
+        p99_s = ingest_mod._H_FRESH.quantile_s(0.99)
+        assert p99_s is not None, "no freshness observations landed"
+        staleness_p99_ms = p99_s * 1e3
+        extras["live_staleness_p50_ms"] = round((p50_s or 0.0) * 1e3, 3)
+        extras["live_staleness_p99_ms"] = round(staleness_p99_ms, 3)
+
+        # the sentinel committed mid-run is retrievable and its ingest
+        # trace was kept (keep_reason "forced" via the 1 ms threshold)
+        assert sentinel["key"] is not None
+        got = pipe([sentinel_text], k)
+        assert sentinel["key"] in [key for key, _score in got[0]], got[0]
+        kept_ingest = [
+            t for t in trace_mod.snapshot_traces()["traces"]
+            if t.get("kind") == "ingest"
+        ]
+        assert kept_ingest, "no kept ingest trace for the sentinel batch"
+        extras["live_sentinel_trace_kept"] = len(kept_ingest)
+
+        # -- 2+2 budget with ingest absorbing around the burst --
+        b0 = runner.stats["batches"]
+        with ServeScheduler(
+            pipe, window_us=200_000, result_cache=None
+        ) as bsched:
+            conn.insert_rows(fresh_rows(per_commit))
+            conn.commit()
+            res: list = []
+            errs: list = []
+            barrier = threading.Barrier(8)
+
+            def w(q):
+                try:
+                    barrier.wait(timeout=60)
+                    res.append(bsched.serve([q], k))
+                except Exception as exc:
+                    errs.append(repr(exc))
+
+            with dispatch_counter.DispatchCounter() as counter:
+                threads = [
+                    threading.Thread(target=w, args=(q,)) for q in pool[:8]
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            if errs:
+                raise RuntimeError(f"live_ingest burst failed: {errs[:3]}")
+            batches = max(1, bsched.stats["batches"] + bsched.stats["solo"])
+        assert runner.flush(timeout=60.0), runner.stats
+        extras["live_dispatches_per_batch"] = round(
+            counter.dispatches / batches, 2
+        )
+        extras["live_fetches_per_batch"] = round(counter.fetches / batches, 2)
+        extras["live_ingest_batches_during_burst"] = (
+            runner.stats["batches"] - b0
+        )
+        assert counter.dispatches <= 2 * batches, (counter.events, batches)
+        assert counter.fetches <= 2 * batches, (counter.events, batches)
+        runner.stop()
+
+        # -- freshness-plane overhead A/B: serve p50 with the plane on
+        # (histograms + stage spans + provider) vs a plane-off runner,
+        # interleaved paired rounds, median ratio, < 3% budget --
+        rounds = int(os.environ.get("BENCH_LI_ROUNDS", "3"))
+        lat_arm = {True: [], False: []}
+        ratios = []
+        for r in range(rounds):
+            order = (True, False) if r % 2 == 0 else (False, True)
+            round_p50 = {}
+            for plane in order:
+                arm_runner = LiveIngestRunner(
+                    encoder, index, name=f"ab-{r}-{int(plane)}",
+                    freshness_plane=plane,
+                )
+                arm_conn = arm_runner.connector("ab-0")
+
+                def ab_feeder(barrier, arm_conn=arm_conn):
+                    barrier.wait(timeout=60)
+                    for _ in range(6):
+                        arm_conn.insert_rows(fresh_rows(per_commit))
+                        arm_conn.commit()
+                        time.sleep(0.005)
+
+                asched = ServeScheduler(
+                    pipe, window_us=window_us, max_batch=max_batch,
+                    result_cache=None,
+                )
+                try:
+                    drive(asched, 2 * conc)  # settle after the flip
+                    arm, _s, _p = drive(asched, n_req, feeder=ab_feeder)
+                finally:
+                    asched.stop()
+                    arm_runner.flush(timeout=60.0)
+                    arm_runner.stop()
+                arm = np.asarray([l for l in arm if l is not None])
+                lat_arm[plane].append(arm)
+                round_p50[plane] = float(np.percentile(arm, 50))
+            ratios.append(round_p50[True] / max(round_p50[False], 1e-9))
+        overhead_pct = (float(np.median(ratios)) - 1.0) * 100.0
+        extras["live_plane_p50_on_ms"] = round(
+            float(np.percentile(np.concatenate(lat_arm[True]), 50)), 3
+        )
+        extras["live_plane_p50_off_ms"] = round(
+            float(np.percentile(np.concatenate(lat_arm[False]), 50)), 3
+        )
+        extras["live_plane_round_ratios"] = [round(x, 4) for x in ratios]
+        extras["freshness_plane_overhead_pct"] = round(overhead_pct, 3)
+        max_pct = float(os.environ.get("BENCH_LI_MAX_OVERHEAD_PCT", "3.0"))
+        assert overhead_pct < max_pct, (
+            f"freshness plane adds {overhead_pct:.2f}% serve p50 "
+            f"(budget {max_pct}%)"
+        )
+
+        # -- shed A/B under a REAL freshness burn: a paused absorber's
+        # backlog ages past a 50 ms threshold, the freshness objective
+        # fires, and the admission decision (serve.shed + priority
+        # classes) turns low-priority load away — the surviving
+        # high-priority p99 is the number the decision protects --
+        env_prev = {
+            kk: os.environ.get(kk)
+            for kk in ("PATHWAY_SLO_FRESHNESS_MS", "PATHWAY_SERVE_SHED")
+        }
+        backlog = None
+        try:
+            os.environ["PATHWAY_SLO_FRESHNESS_MS"] = "50"
+            engine = slo_mod.set_engine(None)
+            engine.evaluate(max_age_s=0.0)  # baseline ring snapshot
+            backlog = LiveIngestRunner(
+                encoder, index, name="backlog", autostart=False
+            )
+            bconn = backlog.connector("backlog-0")
+            bconn.insert_rows(fresh_rows(32))
+            bconn.commit()
+            time.sleep(0.12)  # age the backlog past the threshold
+            engine.evaluate(max_age_s=0.0)
+            assert "freshness" in slo_mod.firing_specs(), (
+                slo_mod.firing_specs()
+            )
+            assert slo_mod.should_shed()
+
+            def priority_of(i: int) -> str:
+                return "low" if i % 2 else "high"
+
+            pairs = []
+            shed_total = 0
+            for r in range(rounds):
+                order = (True, False) if r % 2 == 0 else (False, True)
+                round_hi = {}
+                for shed_on in order:
+                    if shed_on:
+                        os.environ.pop("PATHWAY_SERVE_SHED", None)
+                    else:
+                        os.environ["PATHWAY_SERVE_SHED"] = "0"
+                    ssched = ServeScheduler(
+                        pipe, window_us=window_us, max_batch=max_batch,
+                        result_cache=None,
+                    )
+                    try:
+                        drive(ssched, 2 * conc, priority_of=priority_of)
+                        lats, sheds, prios = drive(
+                            ssched, n_req, priority_of=priority_of
+                        )
+                        n_shed = ssched.stats.get("shed", 0)
+                    finally:
+                        ssched.stop()
+                    hi = [
+                        lats[i] for i in range(n_req)
+                        if prios[i] == "high" and lats[i] is not None
+                    ]
+                    assert not any(
+                        sheds[i] for i in range(n_req) if prios[i] == "high"
+                    ), "a high-priority request was shed"
+                    if shed_on:
+                        assert any(sheds), "burn firing but nothing shed"
+                        shed_total += n_shed
+                    else:
+                        assert not any(sheds) and n_shed == 0
+                    round_hi[shed_on] = float(np.percentile(hi, 99))
+                pairs.append((round_hi[True], round_hi[False]))
+            protection = float(
+                np.median([off / max(on, 1e-9) for on, off in pairs])
+            )
+            extras["live_shed_high_p99_on_ms"] = round(
+                float(np.median([on for on, _ in pairs])), 3
+            )
+            extras["live_shed_high_p99_off_ms"] = round(
+                float(np.median([off for _, off in pairs])), 3
+            )
+            extras["live_shed_requests_shed"] = shed_total
+            extras["live_shed_p99_protection_x"] = round(protection, 3)
+            assert protection > 1.0, (
+                f"shedding low-priority load did not protect the "
+                f"high-priority p99 (ratio {protection:.3f})"
+            )
+        finally:
+            for kk, vv in env_prev.items():
+                if vv is None:
+                    os.environ.pop(kk, None)
+                else:
+                    os.environ[kk] = vv
+            slo_mod.reset()
+            if backlog is not None:
+                backlog.stop()
+    finally:
+        observe.set_enabled(env_enabled)
+    return round(staleness_p99_ms, 3)
+
+
 def phase_wordcount(backend: str, extras: dict) -> float:
     """Relational engine throughput: rows/sec through groupby-count."""
     _init_jax("cpu")  # host-side engine bench; never needs the device
@@ -3236,6 +3606,7 @@ _PHASES = {
     "continuous_decode": (phase_continuous_decode, 450),
     "speculative_decode": (phase_speculative_decode, 450),
     "ingest": (phase_ingest, 900),
+    "live_ingest": (phase_live_ingest, 600),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
     "exchange": (phase_exchange, 450),
@@ -3469,6 +3840,7 @@ def main() -> None:
         ("continuous_decode", lambda: device_phase("continuous_decode")),
         ("speculative_decode", lambda: device_phase("speculative_decode")),
         ("ingest", lambda: device_phase("ingest")),
+        ("live_ingest", lambda: device_phase("live_ingest")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)
         ("exchange", lambda: run_phase("exchange", "cpu", extras, errors)),
@@ -3517,6 +3889,8 @@ def main() -> None:
             extras["speculative_decode_speedup_c16"] = round(value, 3)
         elif name == "ingest" and value is not None:
             extras["ingest_docs_per_sec"] = round(value, 1)
+        elif name == "live_ingest" and value is not None:
+            extras["live_staleness_p99_ms"] = round(value, 3)
         elif name == "wordcount" and value is not None:
             extras["wordcount_rows_per_sec"] = round(value, 1)
         emit(partial=True)

@@ -252,7 +252,15 @@ def collect_donating_jits(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
 # visible device→host crossing even in modules with no jit of their own
 # (the stdlib adapter class).  The receiver spelling carries the
 # convention; ``str.encode`` receivers (payload/text vars) do not match.
-_PRODUCER_METHODS = {"encode", "encode_token_states"}
+# encode_to_device / encode_packed_to_device: the live-ingest runner
+# (serve/ingest.py) reaches the encoder through the device-resident
+# batch entries, so their results must carry device provenance too
+_PRODUCER_METHODS = {
+    "encode",
+    "encode_token_states",
+    "encode_to_device",
+    "encode_packed_to_device",
+}
 _PRODUCER_RECEIVER_RE = re.compile(
     r"(^|_)(embedder|encoder|enc|model)s?$", re.IGNORECASE
 )

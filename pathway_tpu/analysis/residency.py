@@ -86,6 +86,11 @@ DECLARED_TRANSFERS: Dict[Tuple[str, str], str] = {
         "host float32 rows for the inner index, one batched crossing "
         "per micro-batch, off every serve lock"
     ),
+    ("xpacks/llm/embedders.py", "TpuEmbedder.__init__.embed"): (
+        "the embedder xpack's UDF contract is a host ndarray: one "
+        "batched synchronous fetch per ingest micro-batch, never "
+        "inside a serve stage"
+    ),
     ("ops/serving.py", "FusedEncodeSearch._submit_sharded"): (
         "deliberate per-shard d2d scatter: the SAME embedding is placed "
         "on each shard's device once per serve — the transfer varies by "

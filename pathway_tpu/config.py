@@ -177,6 +177,28 @@ _knob("serve.deadline_ms", "PATHWAY_SERVE_DEADLINE_MS", "float", 0.0,
       "per-request serve deadline in ms (0 = none)", lo=0.0, hi=600_000.0)
 _knob("serve.stage1_fraction", "PATHWAY_SERVE_STAGE1_FRACTION", "float", 0.6,
       "fraction of the deadline granted to stage 1", lo=0.05, hi=1.0)
+_knob("serve.shed", "PATHWAY_SERVE_SHED", "bool", True,
+      "SLO burn sheds shed-class requests at admission (off = advisory "
+      "log-only, the pre-round-19 behavior)")
+_knob("serve.shed_priorities", "PATHWAY_SERVE_SHED_PRIORITIES", "str", "low",
+      "comma-separated priority classes eligible for load shedding")
+_knob("serve.default_priority", "PATHWAY_SERVE_DEFAULT_PRIORITY", "enum",
+      "normal", "priority class for submit() calls that pass none",
+      choices=("high", "normal", "low"))
+
+# live ingest (serve/ingest.py)
+_knob("ingest.batch_docs", "PATHWAY_INGEST_BATCH_DOCS", "int", 32,
+      "max documents one ingest embed/absorb batch carries",
+      lo=1, hi=4096, mutability=DYNAMIC)
+_knob("ingest.poll_ms", "PATHWAY_INGEST_POLL_MS", "float", 5.0,
+      "ingest loop idle poll interval in ms", lo=0.1, hi=60_000.0,
+      mutability=DYNAMIC)
+_knob("ingest.queue_cap", "PATHWAY_INGEST_QUEUE_CAP", "int", 4096,
+      "pending-document queue capacity (connector commits block past it)",
+      lo=1, hi=1_048_576)
+_knob("ingest.backpressure_ms", "PATHWAY_INGEST_BACKPRESSURE_MS", "float",
+      25.0, "absorb-cadence yield when serve latency is the binding SLO",
+      lo=0.0, hi=60_000.0, mutability=DYNAMIC)
 
 # continuous decode / generator
 _knob("decode.step_bucket", "PATHWAY_DECODE_STEP_BUCKET", "int", 8,
@@ -269,6 +291,11 @@ _knob("observe.slo_availability", "PATHWAY_SLO_AVAILABILITY", "float", 0.999,
       "availability SLO objective fraction", lo=0.5, hi=0.99999)
 _knob("observe.slo_ttlt_ms", "PATHWAY_SLO_TTLT_MS", "float", 2000.0,
       "decode TTLT SLO threshold in ms", lo=1.0, hi=600_000.0)
+_knob("observe.slo_freshness_ms", "PATHWAY_SLO_FRESHNESS_MS", "float",
+      5000.0, "ingest freshness SLO threshold in ms (arrival to "
+      "retrievable)", lo=1.0, hi=86_400_000.0)
+_knob("observe.slo_freshness_objective", "PATHWAY_SLO_FRESHNESS_OBJECTIVE",
+      "float", 0.99, "freshness SLO objective fraction", lo=0.5, hi=0.99999)
 _knob("observe.slo_fast_window_s", "PATHWAY_SLO_FAST_WINDOW_S", "float",
       300.0, "fast burn-rate window in seconds", lo=0.05, hi=86_400.0)
 _knob("observe.slo_slow_window_s", "PATHWAY_SLO_SLOW_WINDOW_S", "float",

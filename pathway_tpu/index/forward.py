@@ -353,6 +353,10 @@ class ForwardIndex:
         plan — serving continues throughout), then commit the donated
         scatter + bookkeeping under the lock, IVF-style.  Upserts
         overwrite in place; returns the number of documents committed.
+        This is the live-ingest runner's (serve/ingest.py) forward-side
+        absorb target: the runner fires ``ingest.commit`` upstream of
+        this call, while ``forward.absorb``/``forward.upload`` below
+        cover the plan and scatter independently.
 
         Degrade-not-die: a failed pass is logged once and counted on
         ``pathway_forward_absorb_failures_total`` — the documents simply

@@ -203,15 +203,20 @@ class SessionWriter:
         self.session.remove(key)
         self.monitor.on_delete()
 
-    def commit_offsets(self, offsets: Mapping[Any, Any]) -> None:
+    def commit_offsets(self, offsets: Mapping[Any, Any]):
         """Record committed per-partition read positions: persisted when a
         persistence config is active, and always folded into the connector
-        monitor's offset antichain for lag/partition stats."""
+        monitor's offset antichain for lag/partition stats.  Returns the
+        monitor's merged antichain — the same contract
+        ``serve/ingest.py``'s ``IngestConnector.commit`` mirrors, so code
+        bridging engine sources into the live indexes reads committed
+        positions back from either."""
         from ._offsets import OffsetAntichain
 
         if self.persistence is not None:
             self.persistence.save_offsets(dict(offsets))
         self.monitor.on_commit(OffsetAntichain(dict(offsets)))
+        return self.monitor.offsets
 
     def close(self) -> None:
         self.monitor.on_finish()

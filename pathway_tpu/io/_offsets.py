@@ -97,6 +97,7 @@ class ConnectorMonitor:
         self.commits = 0
         self.started_at = time.time()
         self.last_row_at: Optional[float] = None
+        self.last_commit_at: Optional[float] = None
         self.offsets = OffsetAntichain()
         self.finished = False
         _monitors.add(self)
@@ -114,6 +115,7 @@ class ConnectorMonitor:
     def on_commit(self, offsets: Optional[OffsetAntichain] = None) -> None:
         with self._lock:
             self.commits += 1
+            self.last_commit_at = time.time()
             if offsets is not None:
                 self.offsets = self.offsets.merge(offsets)
 
@@ -134,6 +136,12 @@ class ConnectorMonitor:
             "rows_deleted": self.rows_deleted,
             "commits": self.commits,
             "lag_seconds": self.lag_seconds(),
+            "last_commit_at": self.last_commit_at,
             "partitions": len(self.offsets),
+            # the committed antichain itself: the live-ingest freshness
+            # plane (serve/ingest.py) surfaces per-connector positions on
+            # /serve_stats, and replaying a partition needs the positions
+            # not just their count
+            "offsets": self.offsets.as_dict(),
             "finished": self.finished,
         }

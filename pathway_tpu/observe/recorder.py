@@ -459,7 +459,9 @@ def snapshot() -> Dict[str, Any]:
     gauges (provider-sampled), a per-shard column (every provider
     sample labeled ``shard=...`` grouped by shard id), a per-tier cache
     column (samples labeled ``tier=...`` — the pathway_tpu/cache
-    hit/miss/evict/bytes families), and the recent event ring."""
+    hit/miss/evict/bytes families), a per-runner ingest column (samples
+    labeled ``ingest=...`` — lag, pending docs, freshness quantiles),
+    and the recent event ring."""
     with _registry_lock:
         hist_items = {name: dict(series) for name, series in _hists.items()}
         counter_items = {
@@ -511,6 +513,12 @@ def snapshot() -> Dict[str, Any]:
     # slot occupancy, prefill/decode token counters, finished/evicted
     # requests, quarantined slots, per engine name
     generators: Dict[str, Dict[str, float]] = {}
+    # the ingest column: samples labeled ingest=... (the live-ingest
+    # runners, serve/ingest.py) grouped per runner — pending docs,
+    # oldest-pending age, per-connector lag, freshness p50/p99 — so the
+    # one scrape surface stays the single pane of glass for the
+    # ingest+serve plane
+    ingests: Dict[str, Dict[str, float]] = {}
     for kind, name, key, value in _provider_samples():
         target = counters if kind == "counter" else gauges
         target[series_name(name, key)] = value
@@ -529,6 +537,10 @@ def snapshot() -> Dict[str, Any]:
         if gen is not None:
             rest = tuple((lk, lv) for lk, lv in key if lk != "generator")
             generators.setdefault(gen, {})[series_name(name, rest)] = value
+        ing = labels.get("ingest")
+        if ing is not None:
+            rest = tuple((lk, lv) for lk, lv in key if lk != "ingest")
+            ingests.setdefault(ing, {})[series_name(name, rest)] = value
     events, total = _ring.snapshot()
     # the profile column: per-callable device-time attribution from the
     # sampling profiler (observe/profile.py — lazy import: profile
@@ -561,6 +573,7 @@ def snapshot() -> Dict[str, Any]:
         "shards": {k: shards[k] for k in sorted(shards, key=_shard_sort_key)},
         "caches": {k: caches[k] for k in sorted(caches)},
         "generators": {k: generators[k] for k in sorted(generators)},
+        "ingest": {k: ingests[k] for k in sorted(ingests)},
         "profile": profile_col,
         "hbm": hbm_col,
         "slo": slo_col,

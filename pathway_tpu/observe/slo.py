@@ -14,6 +14,12 @@ instrumentation, no second bookkeeping path:
 - ``kind="availability"``: 1 − degraded fraction, with bad events from
   a counter family (summed over label sets: every ladder rung counts)
   and totals from a histogram family's event count.
+- ``kind="freshness"``: latency-shaped over the ingest plane's
+  ``pathway_freshness_seconds`` (arrival → retrievable), PLUS the live
+  maintenance lag: every pending document already OLDER than the
+  threshold (read from the registered ingest runners) counts as a bad
+  event right now — the burn rate rises while the backlog ages, not
+  only after slow documents finally land.
 
 Evaluation is the standard SRE burn-rate construction: the error budget
 is ``1 − objective``; the burn rate over a window is the window's error
@@ -35,9 +41,13 @@ budget-in-an-hour page), ``PATHWAY_SLO_TICK_S``, ``PATHWAY_SLO=0`` to
 disable the scheduler's shed advisory.
 
 ``should_shed()`` is the seam the scheduler consumes: True while any
-``shed=True`` spec is firing.  This PR wires it ADVISORY-ONLY (logged +
-counted, never acted on); ROADMAP item 2's backpressure/admission and
-item 3's failover take it from here.
+``shed=True`` spec is firing.  Since round 19 the scheduler ACTS on it
+(``PATHWAY_SERVE_SHED``): shed-class priorities get an empty flagged
+result at admission; ``PATHWAY_SERVE_SHED=0`` restores the round-15
+advisory-only behavior.  ``firing_specs()`` exposes which objectives
+are firing so the ingest runner can tell "serve latency is the binding
+constraint" (yield absorb cadence) from "freshness is burning" (keep
+absorbing).
 
 Degrade-never-fail: the ``slo.evaluate`` chaos site fires at the top of
 a fresh evaluation under a spent deadline — any armed fault serves the
@@ -62,6 +72,7 @@ __all__ = [
     "default_specs",
     "engine",
     "evaluate",
+    "firing_specs",
     "reset",
     "should_shed",
     "shed_advisory_enabled",
@@ -140,6 +151,23 @@ def _good_under_threshold(name: str, threshold_s: float) -> Tuple[int, int, floa
     return good, total, bounds[cut]
 
 
+def _overdue_pending(threshold_s: float) -> int:
+    """Documents sitting in a live ingest runner's queue LONGER than the
+    freshness threshold — already-blown budget that no histogram has
+    seen yet.  Lazy import: serve/ingest.py imports this module."""
+    try:
+        from ..serve.ingest import ingest_runners
+    except Exception:  # pragma: no cover - partial teardown
+        return 0
+    n = 0
+    for runner in ingest_runners():
+        try:
+            n += runner.overdue_pending(threshold_s)
+        except Exception:
+            continue
+    return n
+
+
 class SloSpec:
     """One declarative objective.  ``kind``:
 
@@ -148,6 +176,9 @@ class SloSpec:
     - ``"availability"``: ``bad`` (counter family) + ``total_hist``
       (histogram family whose count is the event total); good = total −
       bad (clamped).
+    - ``"freshness"``: latency over ``hist`` + each currently-pending
+      ingest document older than ``threshold_s`` counted as one bad
+      event (maintenance lag feeds the burn before the doc lands).
     """
 
     __slots__ = (
@@ -167,10 +198,12 @@ class SloSpec:
         shed: bool = False,
         description: str = "",
     ):
-        if kind not in ("latency", "availability"):
+        if kind not in ("latency", "availability", "freshness"):
             raise ValueError(f"unknown SLO kind {kind!r}")
-        if kind == "latency" and (hist is None or threshold_s is None):
-            raise ValueError("latency spec needs hist + threshold_s")
+        if kind in ("latency", "freshness") and (
+            hist is None or threshold_s is None
+        ):
+            raise ValueError(f"{kind} spec needs hist + threshold_s")
         if kind == "availability" and (bad is None or total_hist is None):
             raise ValueError("availability spec needs bad + total_hist")
         if not 0.0 < objective < 1.0:
@@ -189,15 +222,26 @@ class SloSpec:
         """Cumulative (good, total, effective_threshold_s | None)."""
         if self.kind == "latency":
             return _good_under_threshold(self.hist, float(self.threshold_s))
+        if self.kind == "freshness":
+            good, total, eff = _good_under_threshold(
+                self.hist, float(self.threshold_s)
+            )
+            # overdue queue residents: bad events added to the total only
+            # (they leave this term once they land and the histogram
+            # takes over — no double count, since the snapshot ring
+            # differences cumulative values each evaluation)
+            total += _overdue_pending(float(self.threshold_s))
+            return good, total, eff
         total = _family_hist_counts(self.total_hist)[1]
         bad = min(_family_counter_total(self.bad), total)
         return total - bad, total, None
 
 
 def default_specs() -> List[SloSpec]:
-    """The shipped objectives, env-tunable.  Serve latency and
-    availability carry ``shed=True`` — they are the admission seams
-    ROADMAP item 2 will act on; decode TTLT is observe-only."""
+    """The shipped objectives, env-tunable.  Serve latency,
+    availability, and ingest freshness carry ``shed=True`` — the
+    admission seams the scheduler's load-shedding decision acts on
+    (``serve.shed`` + priority classes); decode TTLT is observe-only."""
     return [
         SloSpec(
             "serve_latency",
@@ -224,6 +268,16 @@ def default_specs() -> List[SloSpec]:
             hist="pathway_generator_ttlt_seconds",
             threshold_s=config.get("observe.slo_ttlt_ms") * 1e-3,
             description="decode requests at/under the TTLT threshold",
+        ),
+        SloSpec(
+            "freshness",
+            "freshness",
+            objective=config.get("observe.slo_freshness_objective"),
+            hist="pathway_freshness_seconds",
+            threshold_s=config.get("observe.slo_freshness_ms") * 1e-3,
+            shed=True,
+            description="documents retrievable within the freshness "
+            "threshold (overdue pending docs count against it)",
         ),
     ]
 
@@ -425,10 +479,12 @@ def set_shed_advisory(flag: bool) -> None:
 
 def should_shed() -> bool:
     """The scheduler's admission probe: True while any ``shed=True``
-    objective is firing.  ADVISORY this PR — the scheduler logs and
-    counts (``pathway_slo_shed_advised_total``) but admits normally;
-    item 2's backpressure acts on it.  One throttled evaluation at most
-    per tick, so the steady-state cost is a clock read."""
+    objective is firing.  With ``PATHWAY_SERVE_SHED`` on the scheduler
+    ACTS on it for shed-class priorities (empty flagged result, counted
+    on ``pathway_serve_shed_total``); otherwise it logs and counts
+    (``pathway_slo_shed_advised_total``) and admits normally.  One
+    throttled evaluation at most per tick, so the steady-state cost is
+    a clock read."""
     if not _shed_on:
         return False
     try:
@@ -439,6 +495,25 @@ def should_shed() -> bool:
 
 def record_shed_advised() -> None:
     _C_SHED_ADVISED.inc()
+
+
+def firing_specs() -> Tuple[str, ...]:
+    """Names of the objectives currently firing (from the throttled
+    evaluation — same cost profile as ``should_shed``).  The ingest
+    runner reads this to decide WHICH side yields: serve_latency firing
+    while freshness is quiet means serve p99 is the binding constraint,
+    so maintenance backs off its absorb cadence."""
+    if not _shed_on:
+        return ()
+    try:
+        doc = engine().evaluate()
+    except Exception:
+        return ()
+    return tuple(
+        name
+        for name, row in doc.get("slos", {}).items()
+        if row.get("state") == "firing"
+    )
 
 
 def reset() -> None:

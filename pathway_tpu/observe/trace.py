@@ -106,6 +106,15 @@ _sample = config.get("observe.trace_sample")
 # finish, it is BOTH the tail sampler's "slow" threshold source and the
 # flagship exemplar family (a p99 bucket links to a kept trace id)
 _H_REQUEST = histogram("pathway_serve_request_seconds")
+# the ingest plane's arrival→retrievable histogram (observed by
+# serve/ingest.py per document): its quantile is the slow threshold for
+# kind="ingest" traces — a slow document keeps its trace exactly like a
+# slow serve does
+_H_INGEST = histogram("pathway_freshness_seconds")
+
+# per-kind slow-rule source: the histogram whose tail quantile defines
+# "slow" for traces of that kind
+_SLOW_HISTS = {"request": _H_REQUEST, "ingest": _H_INGEST}
 
 _C_SPANS_DROPPED = counter("pathway_trace_spans_dropped_total")
 _C_SAMPLED_OUT = counter("pathway_trace_sampled_out_total")
@@ -362,8 +371,9 @@ def _keep_reason(ctx: TraceContext, dur_ns: int) -> Optional[str]:
                 return "deadline"
         except Exception:
             pass
-    if ctx.kind == "request" and _H_REQUEST.count >= _SLOW_MIN_COUNT:
-        q = _H_REQUEST.quantile_s(_SLOW_PCT)
+    h = _SLOW_HISTS.get(ctx.kind)
+    if h is not None and h.count >= _SLOW_MIN_COUNT:
+        q = h.quantile_s(_SLOW_PCT)
         if q is not None and dur_ns * 1e-9 >= q:
             return "slow"
     return None

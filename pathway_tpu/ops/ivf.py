@@ -356,7 +356,7 @@ class IvfKnnIndex:
         return len(self._slot_of_key) + len(self._tail)
 
     # -- mutation (host-of-record; device rebuilt lazily) ------------------
-    def add(self, keys: Sequence[int], vectors: np.ndarray) -> None:
+    def add(self, keys: Sequence[int], vectors: np.ndarray) -> int:
         # coerce + normalize BEFORE the lock: callers hand the encoder's
         # device rows straight here, and the implicit device→host sync
         # must not stall every concurrent search/absorb on the index
@@ -408,6 +408,11 @@ class IvfKnnIndex:
                     # instead of disabling absorbs for the index lifetime
                     self._absorbing = False
             self.maybe_retrain_async()
+            # the generation this commit produced: the live-ingest
+            # runner stamps it on the batch trace — documents become
+            # retrievable (and the scheduler's generation-keyed result
+            # cache rolls over) exactly at this value
+            return self.generation
 
     def remove(self, keys: Sequence[int]) -> None:
         with self._lock:

@@ -34,6 +34,7 @@ from .deadline import Deadline, DeadlineExceeded, stage1_fraction
 from .degrade import (
     EXTRACTIVE_ANSWER,
     LATE_INTERACTION_SKIPPED,
+    LOAD_SHED,
     RERANK_SKIPPED,
     RETRIEVAL_FAILED,
     SHARD_SKIPPED,
@@ -61,6 +62,7 @@ __all__ = [
     "EXTRACTIVE_ANSWER",
     "FaultInjected",
     "LATE_INTERACTION_SKIPPED",
+    "LOAD_SHED",
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",
     "RetryPolicy",

@@ -25,6 +25,8 @@ breaker open           candidates (recall lost on
                        only)
 generator down         extractive answer from      ``extractive_answer``
                        the top passages
+SLO burn firing +      empty result set (shed at   ``load_shed``
+shed-class priority    admission, never queued)
 stage 1 down           empty result set            ``retrieval_failed``
 =====================  ==========================  ==========================
 
@@ -49,6 +51,7 @@ from ..observe import trace as _trace
 __all__ = [
     "EXTRACTIVE_ANSWER",
     "LATE_INTERACTION_SKIPPED",
+    "LOAD_SHED",
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",
     "SHARD_SKIPPED",
@@ -63,6 +66,7 @@ LATE_INTERACTION_SKIPPED = "late_interaction_skipped"
 TAIL_SKIPPED = "tail_skipped"
 SHARD_SKIPPED = "shard_skipped"
 EXTRACTIVE_ANSWER = "extractive_answer"
+LOAD_SHED = "load_shed"
 RETRIEVAL_FAILED = "retrieval_failed"
 
 # pre-resolved per-reason counters (reasons are the small fixed rung set)

@@ -35,7 +35,15 @@ raise/delay/hang alike, degrades to dropped spans counted on
 ``pathway_trace_spans_dropped_total`` and a flagged-empty ``/traces``
 payload; the tracing layer fires these sites under an already-spent
 deadline so even a hang releases immediately and a serve is never
-failed or stalled by its own observability), … — and lets a test (or
+failed or stalled by its own observability), and the live-ingest
+triple ``ingest.poll`` / ``ingest.embed`` / ``ingest.commit``
+(serve/ingest.py — a faulted poll RETRIES, its documents never leave
+the queue; a faulted embed or commit DROPS only that batch's
+documents, counted on ``pathway_ingest_failures_total{stage=...}``;
+serve results stay clean and bit-identical because the index simply
+does not advance, and every ingest site fires under an already-spent
+deadline so an armed hang releases instantly — maintenance never
+stalls), … — and lets a test (or
 an operator running a game-day) arm any site to
 
 - ``raise`` a ``FaultInjected`` (a transient dispatch/socket error),

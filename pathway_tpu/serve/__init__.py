@@ -14,20 +14,31 @@ to GENERATOR decode at token granularity: a persistent slotted K/V pool
 where requests join after a (prefix-cached) prefill and leave at EOS,
 freeing their slot mid-flight — the throughput substrate for the
 cascade's listwise LLM rerank stage and the chat/QA path.
+
+``LiveIngestRunner`` (ingest.py) closes the loop with the incremental
+half of the reference: committed connector rows are embedded in
+off-serve-path batches and absorbed into the live indexes under serve
+traffic, with the freshness plane (``pathway_freshness_seconds``,
+ingest traces, maintenance-lag gauges, the freshness SLO) attributing
+every ingest→retrievable journey.
 """
 
 from .decode import ContinuousDecoder, DecodeResult, decode_slots
+from .ingest import IngestConnector, LiveIngestRunner, ingest_runners
 from .scheduler import ServeScheduler, SharedBatcher, coalesce_window_s, max_batch_queries
 from .tuner import Tuner, tuner_from_env
 
 __all__ = [
     "ContinuousDecoder",
     "DecodeResult",
+    "IngestConnector",
+    "LiveIngestRunner",
     "ServeScheduler",
     "SharedBatcher",
     "Tuner",
     "coalesce_window_s",
     "decode_slots",
+    "ingest_runners",
     "max_batch_queries",
     "tuner_from_env",
 ]

@@ -75,7 +75,14 @@ from .. import config, observe
 from ..cache import query_key, result_cache_from_env
 from ..observe import slo as slo_mod
 from ..observe import trace
-from ..robust import Deadline, RETRIEVAL_FAILED, ServeResult, log_once, record_degraded
+from ..robust import (
+    Deadline,
+    LOAD_SHED,
+    RETRIEVAL_FAILED,
+    ServeResult,
+    log_once,
+    record_degraded,
+)
 
 __all__ = [
     "ServeScheduler",
@@ -106,6 +113,20 @@ def max_batch_queries() -> int:
 # (shared series across scheduler instances, like the serve stage
 # histograms; per-instance split rides the provider counters below)
 _H_QUEUE_WAIT = observe.histogram("pathway_serve_queue_wait_seconds")
+
+# requests shed at admission, by priority class — pre-created for the
+# known classes so the family renders at 0 before the first shed
+_C_SHED = {
+    p: observe.counter("pathway_serve_shed_total", priority=p)
+    for p in ("high", "normal", "low")
+}
+
+
+def _shed_classes() -> frozenset:
+    """Priority classes eligible for shedding (``serve.shed_priorities``,
+    CSV, default "low")."""
+    raw = str(config.get("serve.shed_priorities"))
+    return frozenset(p.strip().lower() for p in raw.split(",") if p.strip())
 
 # stateless shared no-op context manager for the untraced fast path
 _NOOP_CM = contextlib.nullcontext()
@@ -727,26 +748,63 @@ class ServeScheduler(_CoalescerBase):
         texts: Sequence[str],
         k: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        priority: Optional[str] = None,
     ) -> _Ticket:
         """Admit one serve request; returns a ticket (zero-arg callable /
         ``result(timeout)``) resolving to this request's ``ServeResult``.
         ``deadline`` defaults to the target's own policy
         (``deadline_ms``/``PATHWAY_SERVE_DEADLINE_MS``); a deadline too
-        tight for the coalescing window serves solo immediately."""
+        tight for the coalescing window serves solo immediately.
+        ``priority`` (high/normal/low; default ``serve.default_priority``)
+        is the load-shedding class — shed-class requests get an empty
+        ``load_shed``-flagged result while a shed-enabled SLO burns."""
         if deadline is None:
             default = getattr(self.target, "_default_deadline", Deadline.from_env)
             deadline = default()
-        # SLO shed advisory (observe/slo.py): while a shed-enabled
-        # objective is burning past threshold, LOG + COUNT — admission
-        # is unchanged this round (ROADMAP item 2's backpressure acts on
-        # the same probe).  The probe is a throttled cached read; the
-        # advisory path may never fail or slow an admission.
+        if priority is None:
+            priority = config.get("serve.default_priority")
+        priority = str(priority).lower()
+        # SLO-burn load shedding (observe/slo.py): while a shed-enabled
+        # objective (serve latency/availability, ingest freshness) burns
+        # past threshold, shed-class requests are turned away AT
+        # admission — an immediately-resolved ticket carrying an empty
+        # ``load_shed``-flagged ServeResult (counted, flagged, never an
+        # exception), zero dispatches, no window wait.  The probe is a
+        # throttled cached read and may never fail or slow an admission.
+        # ``PATHWAY_SERVE_SHED=0`` restores the round-15 advisory-only
+        # behavior (log + count, admit normally).
         if slo_mod.should_shed():
+            if config.get("serve.shed") and priority in _shed_classes():
+                c = _C_SHED.get(priority)
+                if c is None:
+                    c = observe.counter(
+                        "pathway_serve_shed_total", priority=priority
+                    )
+                c.inc()
+                record_degraded(LOAD_SHED, 1)
+                with self._qlock:
+                    self.stats["shed"] = self.stats.get("shed", 0) + 1
+                ctx = trace.start_trace("serve.request", deadline=deadline)
+                if ctx is not None:
+                    ctx.annotate(priority=priority, shed=True)
+                req = _Request(list(texts), k or self.k, deadline)
+                req.trace = ctx
+                req.slots = list(range(len(texts)))
+                shed = ServeResult(
+                    [[] for _ in texts],
+                    degraded=(LOAD_SHED,),
+                    meta={"priority": priority, "shed": True},
+                )
+                req.batch = _Batch(
+                    lambda: shed, len(texts), 1, self._degrade_empty
+                )
+                req.event.set()
+                return _Ticket(self, req)
             log_once(
                 "serve.slo_shed",
                 "SLO burn-rate alert firing: should_shed() advises "
-                "load shedding (advisory only — admission unchanged; "
-                "see GET /slo)",
+                "load shedding (advisory only — PATHWAY_SERVE_SHED off "
+                "or priority not shed-class; see GET /slo)",
             )
             slo_mod.record_shed_advised()
         # per-request trace root (observe/trace.py): admission → cache →
@@ -809,8 +867,9 @@ class ServeScheduler(_CoalescerBase):
         texts: Sequence[str],
         k: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        priority: Optional[str] = None,
     ) -> ServeResult:
-        return self.submit(texts, k, deadline=deadline)()
+        return self.submit(texts, k, deadline=deadline, priority=priority)()
 
     __call__ = serve
 

@@ -1,0 +1,392 @@
+"""Live-index freshness plane + real load shedding (ISSUE 18).
+
+Four seams under test:
+
+- **runner**: connector commit → queue → bucketed embed → IVF/forward
+  absorb under the off-lock-plan/locked-commit discipline, generation
+  bumped, freshness histograms + per-stage attribution populated;
+- **traces**: one ``kind="ingest"`` trace per absorb batch rooted at
+  the oldest rider's arrival — the per-stage spans are contiguous and
+  sum to that document's ingest→retrievable latency, and a batch slower
+  than the freshness SLO threshold is force-kept like a slow serve;
+- **freshness SLO**: overdue queue residents burn budget BEFORE they
+  land (maintenance lag feeds the burn), and the landed histogram takes
+  over without double counting;
+- **the decision**: ``should_shed()`` graduates from advisory to a real
+  admission outcome — shed-class (low) priorities get an empty
+  ``load_shed``-flagged result while a shed-enabled objective fires,
+  high/normal priorities admit clean, ``PATHWAY_SERVE_SHED=0`` restores
+  the round-15 advisory, and a ``serve_latency`` burn backpressures the
+  ingest loop (the reverse edge of the control loop).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import config, observe
+from pathway_tpu.observe import recorder, slo, trace
+from pathway_tpu.robust import inject
+from pathway_tpu.serve import LiveIngestRunner, ServeScheduler, ingest_runners
+
+DOCS = {
+    i: f"live doc {i} about {topic} with streaming updates"
+    for i, topic in enumerate(
+        [
+            "incremental dataflow", "vector indexes", "exactly once",
+            "stream joins", "window aggregation", "schema registries",
+            "kafka offsets", "snapshot replay", "rag retrieval",
+            "sharded state", "commit ticks", "key ownership",
+        ]
+    )
+}
+QUERIES = ["rag retrieval serving", "exactly once stream"]
+
+
+class StubEncoder:
+    """Deterministic, instant [B, d] embeddings (unit tests that do not
+    need the real model); ``delay_s`` makes the embed stage visible to
+    the span-attribution assertions."""
+
+    def __init__(self, d: int = 8, delay_s: float = 0.0):
+        self.d = d
+        self.delay_s = delay_s
+
+    def encode_to_device(self, texts):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        rows = [
+            np.full(self.d, float(len(t) % 17) + 1.0, np.float32)
+            for t in texts
+        ]
+        return np.stack(rows)
+
+
+class StubIndex:
+    def __init__(self):
+        self.generation = 0
+        self.keys = []
+
+    def add(self, keys, vecs):
+        assert isinstance(vecs, np.ndarray)
+        self.keys.extend(int(k) for k in keys)
+        self.generation += 1
+        return self.generation
+
+
+@pytest.fixture(scope="module")
+def serve_stack():
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+    from pathway_tpu.models.encoder import SentenceEncoder
+    from pathway_tpu.ops.ivf import IvfKnnIndex
+    from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+    from pathway_tpu.ops.serving import FusedEncodeSearch
+
+    enc = SentenceEncoder(
+        dimension=16, n_layers=1, n_heads=2, max_length=16,
+        vocab_size=256, dtype=jnp.float32,
+    )
+    ce = CrossEncoderModel(
+        dimension=16, n_layers=1, n_heads=2, max_length=32,
+        vocab_size=256, dtype=jnp.float32,
+    )
+    ivf = IvfKnnIndex(dimension=16, metric="cos", n_clusters=4, n_probe=4)
+    keys = sorted(DOCS)
+    ivf.add(keys, enc.encode([DOCS[i] for i in keys]))
+    ivf.build()
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, ivf, k=8), ce, DOCS, k=3, candidates=8
+    )
+    pipe(QUERIES)  # warmup compile
+    return enc, ce, ivf, pipe
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo_state():
+    inject.disarm()
+    yield
+    inject.disarm()
+    slo.reset()
+
+
+def _firing_engine(spec_name: str, hist_tag: str):
+    """A fresh engine whose one shed-enabled latency objective is
+    FIRING (test_profile.py's synthetic-inflation idiom)."""
+    spec = slo.SloSpec(
+        spec_name,
+        "latency",
+        objective=0.999,
+        hist=f"pathway_test_{hist_tag}_seconds",
+        threshold_s=0.01,
+        shed=True,
+    )
+    engine = slo.SloEngine([spec])
+    hist = observe.histogram(f"pathway_test_{hist_tag}_seconds")
+    engine.evaluate(max_age_s=0.0)  # baseline snapshot
+    for _ in range(300):
+        hist.observe_ns(500_000_000)
+    assert engine.evaluate(max_age_s=0.0)["should_shed"] is True
+    return engine
+
+
+# -- runner: commit → retrievable -------------------------------------------
+
+
+def test_connector_commit_to_absorb_bumps_generation():
+    idx = StubIndex()
+    fresh0 = observe.histogram("pathway_freshness_seconds").count
+    with LiveIngestRunner(StubEncoder(), idx, name="t-basic") as runner:
+        assert runner in ingest_runners()
+        conn = runner.connector("src0")
+        conn.insert(1, "first live doc")
+        conn.insert_rows([(2, "second"), (3, "third")])
+        offsets = conn.commit(offsets={"p0": 3})
+        assert offsets.as_dict() == {"p0": 3}
+        assert runner.flush(timeout=10.0)
+    assert sorted(idx.keys) == [1, 2, 3]
+    assert idx.generation >= 1
+    assert runner.stats["docs"] == 3 and runner.stats["dropped"] == 0
+    # every rider stamped arrival→retrievable
+    assert observe.histogram("pathway_freshness_seconds").count == fresh0 + 3
+    stats = conn.monitor.stats()
+    assert stats["offsets"] == {"p0": 3}
+    assert stats["last_commit_at"] is not None
+
+
+def test_runner_is_a_recorder_provider_with_ingest_column():
+    idx = StubIndex()
+    with LiveIngestRunner(StubEncoder(), idx, name="t-column") as runner:
+        conn = runner.connector("kafka-0")
+        conn.insert_rows([(10, "a"), (11, "bb")])
+        conn.commit(offsets={"0": 2})
+        assert runner.flush(timeout=10.0)
+        col = recorder.snapshot()["ingest"]["t-column"]
+    assert col["pathway_ingest_docs_total"] == 2.0
+    assert col["pathway_ingest_pending_docs"] == 0.0
+    assert 'pathway_ingest_connector_lag_seconds{connector="kafka-0"}' in col
+    assert 'pathway_freshness_quantile_seconds{q="0.99"}' in col
+
+
+# -- traces: per-stage spans sum to ingest→retrievable -----------------------
+
+
+def test_freshness_spans_sum_to_arrival_to_retrievable(monkeypatch):
+    # 1 ms threshold + a 5 ms embed: every batch is slower than the
+    # freshness objective, so its trace is force-kept like a slow serve
+    monkeypatch.setenv("PATHWAY_SLO_FRESHNESS_MS", "1")
+    idx = StubIndex()
+    with LiveIngestRunner(
+        StubEncoder(delay_s=0.005), idx, name="t-spans"
+    ) as runner:
+        conn = runner.connector()
+        conn.insert(42, "the attributed document")
+        conn.commit()
+        assert runner.flush(timeout=10.0)
+    kept = [
+        t for t in trace.snapshot_traces()["traces"]
+        if t["name"] == "ingest.batch"
+    ]
+    assert kept, "a slower-than-SLO ingest batch must keep its trace"
+    t = kept[0]
+    assert t["kind"] == "ingest" and t["keep_reason"] == "forced"
+    assert t["attrs"]["docs"] == 1
+    assert t["attrs"]["generation"] == t["attrs"]["generation_before"] + 1
+    root = t["root"]
+    stages = root["children"]
+    assert [s["name"] for s in stages] == [
+        "ingest.queue_wait", "ingest.embed",
+        "ingest.absorb_plan", "ingest.commit",
+    ]
+    # contiguous: each stage starts where the previous ended, the first
+    # at the (oldest) arrival the trace is rooted at
+    assert stages[0]["start_ms"] == 0.0
+    for prev, nxt in zip(stages, stages[1:]):
+        assert nxt["start_ms"] == pytest.approx(
+            prev["start_ms"] + prev["duration_ms"], abs=1e-6
+        )
+    # ... so the stage durations SUM to arrival→retrievable; the root
+    # only adds the finish-call overhead beyond the commit instant
+    total_ms = sum(s["duration_ms"] for s in stages)
+    assert stages[1]["duration_ms"] >= 4.0  # the injected embed cost
+    assert total_ms <= root["duration_ms"]
+    assert root["duration_ms"] - total_ms < 5.0
+
+
+# -- freshness SLO: maintenance lag burns before the doc lands ---------------
+
+
+def test_overdue_pending_docs_burn_freshness_budget():
+    spec = slo.SloSpec(
+        "test_freshness",
+        "freshness",
+        objective=0.99,
+        hist="pathway_test_overdue_seconds",
+        threshold_s=0.01,
+        shed=True,
+    )
+    engine = slo.SloEngine([spec])
+    engine.evaluate(max_age_s=0.0)  # baseline: empty plane, green
+    runner = LiveIngestRunner(
+        StubEncoder(), StubIndex(), name="t-overdue", autostart=False
+    )
+    try:
+        conn = runner.connector()
+        conn.insert_rows([(i, f"stalled {i}") for i in range(5)])
+        conn.commit()
+        time.sleep(0.03)  # runner stopped: the backlog ages past 10 ms
+        assert runner.overdue_pending(0.01) == 5
+        doc = engine.evaluate(max_age_s=0.0)
+        row = doc["slos"]["test_freshness"]
+        # 5 overdue residents, 0 good events: the burn fires NOW, before
+        # a single document has landed in the histogram
+        assert row["state"] == "firing", row
+        assert doc["should_shed"] is True
+        # drain: landed documents leave the overdue term (the ring
+        # differences cumulative snapshots — no double count)
+        runner.start()
+        assert runner.flush(timeout=10.0)
+        assert runner.overdue_pending(0.01) == 0
+        assert runner.pending_docs() == 0
+    finally:
+        runner.stop()
+
+
+def test_default_freshness_spec_reads_env_threshold(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SLO_FRESHNESS_MS", "2500")
+    by_name = {s.name: s for s in slo.default_specs()}
+    fresh = by_name["freshness"]
+    assert fresh.kind == "freshness" and fresh.shed is True
+    assert fresh.threshold_s == pytest.approx(2.5)
+    assert fresh.hist == "pathway_freshness_seconds"
+
+
+# -- the decision: priorities + shed-under-burn ------------------------------
+
+
+def test_priority_classes_admit_clean_while_green(serve_stack):
+    _enc, _ce, _ivf, pipe = serve_stack
+    slo.reset()  # the real env engine: green baseline
+    assert config.get("serve.default_priority") == "normal"
+    assert config.get("serve.shed") is True
+    with ServeScheduler(pipe, window_us=0, result_cache=None) as sched:
+        for prio in (None, "high", "normal", "LOW"):
+            got = sched.serve(QUERIES, priority=prio)
+            assert got.degraded == () and all(got), prio
+            assert "shed" not in got.meta
+
+
+def test_shed_decision_sheds_low_keeps_high_under_burn(serve_stack):
+    _enc, _ce, _ivf, pipe = serve_stack
+    engine = _firing_engine("test_burn", "burn")
+    slo._engine = engine  # direct install: set_engine() would re-read env
+    shed0 = slo.shed_advisory_enabled()
+    slo.set_shed_advisory(True)
+    shed_low = observe.counter("pathway_serve_shed_total", priority="low")
+    try:
+        assert slo.should_shed() is True
+        before = shed_low.value
+        with ServeScheduler(pipe, window_us=0, result_cache=None) as sched:
+            low = sched.serve(QUERIES, priority="low")
+            # the real decision: empty, flagged, counted — never raised
+            assert low.degraded == ("load_shed",)
+            assert low.meta["shed"] is True and low.meta["priority"] == "low"
+            assert all(rows == [] for rows in low)
+            assert shed_low.value == before + 1
+            assert sched.stats["shed"] == 1
+            # high and normal stay clean through the same burn — the
+            # shed protects them instead of rationing uniformly
+            high = sched.serve(QUERIES, priority="high")
+            norm = sched.serve(QUERIES)
+            assert high.degraded == () and all(high)
+            assert norm.degraded == () and all(norm)
+    finally:
+        slo.set_shed_advisory(shed0)
+        slo.reset()
+
+
+def test_shed_disabled_restores_advisory_admission(serve_stack, monkeypatch):
+    monkeypatch.setenv("PATHWAY_SERVE_SHED", "0")
+    _enc, _ce, _ivf, pipe = serve_stack
+    engine = _firing_engine("test_adv", "adv")
+    slo._engine = engine
+    shed0 = slo.shed_advisory_enabled()
+    slo.set_shed_advisory(True)
+    advised = observe.counter("pathway_slo_shed_advised_total")
+    try:
+        before = advised.value
+        with ServeScheduler(pipe, window_us=0, result_cache=None) as sched:
+            got = sched.serve(QUERIES, priority="low")
+        # round-15 behavior: logged + counted, admitted, results clean
+        assert got.degraded == () and all(got)
+        assert advised.value > before
+    finally:
+        slo.set_shed_advisory(shed0)
+        slo.reset()
+
+
+def test_serve_latency_burn_backpressures_ingest():
+    engine = _firing_engine("serve_latency", "bp")
+    slo._engine = engine
+    shed0 = slo.shed_advisory_enabled()
+    slo.set_shed_advisory(True)
+    idx = StubIndex()
+    try:
+        with LiveIngestRunner(StubEncoder(), idx, name="t-bp") as runner:
+            conn = runner.connector()
+            conn.insert(7, "under pressure")
+            conn.commit()
+            # the loop yields absorb cadence while serve_latency is the
+            # binding constraint — but still makes progress (a delay,
+            # never a stall)
+            assert runner.flush(timeout=10.0)
+            deadline = time.monotonic() + 5.0
+            while (
+                runner.stats["backpressure"] == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert runner.stats["backpressure"] > 0
+        assert idx.keys == [7]
+    finally:
+        slo.set_shed_advisory(shed0)
+        slo.reset()
+
+
+# -- absorb under live serve traffic ----------------------------------------
+
+
+def test_mid_run_document_becomes_retrievable_under_serve(serve_stack):
+    from pathway_tpu.ops.retrieve_rerank import RetrieveRerankPipeline
+    from pathway_tpu.ops.serving import FusedEncodeSearch
+
+    enc, ce, ivf, _pipe = serve_stack
+    sentinel_key = 900
+    sentinel_text = "zebra quasar submarine fresh sentinel document"
+    docs = dict(DOCS)
+    docs[sentinel_key] = sentinel_text
+    # k == candidates: every stage-1 winner survives the rerank, so
+    # presence in the result IS stage-1 retrievability
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, ivf, k=8), ce, docs, k=8, candidates=8
+    )
+    gen0 = ivf.generation
+    with ServeScheduler(pipe, window_us=0, result_cache=None) as sched:
+        with LiveIngestRunner(enc, ivf, name="t-live") as runner:
+            conn = runner.connector("live-src")
+            # serve traffic before, during, and after the absorb
+            assert all(sched.serve(QUERIES))
+            conn.insert(sentinel_key, sentinel_text)
+            conn.commit(offsets={"p0": 1})
+            ticket = sched.submit(QUERIES)  # in flight while absorbing
+            assert runner.flush(timeout=30.0)
+            assert all(ticket())
+        assert ivf.generation > gen0
+        assert runner.stats["docs"] == 1
+        # the committed document is retrievable by the very next serve
+        got = sched.serve([sentinel_text])
+        assert got.degraded == ()
+        assert sentinel_key in [k for k, _score in got[0]]

@@ -15,8 +15,9 @@ parse it.  The workload below drives, in one process: the engine graph
 + a connector monitor, a sharded IVF + forward-index cascade serve
 (clean, degraded, retried, breaker-probed, host-merge-probed), the
 coalescing scheduler with all three cache tiers, a continuous-decode
-engine, an exchange plane pair, full-rate tracing and profiling, the
-HBM ledger, and the SLO engine.
+engine, an exchange plane pair, a live-ingest runner absorbing a
+committed document under the serve stack, full-rate tracing and
+profiling, the HBM ledger, and the SLO engine.
 """
 
 from __future__ import annotations
@@ -184,6 +185,19 @@ def rendered_families():
             flagged = sched.serve(["window aggregation state"])
         assert flagged.degraded == ("rerank_skipped",)  # ⇒ kept trace
     inject.disarm()
+
+    # live ingest + freshness plane (ISSUE 18): one committed batch
+    # absorbed under the serve stack renders the freshness histograms,
+    # the maintenance-lag gauges, and the per-connector offset lag; the
+    # runner object stays referenced so its provider is scraped below
+    from pathway_tpu.serve import LiveIngestRunner
+
+    ingest_runner = LiveIngestRunner(enc, ivf, name="inventory")
+    live_conn = ingest_runner.connector("inventory-live")
+    live_conn.insert(901, "freshness inventory probe doc")
+    live_conn.commit(offsets={"0": 1})
+    assert ingest_runner.flush(timeout=30.0)
+    ingest_runner.stop()
 
     # continuous decode + prefix KV cache (generator + prefill families)
     gen = TextGenerator(

@@ -371,15 +371,20 @@ def test_latency_threshold_snaps_to_bucket_bound():
 
 def test_default_specs_cover_serve_and_decode():
     names = {s.name for s in slo.default_specs()}
-    assert names == {"serve_latency", "serve_availability", "decode_ttlt"}
+    assert names == {
+        "serve_latency", "serve_availability", "decode_ttlt", "freshness",
+    }
     by_name = {s.name: s for s in slo.default_specs()}
     assert by_name["serve_latency"].shed is True
     assert by_name["serve_availability"].shed is True
     assert by_name["decode_ttlt"].shed is False
+    assert by_name["freshness"].shed is True
     assert by_name["serve_latency"].hist == "pathway_serve_request_seconds"
     assert (
         by_name["decode_ttlt"].hist == "pathway_generator_ttlt_seconds"
     )
+    assert by_name["freshness"].hist == "pathway_freshness_seconds"
+    assert by_name["freshness"].kind == "freshness"
 
 
 def test_throttled_evaluate_reuses_cached_doc():
@@ -393,9 +398,11 @@ def test_throttled_evaluate_reuses_cached_doc():
 
 
 def test_scheduler_shed_advisory_counts_but_admits(serve_stack):
-    """The advisory seam: with a firing shed-enabled objective, the
-    scheduler LOGS + COUNTS and admits normally — results identical,
-    nothing shed this round (ROADMAP item 2 acts on the probe)."""
+    """The advisory seam: with a firing shed-enabled objective, a
+    request OUTSIDE the shed classes (default priority ``normal``,
+    shed classes ``low``) is LOGGED + COUNTED and admitted normally —
+    results identical.  The real decision for shed-class priorities
+    lives in tests/test_live_ingest.py."""
     from pathway_tpu.serve import ServeScheduler
 
     _enc, _ce, _ivf, pipe = serve_stack
@@ -440,7 +447,7 @@ def test_slo_endpoint_serves_burn_rate_document(serve_stack):
         server.stop()
     assert doc["stale"] is False
     assert set(doc["slos"]) == {
-        "serve_latency", "serve_availability", "decode_ttlt"
+        "serve_latency", "serve_availability", "decode_ttlt", "freshness"
     }
     for row in doc["slos"].values():
         assert {"fast", "slow"} <= set(row["windows"])

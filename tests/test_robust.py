@@ -20,7 +20,12 @@ observability triple profile.sample / hbm.ledger / slo.evaluate
 (ISSUE 12: a faulted profiler sample is dropped and counted, a faulted
 ledger sample serves the last-known bytes stale-flagged, a faulted SLO
 evaluation serves the last-known burn-rate document — the serve is
-never failed, slowed, or shed by its own observability).
+never failed, slowed, or shed by its own observability), and the
+live-ingest triple ingest.poll / ingest.embed / ingest.commit
+(ISSUE 18: a faulted poll RETRIES with nothing lost; a faulted embed or
+commit DROPS only that batch's documents, counted on
+``pathway_ingest_failures_total{stage}``, with serve results staying
+clean and bit-identical because the index simply does not advance).
 
 Plus: Deadline / RetryPolicy / CircuitBreaker / ServeResult units,
 ``PATHWAY_FAULTS`` parsing, the missing-doc response-metadata
@@ -1539,3 +1544,111 @@ def test_tuner_adjust_chaos_freezes_never_raises():
     assert (
         observe.counter("pathway_tuner_faults_total").value == before + 1
     )
+
+
+# -- chaos: live ingest (ISSUE 18) -------------------------------------------
+
+
+def _ingest_failures(stage: str) -> int:
+    return observe.counter(
+        "pathway_ingest_failures_total", stage=stage
+    ).value
+
+
+def test_ingest_poll_chaos_triple_retries_never_loses_docs(stack):
+    """``ingest.poll`` armed raise, delay, and hang: a faulted poll
+    RETRIES — the documents never leave the queue, nothing is dropped,
+    and once the site clears every one of them lands.  The spent-deadline
+    fire means even a 30 s hang releases instantly."""
+    from pathway_tpu.serve import LiveIngestRunner
+
+    class _Enc:
+        def encode_to_device(self, texts):
+            return np.ones((len(texts), 4), np.float32)
+
+    class _Idx:
+        def __init__(self):
+            self.generation = 0
+            self.keys = []
+
+        def add(self, keys, vecs):
+            self.keys.extend(int(k) for k in keys)
+            self.generation += 1
+            return self.generation
+
+    idx = _Idx()
+    with LiveIngestRunner(_Enc(), idx, name="chaos-poll") as runner:
+        conn = runner.connector()
+        for mode, kwargs in (
+            ("raise", {}),
+            ("delay", {"delay_s": 5.0}),   # clamped by the spent-
+            ("hang", {"hang_s": 30.0}),    # deadline fire
+        ):
+            failures0 = _ingest_failures("poll")
+            t0 = time.monotonic()
+            with inject.armed("ingest.poll", mode, times=1, **kwargs):
+                conn.insert(len(idx.keys) + 1, f"retried {mode}")
+                conn.commit()
+                assert runner.flush(timeout=10.0), mode
+            elapsed = time.monotonic() - t0
+            assert _ingest_failures("poll") > failures0, mode
+            assert elapsed < 3.0, (mode, elapsed)
+        # RETRY semantics: every committed document landed anyway
+        assert sorted(idx.keys) == [1, 2, 3]
+        assert runner.stats["dropped"] == 0
+
+
+@pytest.mark.parametrize("site", ["ingest.embed", "ingest.commit"])
+def test_ingest_stage_chaos_triple_drops_batch_serve_bit_identical(
+    stack, site
+):
+    """``ingest.embed`` / ``ingest.commit`` armed raise, delay, and
+    hang: the fault DROPS only that batch's documents (counted on
+    ``pathway_ingest_failures_total{stage}``) — serve results stay
+    clean and BIT-IDENTICAL because the index simply does not advance,
+    and the loop is never stalled.  Disarmed, the next commit lands."""
+    from pathway_tpu.serve import LiveIngestRunner, ServeScheduler
+
+    enc, ce, _shared = stack
+    index = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+    index.add(sorted(DOCS), enc.encode([DOCS[i] for i in sorted(DOCS)]))
+    pipe = RetrieveRerankPipeline(
+        FusedEncodeSearch(enc, index, k=8), ce, DOCS, k=5, candidates=16,
+        rerank_breaker=CircuitBreaker(
+            "test-ce-ingest", failure_threshold=100, reset_s=60
+        ),
+    )
+    stage = site.split(".")[1]
+    with ServeScheduler(pipe, window_us=0, result_cache=None) as sched:
+        clean = sched.serve(QUERIES)
+        assert clean.degraded == () and all(clean)
+        with LiveIngestRunner(enc, index, name=f"chaos-{stage}") as runner:
+            conn = runner.connector()
+            dropped = 0
+            for mode, kwargs in (
+                ("raise", {}),
+                ("delay", {"delay_s": 5.0}),
+                ("hang", {"hang_s": 30.0}),
+            ):
+                failures0 = _ingest_failures(stage)
+                t0 = time.monotonic()
+                with inject.armed(site, mode, times=1, **kwargs):
+                    conn.insert(900 + dropped, f"poisoned doc {mode}")
+                    conn.commit()
+                    assert runner.flush(timeout=10.0), mode
+                elapsed = time.monotonic() - t0
+                dropped += 1
+                assert _ingest_failures(stage) == failures0 + 1, mode
+                assert elapsed < 3.0, (mode, elapsed)
+                # the faulted stage cost ONLY its own documents: the
+                # index never advanced, so the serve is bit-identical
+                got = sched.serve(QUERIES)
+                assert got.degraded == (), mode
+                assert list(got) == list(clean), mode
+            assert runner.stats["dropped"] == 3
+            assert runner.stats["docs"] == 0
+            # disarmed: the degrade was transient, the next doc lands
+            conn.insert(990, "healthy after the storm")
+            conn.commit()
+            assert runner.flush(timeout=10.0)
+            assert runner.stats["docs"] == 1
