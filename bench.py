@@ -34,6 +34,7 @@ phases once the budget is spent rather than dying mid-measurement.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import subprocess
@@ -3267,6 +3268,366 @@ def phase_live_ingest(backend: str, extras: dict) -> float:
     return round(staleness_p99_ms, 3)
 
 
+def phase_serve_fabric(backend: str, extras: dict) -> float:
+    """Multi-host serve fabric (ISSUE 19: serve/fabric.py +
+    serve/warmstate.py): a 3-worker replica group (each worker its own
+    ServeScheduler over the shared retrieve→rerank stack) behind one
+    ``ServeFabric`` front-end, driven at c16.  Measures the healthy
+    baseline, then a KILL-ONE-HOST burst (every affected request flagged
+    ``host_failover`` with rows from a survivor, zero exceptions,
+    breaker open, re-route within one heartbeat budget), the 2+2
+    per-batch dispatch budget on the SURVIVING hosts, p99 during a full
+    rolling bounce of every worker (the zero-downtime bar), and the
+    warm-restore vs cold-ingest bring-up ratio (a replacement replica
+    restoring the writer's snapshot vs re-embedding the corpus).  The
+    phase value is the rolling-bounce p99 in ms."""
+    jax = _init_jax(backend)
+
+    from pathway_tpu import robust
+    from pathway_tpu.ops import dispatch_counter
+    from pathway_tpu.ops.ivf import IvfKnnIndex
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.robust import HOST_FAILOVER
+    from pathway_tpu.serve import (
+        FabricWorker,
+        ServeFabric,
+        ServeScheduler,
+        WarmStateManager,
+        fabric_token,
+    )
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    n_docs = int(os.environ.get("BENCH_SF_DOCS", "20000" if on_tpu else "1000"))
+    k, candidates = 10, 32
+    pipe, _cross, docs, _queries = _build_rr_pipeline(
+        n_docs, 16, k, candidates, small=not on_tpu
+    )
+    encoder = pipe.retriever.encoder
+    dim = 384 if on_tpu else 64
+
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(32)
+    ]
+    window_us = float(os.environ.get("BENCH_SF_WINDOW_US", "5000"))
+    max_batch = int(
+        os.environ.get("BENCH_SF_MAX_BATCH", "16" if on_tpu else "4")
+    )
+    # warm every compile shape the fleet touches: solo serves plus every
+    # coalesced composition a per-host scheduler can form
+    for q in pool:
+        pipe([q], k)
+    for b in range(2, max_batch + 1):
+        pipe(sorted(set(pool))[:b], k)
+
+    conc = 16
+    n_req = int(os.environ.get("BENCH_SF_REQUESTS", str(conc * 6)))
+    n_hosts = 3
+    hb_s, hb_timeout_s = 0.1, 0.5
+    env_prev = {
+        kk: os.environ.get(kk)
+        for kk in ("PATHWAY_FABRIC_HEARTBEAT", "PATHWAY_FABRIC_HEARTBEAT_TIMEOUT")
+    }
+    os.environ["PATHWAY_FABRIC_HEARTBEAT"] = str(hb_s)
+    os.environ["PATHWAY_FABRIC_HEARTBEAT_TIMEOUT"] = str(hb_timeout_s)
+
+    token = fabric_token()
+    names = [f"bench-sf-{i}" for i in range(n_hosts)]
+
+    def make_host(i: int):
+        sched = ServeScheduler(
+            pipe, window_us=window_us, max_batch=max_batch,
+            result_cache=None, name=f"{names[i]}-s",
+        )
+        worker = FabricWorker(sched, token=token, name=names[i])
+        return sched, worker
+
+    scheds, workers = [], []
+    for i in range(n_hosts):
+        s, w = make_host(i)
+        scheds.append(s)
+        workers.append(w)
+    fabric = ServeFabric(
+        {w.name: w.address for w in workers}, token, name="bench-fabric"
+    )
+
+    def crash(i: int) -> None:
+        """Unplanned death: listener + live streams die with NO bye."""
+        workers[i].kill()
+        scheds[i].stop()
+
+    def drive(n: int, on_each=None):
+        """c16 barrier drive through the fabric; returns per-request
+        (latency ms, degraded flags, rows-landed) plus raised errors."""
+        reqs = [pool[(i * 7) % len(pool)] for i in range(n)]
+        lats: list = [None] * n
+        flags: list = [()] * n
+        rows_ok = [False] * n
+        errs: list = []
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=60)
+                for i in range(t, n, conc):
+                    t0 = time.perf_counter()
+                    res = fabric.serve([reqs[i]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    flags[i] = tuple(res.degraded)
+                    rows_ok[i] = bool(res and res[0])
+                    if on_each is not None:
+                        on_each(i)
+            except Exception as exc:  # the contract: NEVER an exception
+                errs.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        t_all = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_all
+        return lats, flags, rows_ok, errs, elapsed
+
+    bounce_p99_ms = 0.0
+    try:
+        assert fabric.connect() == n_hosts
+
+        # -- healthy baseline: c16, no failures, no degraded flags --
+        drive(conc * 2)  # settle the per-host batch compositions
+        lats, flags, rows_ok, errs, elapsed = drive(n_req)
+        assert errs == [], errs[:3]
+        assert all(rows_ok), "healthy fleet must serve every request"
+        assert not any(flags), f"healthy fleet degraded: {flags}"
+        done = np.asarray([l for l in lats if l is not None])
+        p99_healthy = float(np.percentile(done, 99))
+        extras["fabric_hosts"] = n_hosts
+        extras["fabric_qps_healthy_c16"] = round(n_req / elapsed, 2)
+        extras["fabric_p50_healthy_ms"] = round(float(np.percentile(done, 50)), 3)
+        extras["fabric_p99_healthy_ms"] = round(p99_healthy, 3)
+
+        # -- kill-one-host burst: crash host 0 while it holds in-flight
+        # requests; every affected request re-routes to a survivor --
+        killed = threading.Event()
+
+        def killer():
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30:
+                if fabric._links[0].inflight > 0:
+                    break
+                time.sleep(0.002)
+            crash(0)
+            killed.set()
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        lats, flags, rows_ok, errs, _elapsed = drive(n_req)
+        kt.join()
+        assert killed.is_set()
+        assert errs == [], errs[:3]
+        assert all(rows_ok), "survivors must serve every request"
+        failover_lats = [
+            lats[i] for i in range(n_req)
+            if HOST_FAILOVER in flags[i] and lats[i] is not None
+        ]
+        assert failover_lats, "the kill burst never caught an in-flight request"
+        # re-route within one heartbeat: a dead socket fails in-flights
+        # immediately and heartbeat silence is bounded by the timeout —
+        # the affected request pays at most one heartbeat timeout plus a
+        # normal (contended) serve on the survivor
+        reroute_budget_ms = hb_timeout_s * 1e3 + max(2000.0, 5 * p99_healthy)
+        extras["fabric_kill_failovers"] = len(failover_lats)
+        extras["fabric_reroute_max_ms"] = round(max(failover_lats), 3)
+        extras["fabric_reroute_budget_ms"] = round(reroute_budget_ms, 1)
+        assert max(failover_lats) < reroute_budget_ms, (
+            max(failover_lats), reroute_budget_ms,
+        )
+        breaker0 = robust.breaker(f"fabric:{names[0]}")
+        assert breaker0.state != "closed", breaker0.state
+        assert not fabric._links[0].up()
+        extras["fabric_breaker_after_kill"] = breaker0.state
+
+        # -- 2+2 per-batch dispatch budget on the SURVIVING hosts --
+        def fleet_batches():
+            return sum(
+                scheds[i].stats["batches"] + scheds[i].stats["solo"]
+                for i in range(1, n_hosts)
+            )
+
+        b0 = fleet_batches()
+        res: list = []
+        burst_errs: list = []
+        barrier = threading.Barrier(8)
+
+        def burst_worker(q):
+            try:
+                barrier.wait(timeout=60)
+                res.append(fabric.serve([q], k))
+            except Exception as exc:
+                burst_errs.append(repr(exc))
+
+        with dispatch_counter.DispatchCounter() as counter:
+            threads = [
+                threading.Thread(target=burst_worker, args=(q,))
+                for q in pool[:8]
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert burst_errs == [], burst_errs[:3]
+        assert all(r and r[0] for r in res)
+        batches = max(1, fleet_batches() - b0)
+        extras["fabric_dispatches_per_batch_survivors"] = round(
+            counter.dispatches / batches, 2
+        )
+        extras["fabric_fetches_per_batch_survivors"] = round(
+            counter.fetches / batches, 2
+        )
+        assert counter.dispatches <= 2 * batches, (counter.events, batches)
+        assert counter.fetches <= 2 * batches, (counter.events, batches)
+
+        # -- rolling bounce of the FULL fleet under continuous load --
+        def restart(i: int) -> None:
+            """A restarting process re-binds the bounced listener's port
+            (retrying until TIME_WAIT clears) and re-joins the fabric."""
+            port = workers[i].port
+            workers[i].stop()
+            scheds[i].stop()
+            scheds[i] = ServeScheduler(
+                pipe, window_us=window_us, max_batch=max_batch,
+                result_cache=None, name=f"{names[i]}-s2",
+            )
+            t0 = time.monotonic()
+            while True:
+                try:
+                    workers[i] = FabricWorker(
+                        scheds[i], host="127.0.0.1", port=port,
+                        token=token, name=names[i],
+                    )
+                    break
+                except OSError:
+                    if time.monotonic() - t0 > 15:
+                        raise
+                    time.sleep(0.05)
+            # the breaker half-opens after one heartbeat timeout; an
+            # affinity-routed probe closes it again
+            q = next(
+                q for q in (f"rejoin probe {j}" for j in itertools.count())
+                if fabric._affinity(q) == i
+            )
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30:
+                got = fabric.serve([q], k)
+                if got.meta.get("fabric_host") == names[i]:
+                    return
+                time.sleep(0.05)
+            raise RuntimeError(f"worker {i} never re-joined the fabric")
+
+        restart(0)  # bring the killed host back before the bounce
+        stop_serving = threading.Event()
+        bounce_lats: list = []
+        bounce_errs: list = []
+        bounce_lock = threading.Lock()
+
+        def bounce_driver(qi: int):
+            while not stop_serving.is_set():
+                try:
+                    t0 = time.perf_counter()
+                    got = fabric.serve([pool[qi % len(pool)]], k)
+                    lat = (time.perf_counter() - t0) * 1e3
+                    with bounce_lock:
+                        bounce_lats.append(lat)
+                        if not (len(got) == 1 and got[0]):
+                            bounce_errs.append(("empty", got.degraded))
+                except Exception as exc:
+                    with bounce_lock:
+                        bounce_errs.append(("raise", repr(exc)))
+                time.sleep(0.002)
+
+        drivers = [
+            threading.Thread(target=bounce_driver, args=(i,)) for i in range(8)
+        ]
+        for t in drivers:
+            t.start()
+        try:
+            for i in range(n_hosts):
+                restart(i)
+        finally:
+            stop_serving.set()
+            for t in drivers:
+                t.join(30)
+        assert bounce_errs == [], bounce_errs[:5]
+        assert len(bounce_lats) > 20, "the bounce drive never ramped"
+        bounce_p99_ms = float(np.percentile(np.asarray(bounce_lats), 99))
+        bounce_budget_ms = float(
+            os.environ.get("BENCH_SF_BOUNCE_BUDGET_MS", "0") or 0
+        ) or (hb_timeout_s * 1e3 + 10 * p99_healthy)
+        extras["fabric_bounce_requests"] = len(bounce_lats)
+        extras["fabric_bounce_p99_ms"] = round(bounce_p99_ms, 3)
+        extras["fabric_bounce_p99_vs_healthy_x"] = round(
+            bounce_p99_ms / max(p99_healthy, 1e-9), 3
+        )
+        extras["fabric_bounce_budget_ms"] = round(bounce_budget_ms, 1)
+        assert bounce_p99_ms < bounce_budget_ms, (
+            f"rolling-bounce p99 {bounce_p99_ms:.0f} ms exceeds the "
+            f"{bounce_budget_ms:.0f} ms budget"
+        )
+        for nm in names:
+            assert robust.breaker(f"fabric:{nm}").state == "closed", nm
+    finally:
+        fabric.stop()
+        for w in workers:
+            w.stop()
+        for s in scheds:
+            s.stop()
+        for kk, vv in env_prev.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+
+    # -- warm-restore vs cold-ingest bring-up: a replacement replica
+    # restores the writer's snapshot instead of re-embedding the corpus --
+    keys = list(range(n_docs))
+    t0 = time.perf_counter()
+    cold_index = IvfKnnIndex(
+        dimension=dim, metric="cos", n_clusters=16, n_probe=16
+    )
+    cold_index.add(keys, encoder.encode(docs))
+    q_emb = encoder.encode(pool[:2])
+    want = cold_index.search(q_emb, k=k)
+    t_cold = time.perf_counter() - t0
+
+    mgr = WarmStateManager(
+        MemoryBackend(), name="bench-sf", components={"ivf": cold_index}
+    )
+    assert mgr.snapshot() is not None
+    t0 = time.perf_counter()
+    replica = IvfKnnIndex(
+        dimension=dim, metric="cos", n_clusters=16, n_probe=16
+    )
+    report = WarmStateManager(
+        mgr.backend, name="bench-sf", components={"ivf": replica}
+    ).restore()
+    got = replica.search(q_emb, k=k)
+    t_warm = time.perf_counter() - t0
+    assert report.restored, report
+    # bit-identity: the warm-restored replica serves the writer's rows
+    assert np.array_equal(np.asarray(want[0]), np.asarray(got[0]))
+    assert np.array_equal(np.asarray(want[1]), np.asarray(got[1]))
+    warm_vs_cold = t_cold / max(t_warm, 1e-9)
+    extras["fabric_cold_ingest_s"] = round(t_cold, 3)
+    extras["fabric_warm_restore_s"] = round(t_warm, 3)
+    extras["fabric_warm_vs_cold_x"] = round(warm_vs_cold, 2)
+    assert warm_vs_cold > 1.0, (t_cold, t_warm)
+
+    return round(bounce_p99_ms, 3)
+
+
 def phase_wordcount(backend: str, extras: dict) -> float:
     """Relational engine throughput: rows/sec through groupby-count."""
     _init_jax("cpu")  # host-side engine bench; never needs the device
@@ -3607,6 +3968,7 @@ _PHASES = {
     "speculative_decode": (phase_speculative_decode, 450),
     "ingest": (phase_ingest, 900),
     "live_ingest": (phase_live_ingest, 600),
+    "serve_fabric": (phase_serve_fabric, 600),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
     "exchange": (phase_exchange, 450),
@@ -3841,6 +4203,7 @@ def main() -> None:
         ("speculative_decode", lambda: device_phase("speculative_decode")),
         ("ingest", lambda: device_phase("ingest")),
         ("live_ingest", lambda: device_phase("live_ingest")),
+        ("serve_fabric", lambda: device_phase("serve_fabric")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)
         ("exchange", lambda: run_phase("exchange", "cpu", extras, errors)),
@@ -3891,6 +4254,8 @@ def main() -> None:
             extras["ingest_docs_per_sec"] = round(value, 1)
         elif name == "live_ingest" and value is not None:
             extras["live_staleness_p99_ms"] = round(value, 3)
+        elif name == "serve_fabric" and value is not None:
+            extras["fabric_bounce_p99_ms"] = round(value, 3)
         elif name == "wordcount" and value is not None:
             extras["wordcount_rows_per_sec"] = round(value, 1)
         emit(partial=True)

@@ -35,7 +35,12 @@ they execute later, not under the lock):
   would stall every admitter instead of only the calling request.  The
   in-flight ownership pattern (persistence/object_cache.py
   ``get_or_compute``) is the sanctioned shape: the global lock guards
-  only the owner dict; compute, backend I/O and pickling run off it.
+  only the owner dict; compute, backend I/O and pickling run off it;
+- stream network I/O (``<stream|link|peer|conn>.send/.recv/
+  .send_request`` — the fabric/exchange convention): a frame send can
+  stall for a full heartbeat timeout on a congested peer and fires the
+  ``fabric.send``/``fabric.recv`` chaos sites.  The sanctioned shape is
+  serve/fabric.py's swap-under-lock / I/O-off-lock discipline.
 
 And the INVERSE scope check on serve-path modules: a trace span opened
 as a context manager (``with trace.span(...):`` / ``start_span`` /
@@ -69,6 +74,7 @@ from .registry import (
     is_jit_call,
     is_lock_context,
     is_observability_callback,
+    is_stream_io,
     scope_handle_vars,
     scope_jit_and_device_vars,
     walk_scope,
@@ -257,6 +263,7 @@ class LockDisciplineRule(Rule):
                 handle = is_handle_fetch(node, handle_vars)
                 cache = is_cache_access(node)
                 obs = is_observability_callback(node)
+                stream = is_stream_io(node)
                 if handle is not None:
                     ctx.report(
                         self.name, node,
@@ -285,4 +292,15 @@ class LockDisciplineRule(Rule):
                         "sites, may delay or hang); it belongs on "
                         "scrape/bench threads, never inside a serve-path "
                         "lock where the walk stalls every admitter",
+                    )
+                elif stream is not None:
+                    ctx.report(
+                        self.name, node,
+                        f"stream network I/O `{stream}(...)` under lock — "
+                        "a frame send can stall for a full heartbeat "
+                        "timeout on a congested peer and fires the "
+                        "fabric.send/fabric.recv chaos sites (delay/hang);"
+                        " swap the stream slot under the lock and perform "
+                        "the I/O after releasing it (the fabric "
+                        "mark_down/close discipline)",
                     )

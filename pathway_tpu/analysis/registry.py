@@ -46,6 +46,7 @@ __all__ = [
     "is_handle_fetch",
     "is_lock_context",
     "is_observability_callback",
+    "is_stream_io",
     "scope_handle_vars",
     "scope_jit_and_device_vars",
     "walk_scope",
@@ -129,6 +130,22 @@ _CACHE_WRAPPER_RE = re.compile(r"^_?(cached_\w+|get_or_\w+)$")
 # they would stall every admitter for the fault's duration.
 _CACHE_METHOD_RE = re.compile(r"^(get|put|lookup|store|admit|match)")
 _CACHE_RECEIVER_RE = re.compile(r"cache$", re.IGNORECASE)
+
+# the fabric stream convention (serve/fabric.py over the exchange
+# plane's FramedStream): ``<stream|link|peer|conn-spelled receiver>
+# .send/.recv/.send_request(...)`` is BLOCKING network I/O — a frame
+# send can stall for a full heartbeat timeout on a congested peer, a
+# recv blocks until a frame (or the socket timeout) lands, and both
+# fire the fabric.send/fabric.recv chaos sites (delay/hang).  Under a
+# serve-path lock one slow host becomes a fleet-wide admission stall —
+# the exact failure the fabric exists to contain.  The sanctioned shape
+# is fabric.py's swap-under-lock / I/O-off-lock discipline: mutate the
+# stream slot inside ``_conn_lock``, perform the send/recv/close after
+# releasing it (``mark_down``, ``close``, ``send_request``).
+_STREAM_IO_METHOD_RE = re.compile(r"^(send|recv|send_request)$")
+_STREAM_RECEIVER_RE = re.compile(
+    r"(^|_)(stream|link|peer|conn)s?$", re.IGNORECASE
+)
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:
@@ -414,6 +431,24 @@ def is_observability_callback(call: ast.Call) -> Optional[str]:
     if receiver is None:
         return None
     if _OBS_RECEIVER_RE.search(receiver.rsplit(".", 1)[-1]):
+        return f"{receiver}.{func.attr}"
+    return None
+
+
+def is_stream_io(call: ast.Call) -> Optional[str]:
+    """``<something spelled like a stream/link/peer>.send/recv/
+    send_request(...)`` — blocking network I/O by the fabric/exchange
+    convention.  Returns the dotted spelling for the diagnostic, or
+    None."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if not _STREAM_IO_METHOD_RE.match(func.attr):
+        return None
+    receiver = dotted_name(func.value)
+    if receiver is None:
+        return None
+    if _STREAM_RECEIVER_RE.search(receiver.rsplit(".", 1)[-1]):
         return f"{receiver}.{func.attr}"
     return None
 

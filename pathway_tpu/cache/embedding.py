@@ -125,6 +125,33 @@ class EmbeddingCache:
             key, row, nbytes=getattr(row, "nbytes", 64), deadline=deadline
         )
 
+    # -- durable warm state (serve/warmstate.py) -----------------------------
+    def warm_state(self) -> dict:
+        """Picklable snapshot: the device ``[d]`` rows are fetched to
+        host np arrays here — the one place this tier pays a host
+        transfer, and it runs on the snapshot cadence, never a serve."""
+        entries = [
+            (k, np.asarray(v), nbytes)
+            for k, v, nbytes in self._tier.warm_entries()
+        ]
+        return {"kind": "embedding_cache", "entries": entries}
+
+    def load_warm_state(self, state: dict) -> int:
+        """Re-upload snapshotted rows to device and replay them through
+        ``put`` (bring-up path; hits after restore are device-resident
+        again, bit-identical to the writer's rows)."""
+        if state.get("kind") != "embedding_cache":
+            raise ValueError(
+                f"not an embedding-cache warm state: {state.get('kind')!r}"
+            )
+        import jax.numpy as jnp
+
+        loaded = 0
+        for k, v, nbytes in state["entries"]:
+            if self._tier.put(k, jnp.asarray(v), nbytes=nbytes):
+                loaded += 1
+        return loaded
+
 
 def embedding_cache_from_env() -> Optional[EmbeddingCache]:
     """Serve-path construction: OPT-IN via ``PATHWAY_CACHE_EMBED=1``

@@ -127,6 +127,19 @@ class ResultCache:
             deadline=deadline,
         )
 
+    # -- durable warm state (serve/warmstate.py) -----------------------------
+    def warm_state(self) -> dict:
+        """Picklable snapshot of the live rows (values are host lists of
+        ``(key, score)`` pairs already — nothing to fetch)."""
+        return {"kind": "result_cache", "entries": self._tier.warm_entries()}
+
+    def load_warm_state(self, state: dict) -> int:
+        if state.get("kind") != "result_cache":
+            raise ValueError(
+                f"not a result-cache warm state: {state.get('kind')!r}"
+            )
+        return self._tier.load_warm_entries(state["entries"])
+
     def observe_metrics(self):  # delegate: one provider per tier is enough
         return iter(())
 

@@ -277,6 +277,32 @@ class CacheTier:
             self._entries.clear()
             self._bytes = 0
 
+    # -- durable warm state (serve/warmstate.py) -----------------------------
+    def warm_entries(self) -> List[Any]:
+        """LRU-ordered ``(key, value, nbytes)`` triples of the live,
+        unexpired entries (oldest first, so a replay preserves eviction
+        order).  Values are returned by REFERENCE — callers that need
+        host-picklable payloads (the embedding tier's device rows)
+        override this in the owning wrapper."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                (k, e.value, e.nbytes)
+                for k, e in self._entries.items()
+                if e.expires_at is None or now < e.expires_at
+            ]
+
+    def load_warm_entries(self, entries: List[Any]) -> int:
+        """Replay ``warm_entries()`` triples through ``put`` (fingerprints
+        recomputed, TTL clocks restart — a restored entry is as fresh as
+        a just-inserted one).  Returns the number of entries accepted;
+        a failed put is just a cold key, never an error."""
+        loaded = 0
+        for k, v, nbytes in entries:
+            if self.put(k, v, nbytes=nbytes):
+                loaded += 1
+        return loaded
+
     # -- internals -----------------------------------------------------------
     def _drop_locked(self, key: Any, entry: _Entry) -> None:
         self._entries.pop(key, None)

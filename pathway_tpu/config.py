@@ -186,6 +186,40 @@ _knob("serve.default_priority", "PATHWAY_SERVE_DEFAULT_PRIORITY", "enum",
       "normal", "priority class for submit() calls that pass none",
       choices=("high", "normal", "low"))
 
+# serve fabric (serve/fabric.py) — the cross-process replica-group tier
+_knob("fabric.heartbeat_s", "PATHWAY_FABRIC_HEARTBEAT", "float", 0.5,
+      "fabric host heartbeat ping interval in seconds",
+      lo=0.01, hi=3600.0)
+_knob("fabric.heartbeat_timeout_s", "PATHWAY_FABRIC_HEARTBEAT_TIMEOUT",
+      "float", 2.0, "heartbeat silence before a fabric host is declared "
+      "dead (breaker trips, in-flight tickets re-route)",
+      lo=0.05, hi=86_400.0)
+_knob("fabric.hedge_ms", "PATHWAY_FABRIC_HEDGE_MS", "float", 0.0,
+      "hedged-retry delay in ms: a request unanswered past this is "
+      "re-sent to a second healthy host, first response wins (0 = off)",
+      lo=0.0, hi=600_000.0, mutability=DYNAMIC)
+_knob("fabric.affinity_slack", "PATHWAY_FABRIC_AFFINITY_SLACK", "int", 2,
+      "extra in-flight requests the consistent-hash affinity host may "
+      "carry over the least-loaded host before routing spills",
+      lo=0, hi=4096)
+_knob("fabric.connect_timeout_s", "PATHWAY_FABRIC_CONNECT_TIMEOUT",
+      "float", 5.0, "fabric host TCP connect timeout in seconds",
+      lo=0.05, hi=600.0)
+_knob("fabric.request_timeout_s", "PATHWAY_FABRIC_REQUEST_TIMEOUT",
+      "float", 30.0, "fallback per-request response timeout in seconds "
+      "for requests that carry no deadline", lo=0.05, hi=86_400.0)
+
+# durable warm state (serve/warmstate.py)
+_knob("warmstate.interval_s", "PATHWAY_WARMSTATE_INTERVAL_S", "float",
+      60.0, "warm-state snapshot cadence in seconds (0 = manual only)",
+      lo=0.0, hi=86_400.0, mutability=DYNAMIC)
+_knob("warmstate.chunk_bytes", "PATHWAY_WARMSTATE_CHUNK_BYTES", "int",
+      1_048_576, "CRC-framed snapshot chunk size in bytes",
+      lo=4096, hi=1_073_741_824)
+_knob("warmstate.keep", "PATHWAY_WARMSTATE_KEEP", "int", 2,
+      "committed snapshot generations retained per store",
+      lo=1, hi=1024)
+
 # live ingest (serve/ingest.py)
 _knob("ingest.batch_docs", "PATHWAY_INGEST_BATCH_DOCS", "int", 32,
       "max documents one ingest embed/absorb batch carries",

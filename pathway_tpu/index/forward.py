@@ -575,6 +575,92 @@ class ForwardIndex:
                 if slot is not None:
                     self._release_slot(slot)
 
+    # -- durable warm state (serve/warmstate.py) -----------------------------
+    def warm_state(self) -> Dict[str, Any]:
+        """Snapshot the compressed row buckets + host bookkeeping so a
+        restored replica gathers bit-identically to this index.  Refs are
+        captured under the lock; the device→host fetch happens OFF the
+        lock (the absorb scatter is functional, so snapshotted refs stay
+        valid even if a commit lands mid-fetch)."""
+        with self._lock:
+            tok, scales, nvalid = self._tok, self._scales, self._nvalid
+            state: Dict[str, Any] = {
+                "kind": "forward",
+                "dimension": int(self.dimension),
+                "tokens_per_doc": int(self.tokens_per_doc),
+                "quant": self.quant,
+                "capacity": int(self._capacity),
+                "slot_of_key": dict(self._slot_of_key),
+                "free": list(self._free),
+                "next_slot": int(self._next_slot),
+                "key_version": dict(self._key_version),
+                "ntok_by_slot": (
+                    None if self._ntok_by_slot is None
+                    else np.array(self._ntok_by_slot)
+                ),
+                "nvalid_host": (
+                    None if self._nvalid_host is None
+                    else np.array(self._nvalid_host)
+                ),
+                "tokens_stored": int(self._tokens_stored),
+                "raw_tokens_live": int(self._raw_tokens_live),
+                "generation": int(self.generation),
+            }
+        state["tok"] = None if tok is None else np.asarray(tok)
+        state["scales"] = None if scales is None else np.asarray(scales)
+        state["nvalid"] = None if nvalid is None else np.asarray(nvalid)
+        return state
+
+    def load_warm_state(self, state: Dict[str, Any]) -> None:
+        """Install a ``warm_state()`` snapshot (replica bring-up).  The
+        uploads run OFF the lock; the locked install is a pointer swap,
+        so an in-flight gather finishes against the old buckets.  Raises
+        ``ValueError`` on a geometry/quant mismatch — the warm-state
+        manager degrades that to a counted cold start, never a wrong
+        index.  The restored ``generation`` matches the writer's, so
+        cache/dedup keys agree across the fabric."""
+        if state.get("kind") != "forward":
+            raise ValueError(
+                f"not a forward warm state: {state.get('kind')!r}"
+            )
+        for field in ("dimension", "tokens_per_doc"):
+            if int(state[field]) != int(getattr(self, field)):
+                raise ValueError(
+                    f"{field} mismatch: snapshot {state[field]} "
+                    f"vs index {getattr(self, field)}"
+                )
+        if state["quant"] != self.quant:
+            raise ValueError(
+                f"quant mismatch: snapshot {state['quant']!r} "
+                f"vs index {self.quant!r}"
+            )
+        tok = None if state["tok"] is None else jnp.asarray(state["tok"])
+        scales = (
+            None if state["scales"] is None else jnp.asarray(state["scales"])
+        )
+        nvalid = (
+            None if state["nvalid"] is None else jnp.asarray(state["nvalid"])
+        )
+        with self._lock:
+            self._tok = tok
+            self._scales = scales
+            self._nvalid = nvalid
+            self._capacity = int(state["capacity"])
+            self._slot_of_key = {
+                int(k): int(s) for k, s in state["slot_of_key"].items()
+            }
+            self._free = [int(s) for s in state["free"]]
+            self._next_slot = int(state["next_slot"])
+            self._key_version = {
+                int(k): int(v) for k, v in state["key_version"].items()
+            }
+            self._ntok_by_slot = state["ntok_by_slot"]
+            self._nvalid_host = state["nvalid_host"]
+            self._tokens_stored = int(state["tokens_stored"])
+            self._raw_tokens_live = int(state["raw_tokens_live"])
+            self.generation = int(state["generation"])
+            self._fns.clear()  # capacity may differ — re-specialize lazily
+
     # -- serve-path gather --------------------------------------------------
     def gather_submit(
         self,

@@ -1108,6 +1108,97 @@ class IvfKnnIndex:
             self._search_fns.clear()
             self.stats["sync_builds"] += 1
 
+    # -- durable warm state (serve/warmstate.py) -----------------------------
+    def warm_state(self) -> Dict[str, Any]:
+        """Snapshot everything a replica needs to serve bit-identically
+        to this index: the host-of-record rows, the built device
+        structures (resident slabs + bias + centroids), the slot
+        bookkeeping, the exact tail, and the PUBLIC generation (cache /
+        dedup keys on a restored replica must agree with the writer's).
+
+        Refs are captured under the lock; device→host coercion runs OFF
+        the lock (all device updates here are functional, so snapshotted
+        refs stay valid — the same discipline as the off-lock absorb)."""
+        with self._lock:
+            rows = dict(self._rows)
+            slabs, bias, cents = self._slabs, self._bias, self._centroids
+            keys_by_slot = self._keys_by_slot
+            live_mask = self._live_mask
+            state: Dict[str, Any] = {
+                "kind": "ivf",
+                "dimension": int(self.dimension),
+                "metric": self.metric,
+                "M_pad": int(self._M_pad),
+                "d_pad": int(self._d_pad),
+                "slot_of_key": dict(self._slot_of_key),
+                "tail": list(self._tail),
+                "built_n": int(self._built_n),
+                "generation": int(self.generation),
+            }
+        state["rows"] = rows
+        state["slabs"] = None if slabs is None else np.asarray(slabs)
+        state["bias"] = None if bias is None else np.asarray(bias)
+        state["centroids"] = None if cents is None else np.asarray(cents)
+        state["keys_by_slot"] = (
+            None if keys_by_slot is None else np.array(keys_by_slot)
+        )
+        state["live_mask"] = None if live_mask is None else np.array(live_mask)
+        return state
+
+    def load_warm_state(self, state: Dict[str, Any]) -> None:
+        """Install a ``warm_state()`` snapshot (replica bring-up): the
+        restored index serves bit-identically to the writer at the
+        snapshot's generation.  Uploads happen OFF the lock; the locked
+        install is a pure pointer swap (the same launch-discipline as
+        ``_install``).  Raises ``ValueError`` on a geometry mismatch —
+        the warm-state manager turns that into a counted cold-start."""
+        if state.get("kind") != "ivf":
+            raise ValueError(f"not an IVF warm state: {state.get('kind')!r}")
+        if int(state["dimension"]) != int(self.dimension):
+            raise ValueError(
+                f"dimension mismatch: snapshot {state['dimension']} "
+                f"vs index {self.dimension}"
+            )
+        if state["metric"] != self.metric:
+            raise ValueError(
+                f"metric mismatch: snapshot {state['metric']!r} "
+                f"vs index {self.metric!r}"
+            )
+        slabs = (
+            None if state["slabs"] is None
+            else jnp.asarray(state["slabs"], self.dtype)
+        )
+        bias = (
+            None if state["bias"] is None
+            else jnp.asarray(state["bias"], jnp.float32)
+        )
+        cents = (
+            None if state["centroids"] is None
+            else jnp.asarray(state["centroids"], jnp.float32)
+        )
+        rows = {
+            int(k): np.asarray(v, np.float32) for k, v in state["rows"].items()
+        }
+        with self._lock:
+            self._rows = rows
+            self._slabs = slabs
+            self._bias = bias
+            self._centroids = cents
+            self._keys_by_slot = state["keys_by_slot"]
+            self._live_mask = state["live_mask"]
+            self._M_pad = int(state["M_pad"])
+            self._d_pad = int(state["d_pad"])
+            self._slot_of_key = {
+                int(k): int(s) for k, s in state["slot_of_key"].items()
+            }
+            self._tail = {int(k): None for k in state["tail"]}
+            self._built_n = int(state["built_n"])
+            self._absorb_stuck_at = None
+            self._tail_cache = None
+            self._layout_gen += 1  # in-flight off-lock plans must abort
+            self.generation = int(state["generation"])
+            self._search_fns.clear()
+
     def _default_probe(self) -> int:
         """Probe count bounding the rescore shortlist: up to 20% of
         clusters for small corpora (coarse clusters need generous probing

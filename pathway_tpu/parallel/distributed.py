@@ -44,11 +44,13 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 
-from .. import config
+from .. import config, observe
+from ..robust import log_once
+from ..robust import inject as _inject
 
 __all__ = [
     "topology_from_env",
@@ -141,19 +143,54 @@ def _client():
     return _dist.global_state.client
 
 
-def barrier(name: str, timeout_ms: int = 60_000) -> None:
+# degraded control-plane operations, by site (barrier / broadcast):
+# the coordination service timing out or faulting must cost AGREEMENT,
+# never a hung serve — callers get a flagged local-only answer
+_degraded_counters: Dict[str, observe.Counter] = {}
+
+
+def _count_degraded(site: str) -> None:
+    c = _degraded_counters.get(site)
+    if c is None:
+        c = _degraded_counters[site] = observe.counter(
+            "pathway_dist_degraded_total", site=site
+        )
+    c.inc()
+
+
+def barrier(name: str, timeout_ms: int = 60_000, deadline=None) -> bool:
     """Host-side control-plane barrier over the coordination service — the
     analog of timely's progress frontier sync at commit ticks (workers agree
-    a timestamp is closed before results are emitted downstream)."""
-    if not is_distributed():
-        return
-    client = _client()
-    if client is None:  # pragma: no cover - initialize() always sets it
-        raise RuntimeError("distributed runtime not initialized")
-    client.wait_at_barrier(name, timeout_in_ms=timeout_ms)
+    a timestamp is closed before results are emitted downstream).
+
+    Returns True when every process reached the barrier, False when the
+    sync DEGRADED (chaos site ``dist.barrier`` armed, coordination
+    timeout, or service error): the caller proceeds on local knowledge
+    with the degradation counted on
+    ``pathway_dist_degraded_total{site="barrier"}`` — a serve tier must
+    never hang on its own control plane."""
+    try:
+        _inject.fire("dist.barrier", deadline=deadline)
+        if not is_distributed():
+            return True
+        client = _client()
+        if client is None:  # pragma: no cover - initialize() always sets it
+            raise RuntimeError("distributed runtime not initialized")
+        client.wait_at_barrier(name, timeout_in_ms=timeout_ms)
+        return True
+    except Exception as exc:
+        _count_degraded("barrier")
+        log_once(
+            f"dist.barrier:{type(exc).__name__}",
+            "control-plane barrier %r degraded (%r); proceeding local-only",
+            name,
+            exc,
+        )
+        return False
 
 
-def broadcast_obj(obj=None, *, name: str, timeout_ms: int = 60_000):
+def broadcast_obj(obj=None, *, name: str, timeout_ms: int = 60_000,
+                  deadline=None):
     """Broadcast a small picklable control-plane object (config, rendezvous
     info, a per-tick chosen timestamp) from the coordinator to every process
     via the coordination service's KV store.  Call with ``obj`` on the
@@ -163,16 +200,36 @@ def broadcast_obj(obj=None, *, name: str, timeout_ms: int = 60_000):
     ``name`` must be unique per broadcast (include a tick/sequence number for
     repeated control-plane values: ``name=f"commit/{tick}"``) — the KV store
     rejects overwrites, which makes an accidental reuse fail loudly instead
-    of silently serving a stale value to racing followers."""
-    if not is_distributed():
-        return obj
-    import base64
-    import pickle
+    of silently serving a stale value to racing followers.
 
-    client = _client()
-    key = f"pathway_tpu/bcast/{name}"
-    if is_coordinator():
-        client.key_value_set(key, base64.b64encode(pickle.dumps(obj)).decode())
+    Degrade semantics (chaos site ``dist.broadcast``, KV timeout, service
+    error): returns the LOCAL ``obj`` — the coordinator's own value, or
+    None on a follower — counted on
+    ``pathway_dist_degraded_total{site="broadcast"}``.  Consumers (e.g.
+    warm-state generation agreement, serve/warmstate.py) treat a local-only
+    answer as flagged agreement, never as a reason to hang or fail."""
+    try:
+        _inject.fire("dist.broadcast", deadline=deadline)
+        if not is_distributed():
+            return obj
+        import base64
+        import pickle
+
+        client = _client()
+        key = f"pathway_tpu/bcast/{name}"
+        if is_coordinator():
+            client.key_value_set(
+                key, base64.b64encode(pickle.dumps(obj)).decode()
+            )
+            return obj
+        raw = client.blocking_key_value_get(key, timeout_ms)
+        return pickle.loads(base64.b64decode(raw))
+    except Exception as exc:
+        _count_degraded("broadcast")
+        log_once(
+            f"dist.broadcast:{type(exc).__name__}",
+            "control-plane broadcast %r degraded (%r); serving local value",
+            name,
+            exc,
+        )
         return obj
-    raw = client.blocking_key_value_get(key, timeout_ms)
-    return pickle.loads(base64.b64decode(raw))

@@ -53,7 +53,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from .. import config, observe
 from ..robust import RetryPolicy, inject
 
-__all__ = ["ExchangePlane", "get_plane", "close_plane"]
+__all__ = ["ExchangePlane", "FramedStream", "get_plane", "close_plane"]
 
 _HDR = struct.Struct("!Q")
 _TOKEN_LEN = 32
@@ -83,6 +83,102 @@ def _hb_timeout() -> float:
 
 class PeerLost(RuntimeError):
     """A cluster peer disconnected (crashed or exited early)."""
+
+
+class FramedStream:
+    """One token-authenticated, length-prefixed pickle stream — the
+    point-to-point wire the serve fabric rides (serve/fabric.py), reusing
+    this plane's framing discipline (``_HDR`` length prefix, 32-byte
+    session secret checked with ``hmac.compare_digest`` BEFORE any
+    ``pickle.loads``, ``_recv_exact`` chunked reads).
+
+    Unlike the BSP mesh above, a ``FramedStream`` is a plain muxable
+    duplex channel: any thread may ``send`` (serialized by an internal
+    lock); exactly ONE thread should ``recv`` (the fabric's per-link
+    receiver).  A broken or closed connection surfaces as ``PeerLost``;
+    a recv timeout surfaces as ``socket.timeout`` so callers can poll."""
+
+    __slots__ = ("_sock", "_send_lock", "_closed")
+
+    def __init__(self, sock: socket.socket):
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._closed = False
+
+    @classmethod
+    def connect(
+        cls, host: str, port: int, token: bytes, timeout: float = 5.0
+    ) -> "FramedStream":
+        """Dial a listener and present the session secret (client side)."""
+        s = socket.create_connection((host, port), timeout=timeout)
+        try:
+            s.sendall(token)
+        except OSError as exc:
+            s.close()
+            raise PeerLost(f"fabric connect to {host}:{port} failed: {exc!r}")
+        s.settimeout(None)
+        return cls(s)
+
+    @classmethod
+    def accept(
+        cls, conn: socket.socket, token: bytes, timeout: float = 10.0
+    ) -> "FramedStream":
+        """Authenticate one accepted connection (server side): the first
+        ``_TOKEN_LEN`` bytes must equal the session secret or the
+        connection is closed before any frame is parsed."""
+        try:
+            conn.settimeout(timeout)
+            offered = _recv_exact(conn, _TOKEN_LEN)
+            if not hmac.compare_digest(offered, token):
+                raise PermissionError("bad fabric token")
+            conn.settimeout(None)
+        except BaseException:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+        return cls(conn)
+
+    def send(self, obj: Any) -> None:
+        """Pickle + frame + write ``obj`` (thread-safe)."""
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _HDR.pack(len(payload)) + payload
+        try:
+            with self._send_lock:
+                self._sock.sendall(frame)
+        except OSError as exc:
+            raise PeerLost(f"fabric send failed: {exc!r}") from exc
+
+    def recv(self, timeout: Optional[float] = None) -> Any:
+        """Next frame, unpickled.  ``socket.timeout`` when ``timeout``
+        elapses with no frame started; ``PeerLost`` on disconnect."""
+        try:
+            self._sock.settimeout(timeout)
+            hdr = _recv_exact(self._sock, _HDR.size)
+            # once a header landed the frame is in flight: finish it
+            # without the poll timeout cutting a slow payload short
+            self._sock.settimeout(None)
+            (length,) = _HDR.unpack(hdr)
+            return pickle.loads(_recv_exact(self._sock, length))
+        except socket.timeout:
+            raise
+        except (OSError, ConnectionError, EOFError) as exc:
+            raise PeerLost(f"fabric recv failed: {exc!r}") from exc
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 class ExchangePlane:

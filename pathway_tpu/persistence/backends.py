@@ -4,6 +4,14 @@ Reference: trait PersistenceBackend (src/persistence/backends/mod.rs:50) with
 file / S3 / memory / mock implementations.  Keys are slash-separated paths;
 values are opaque byte blobs.  Writes are atomic (temp file + rename on the
 filesystem backend) so a crash mid-snapshot never corrupts an earlier one.
+
+The S3 backend's ``get``/``put``/``list_keys`` run through
+``robust.retry_call`` (sites ``s3.get`` / ``s3.put`` / ``s3.list``) —
+a transient socket error inside a warm-state snapshot write retries
+with the standard seeded-jitter backoff and counts on
+``pathway_robust_retries_total{site}`` instead of propagating raw out
+of the snapshot path.  ``delete`` stays single-shot: it is only called
+from best-effort pruning, where a miss is already tolerated.
 """
 
 from __future__ import annotations
@@ -11,6 +19,8 @@ from __future__ import annotations
 import os
 import threading
 from typing import Dict, List, Optional
+
+from ..robust import retry_call
 
 __all__ = ["PersistenceBackend", "FileBackend", "MemoryBackend", "S3Backend"]
 
@@ -122,6 +132,9 @@ class S3Backend(PersistenceBackend):
         return f"{self.root}/{key}" if self.root else key
 
     def get(self, key: str) -> Optional[bytes]:
+        return retry_call("s3.get", self._get_once, key)
+
+    def _get_once(self, key: str) -> Optional[bytes]:
         try:
             obj = self.client.get_object(Bucket=self.bucket, Key=self._key(key))
             return obj["Body"].read()
@@ -129,12 +142,21 @@ class S3Backend(PersistenceBackend):
             return None
 
     def put(self, key: str, value: bytes) -> None:
-        self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=value)
+        retry_call(
+            "s3.put",
+            self.client.put_object,
+            Bucket=self.bucket,
+            Key=self._key(key),
+            Body=value,
+        )
 
     def delete(self, key: str) -> None:
         self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
 
     def list_keys(self, prefix: str = "") -> List[str]:
+        return retry_call("s3.list", self._list_once, prefix)
+
+    def _list_once(self, prefix: str) -> List[str]:
         full = self._key(prefix)
         out = []
         paginator = self.client.get_paginator("list_objects_v2")

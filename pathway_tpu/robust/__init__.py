@@ -33,8 +33,10 @@ launches keep their lock-discipline and budget accounting.
 from .deadline import Deadline, DeadlineExceeded, stage1_fraction
 from .degrade import (
     EXTRACTIVE_ANSWER,
+    HOST_FAILOVER,
     LATE_INTERACTION_SKIPPED,
     LOAD_SHED,
+    REPLICA_LOST,
     RERANK_SKIPPED,
     RETRIEVAL_FAILED,
     SHARD_SKIPPED,
@@ -61,8 +63,10 @@ __all__ = [
     "DeadlineExceeded",
     "EXTRACTIVE_ANSWER",
     "FaultInjected",
+    "HOST_FAILOVER",
     "LATE_INTERACTION_SKIPPED",
     "LOAD_SHED",
+    "REPLICA_LOST",
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",
     "RetryPolicy",

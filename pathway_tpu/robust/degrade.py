@@ -28,6 +28,11 @@ generator down         extractive answer from      ``extractive_answer``
 SLO burn firing +      empty result set (shed at   ``load_shed``
 shed-class priority    admission, never queued)
 stage 1 down           empty result set            ``retrieval_failed``
+fabric host dead /     a surviving host's rows     ``host_failover``
+slow (re-routed)       (re-routed or hedged)
+no healthy fabric      empty result set (the       ``replica_lost``
+host remains           fleet, not the request,
+                       is the outage)
 =====================  ==========================  ==========================
 
 ``ServeResult`` is a ``list`` subclass, so every existing caller that
@@ -50,8 +55,10 @@ from ..observe import trace as _trace
 
 __all__ = [
     "EXTRACTIVE_ANSWER",
+    "HOST_FAILOVER",
     "LATE_INTERACTION_SKIPPED",
     "LOAD_SHED",
+    "REPLICA_LOST",
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",
     "SHARD_SKIPPED",
@@ -68,6 +75,13 @@ SHARD_SKIPPED = "shard_skipped"
 EXTRACTIVE_ANSWER = "extractive_answer"
 LOAD_SHED = "load_shed"
 RETRIEVAL_FAILED = "retrieval_failed"
+# serve-fabric rungs (serve/fabric.py): a request re-routed off a dead
+# or slow host keeps a surviving host's full rows (host_failover); only
+# when NO healthy host remains does it degrade to an empty flagged
+# result (replica_lost) — a dead host is its shards' recall plus a
+# flag, never an exception out of a serve call
+HOST_FAILOVER = "host_failover"
+REPLICA_LOST = "replica_lost"
 
 # pre-resolved per-reason counters (reasons are the small fixed rung set)
 _degraded_counters: Dict[str, observe.Counter] = {}

@@ -43,7 +43,25 @@ documents, counted on ``pathway_ingest_failures_total{stage=...}``;
 serve results stay clean and bit-identical because the index simply
 does not advance, and every ingest site fires under an already-spent
 deadline so an armed hang releases instantly — maintenance never
-stalls), … — and lets a test (or
+stalls), the serve-fabric triple ``fabric.route`` / ``fabric.send`` /
+``fabric.recv`` (serve/fabric.py — a route fault falls back from the
+affinity host to the least-loaded healthy one flagged
+``host_failover``; a send/recv fault fails over to a surviving host
+(breaker fed, same rung) and only an exhausted fleet degrades to an
+empty ``replica_lost`` result — the request NEVER sees an exception),
+the warm-state pair ``warmstate.snapshot`` / ``warmstate.restore``
+(serve/warmstate.py — a faulted snapshot is a SKIPPED cadence counted
+on ``pathway_warmstate_snapshot_skipped_total``, never a torn blob; a
+faulted restore degrades bring-up to flagged cold ingest counted on
+``pathway_warmstate_restore_failures_total{kind}``, never a wrong
+index), the distributed control-plane pair ``dist.barrier`` /
+``dist.broadcast`` (parallel/distributed.py — a faulted or timed-out
+barrier/broadcast degrades to FLAGGED local-only agreement, counted on
+``pathway_dist_degraded_total{site}``; a serve is never hung on the
+coordination service), the S3 snapshot-backend triple ``s3.get`` /
+``s3.put`` / ``s3.list`` (persistence/backends.py — transient object-
+store errors retry with the standard seeded-jitter backoff through
+``retry_call``), … — and lets a test (or
 an operator running a game-day) arm any site to
 
 - ``raise`` a ``FaultInjected`` (a transient dispatch/socket error),

@@ -24,20 +24,27 @@ every ingest→retrievable journey.
 """
 
 from .decode import ContinuousDecoder, DecodeResult, decode_slots
+from .fabric import FabricWorker, ServeFabric, fabric_token
 from .ingest import IngestConnector, LiveIngestRunner, ingest_runners
 from .scheduler import ServeScheduler, SharedBatcher, coalesce_window_s, max_batch_queries
 from .tuner import Tuner, tuner_from_env
+from .warmstate import RestoreReport, WarmStateManager
 
 __all__ = [
     "ContinuousDecoder",
     "DecodeResult",
+    "FabricWorker",
     "IngestConnector",
     "LiveIngestRunner",
+    "RestoreReport",
+    "ServeFabric",
     "ServeScheduler",
     "SharedBatcher",
     "Tuner",
+    "WarmStateManager",
     "coalesce_window_s",
     "decode_slots",
+    "fabric_token",
     "ingest_runners",
     "max_batch_queries",
     "tuner_from_env",

@@ -1,0 +1,822 @@
+"""Multi-host serve fabric: replica-group admission routing + failover.
+
+The scheduler's replica placement (serve/scheduler.py) spreads batches
+over data-parallel pipelines INSIDE one process.  This module is the
+tier above it: a front-end that routes admission across HOST-level
+replica groups — each host a ``FabricWorker`` wrapping its own serve
+scheduler over its own device group — with the failure semantics the
+degradation ladder promises (robust/degrade.py):
+
+- **Routing.**  Least-loaded across healthy hosts, with consistent-hash
+  affinity by the ``cache/keys.py`` query key: the same query text (at
+  the fleet's index generation) lands on the same host while that host
+  is healthy and not overloaded past ``PATHWAY_FABRIC_AFFINITY_SLACK``
+  in-flight requests of the fleet minimum — so per-host result and
+  embedding caches stay hot without a shared cache plane.  The affinity
+  key is derived by the SAME ``query_key`` helper the dedup and result
+  caches use; the spellings cannot drift.
+- **Wire.**  Framed request/response over the exchange plane's
+  point-to-point stream (``parallel/exchange.FramedStream``): length-
+  prefixed pickle frames behind a 32-byte session secret checked before
+  any unpickle, one muxed connection per host carrying requests,
+  responses (by ``req_id``), heartbeats, and the ``bye`` drain frame.
+- **Failure.**  Per-host circuit breakers (``robust.breaker``):
+  heartbeat silence past ``PATHWAY_FABRIC_HEARTBEAT_TIMEOUT``, a
+  ``bye``, or a broken stream marks the host down, feeds its breaker,
+  fails its in-flight tickets — and the waiting submits RE-ROUTE to a
+  surviving host, flagged ``host_failover``.  A dead host costs its
+  shards' recall plus a flag, NEVER an exception out of a serve call;
+  only an exhausted fleet degrades to an empty ``replica_lost`` result.
+  Retry-with-hedge: ``PATHWAY_FABRIC_HEDGE_MS`` > 0 mirrors a request
+  to a second healthy host when the first is slow; the first response
+  wins (``meta["hedged"]``).
+- **Chaos sites** (robust/inject.py): ``fabric.route`` (affinity falls
+  back to least-loaded, flagged), ``fabric.send`` / ``fabric.recv``
+  (failover to a survivor, breaker fed) — each honors an
+  already-spent deadline, so an armed hang releases immediately.
+
+Bring-up pairs with ``serve/warmstate.py``: a replacement worker
+restores the writer's warm state (same index generation, same cache
+keys) before joining, so a rolling restart under load serves every
+request from a surviving host while each worker bounces — measured by
+the ``serve_fabric`` bench phase.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import secrets
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import config, observe
+from ..cache.keys import query_key
+from ..parallel.exchange import FramedStream, PeerLost
+from ..robust import breaker as robust_breaker
+from ..robust import inject, log_once
+from ..robust.deadline import Deadline
+from ..robust.degrade import (
+    HOST_FAILOVER,
+    REPLICA_LOST,
+    ServeResult,
+    record_degraded,
+)
+
+__all__ = ["FabricWorker", "ServeFabric", "fabric_token"]
+
+_TOKEN_LEN = 32
+
+
+def fabric_token() -> bytes:
+    """Mint one fabric session secret (share it across the replica
+    group out-of-band — the spawn layer or the coordination KV)."""
+    return secrets.token_bytes(_TOKEN_LEN)
+
+
+def _generation_of(target) -> int:
+    """Best-effort index generation of a serve target: the scheduler's
+    ``index_generation`` hook, or the wrapped target's, else 0."""
+    seen = set()
+    while target is not None and id(target) not in seen:
+        seen.add(id(target))
+        gen_fn = getattr(target, "index_generation", None)
+        if callable(gen_fn):
+            try:
+                return int(gen_fn())
+            except Exception:
+                return 0
+        target = getattr(target, "target", None)
+    return 0
+
+
+class FabricWorker:
+    """One host's serve endpoint: a TCP listener in front of a local
+    scheduler (``ServeScheduler`` or anything with ``serve(texts, k=,
+    deadline=, priority=) -> ServeResult``).
+
+    Per connection, one reader thread answers ``ping`` inline (pong
+    carries the index generation + local in-flight count) and hands
+    each ``serve`` frame to its own handler thread — the local
+    scheduler's coalescing window then batches concurrent riders
+    exactly as it does in-process, so the fabric inherits the 2+2
+    per-batch dispatch budget unchanged.  ``stop()`` drains cleanly:
+    a ``bye`` frame on every live connection tells front-ends this
+    disconnect is a planned restart (re-route, don't panic)."""
+
+    def __init__(
+        self,
+        scheduler,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: Optional[bytes] = None,
+        name: Optional[str] = None,
+    ):
+        self.scheduler = scheduler
+        self.token = token if token is not None else fabric_token()
+        if len(self.token) != _TOKEN_LEN:
+            raise ValueError(f"fabric token must be {_TOKEN_LEN} bytes")
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(16)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self.name = name or f"{self.host}:{self.port}"
+        self._lock = threading.Lock()
+        self._streams: List[FramedStream] = []
+        self._stopping = False
+        self._inflight = 0
+        self.stats: Dict[str, int] = {"requests": 0, "pings": 0, "errors": 0}
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"fabric-acc-{self.name}"
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed (stop())
+            if self._stopping:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            try:
+                stream = FramedStream.accept(conn, self.token)
+            except Exception:
+                continue  # junk/unauthenticated: dropped before any pickle
+            with self._lock:
+                self._streams.append(stream)
+            threading.Thread(
+                target=self._reader,
+                args=(stream,),
+                daemon=True,
+                name=f"fabric-rd-{self.name}",
+            ).start()
+
+    def _reader(self, stream: FramedStream) -> None:
+        try:
+            while True:
+                msg = stream.recv()
+                op = msg.get("op")
+                if op == "ping":
+                    self.stats["pings"] += 1
+                    stream.send(
+                        {
+                            "op": "pong",
+                            "generation": _generation_of(self.scheduler),
+                            "inflight": self._inflight,
+                        }
+                    )
+                elif op == "serve":
+                    threading.Thread(
+                        target=self._handle,
+                        args=(stream, msg),
+                        daemon=True,
+                        name=f"fabric-req-{self.name}",
+                    ).start()
+                elif op == "bye":
+                    return  # client drained; the close below is clean
+        except (PeerLost, Exception):  # noqa: BLE001 - reader dies quietly
+            pass
+        finally:
+            with self._lock:
+                if stream in self._streams:
+                    self._streams.remove(stream)
+            stream.close()
+
+    def _handle(self, stream: FramedStream, msg: Dict[str, Any]) -> None:
+        req_id = msg.get("req_id")
+        deadline = None
+        if msg.get("deadline_ms") is not None:
+            deadline = Deadline.after_ms(float(msg["deadline_ms"]))
+        with self._lock:
+            self._inflight += 1
+            self.stats["requests"] += 1
+        try:
+            kwargs: Dict[str, Any] = {"deadline": deadline}
+            if msg.get("priority") is not None:
+                kwargs["priority"] = msg["priority"]
+            result = self.scheduler.serve(
+                msg["texts"], k=msg.get("k"), **kwargs
+            )
+            degraded = list(getattr(result, "degraded", ()))
+            meta = dict(getattr(result, "meta", {}))
+            reply = {
+                "op": "result",
+                "req_id": req_id,
+                "rows": [list(r) for r in result],
+                "degraded": degraded,
+                "meta": meta,
+            }
+        except Exception as exc:  # the scheduler degrades; a raise is a bug,
+            # and it must cost this request a FAILOVER upstream, not silence
+            self.stats["errors"] += 1
+            reply = {"op": "error", "req_id": req_id, "error": repr(exc)}
+        finally:
+            with self._lock:
+                self._inflight = max(0, self._inflight - 1)
+        try:
+            stream.send(reply)
+        except PeerLost:
+            pass  # front-end gone; its failover already covered this request
+
+    def _close_listener(self) -> None:
+        # close() alone frees the fd NUMBER, but with the accept thread
+        # blocked inside accept(2) the in-flight syscall pins the kernel
+        # socket: it keeps LISTENING on the port, and a "dead" worker
+        # silently accepts front-end reconnects (which then pong the
+        # heartbeat off a stopped scheduler).  shutdown() tears the
+        # socket down underneath the blocked accept — it returns with
+        # an error and the port actually closes.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        """Unplanned death (tests / benches / chaos drills): the
+        listener and every stream die abruptly — no ``bye`` frame,
+        in-flight requests torn mid-reply.  Front-ends observe exactly
+        what a killed process looks like: a disconnect, then connection
+        refused.  Does not stop the scheduler; the caller owns it."""
+        with self._lock:
+            self._stopping = True
+            streams = list(self._streams)
+        self._close_listener()
+        for stream in streams:
+            stream.close()
+
+    def stop(self) -> None:
+        """Planned drain: ``bye`` every front-end (their in-flight
+        tickets re-route as failover, new admissions route elsewhere),
+        then close the listener and connections.  Idempotent."""
+        with self._lock:
+            if self._stopping:
+                return
+            self._stopping = True
+            streams = list(self._streams)
+        for stream in streams:
+            try:
+                stream.send({"op": "bye"})
+            except PeerLost:
+                pass
+        self._close_listener()
+        for stream in streams:
+            stream.close()
+
+
+class _Pending:
+    """One in-flight request on one host link."""
+
+    __slots__ = ("event", "reply")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply: Optional[Dict[str, Any]] = None
+
+    def resolve(self, reply: Dict[str, Any]) -> None:
+        self.reply = reply
+        self.event.set()
+
+
+class _HostLink:
+    """Client side of one host: a muxed ``FramedStream`` (one receiver
+    thread dispatching replies by ``req_id``), a circuit breaker, and
+    the liveness clock the fabric heartbeat drives."""
+
+    def __init__(self, name: str, host: str, port: int, token: bytes):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.token = token
+        # ONE failure trips the host breaker (a fabric host that broke a
+        # stream / went silent / said bye is not worth a retry budget —
+        # survivors hold its load), and the cool-down is one heartbeat
+        # timeout: a bounced worker is probed again as soon as a restart
+        # could plausibly have finished, which is what keeps a rolling
+        # restart's re-join latency at heartbeat scale
+        self.breaker = robust_breaker(
+            f"fabric:{name}",
+            failure_threshold=1,
+            reset_s=config.get("fabric.heartbeat_timeout_s"),
+        )
+        self._stream: Optional[FramedStream] = None
+        self._conn_lock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self.inflight = 0
+        self.last_pong: Optional[float] = None
+        self.generation = 0
+        self.down_reason: Optional[str] = None
+
+    # -- connection ---------------------------------------------------------
+    def ensure(self) -> Optional[FramedStream]:
+        """The live stream, connecting if needed; None when the host is
+        unreachable (breaker fed by the caller)."""
+        with self._conn_lock:
+            if self._stream is not None:
+                return self._stream
+            try:
+                stream = FramedStream.connect(
+                    self.host,
+                    self.port,
+                    self.token,
+                    timeout=config.get("fabric.connect_timeout_s"),
+                )
+            except Exception as exc:
+                self.down_reason = f"connect: {exc!r}"
+                return None
+            self._stream = stream
+            self.down_reason = None
+            self.last_pong = time.monotonic()
+            threading.Thread(
+                target=self._receiver,
+                args=(stream,),
+                daemon=True,
+                name=f"fabric-recv-{self.name}",
+            ).start()
+            return stream
+
+    def _receiver(self, stream: FramedStream) -> None:
+        try:
+            while True:
+                msg = stream.recv()
+                op = msg.get("op")
+                if op == "pong":
+                    self.last_pong = time.monotonic()
+                    self.generation = int(msg.get("generation", 0))
+                elif op in ("result", "error"):
+                    self.last_pong = time.monotonic()
+                    with self._plock:
+                        pending = self._pending.pop(msg.get("req_id"), None)
+                        if pending is not None:
+                            self.inflight = max(0, self.inflight - 1)
+                    if pending is not None:
+                        pending.resolve(msg)
+                elif op == "bye":
+                    self.mark_down("bye")
+                    return
+        except Exception:  # noqa: BLE001 - disconnect = down
+            self.mark_down("disconnect")
+        finally:
+            with self._conn_lock:
+                if self._stream is stream:
+                    self._stream = None
+            stream.close()
+
+    def mark_down(self, reason: str) -> None:
+        """Host is gone (bye / disconnect / heartbeat silence): feed the
+        breaker, drop the stream, FAIL every in-flight ticket — their
+        waiting submits observe the failure and re-route."""
+        with self._conn_lock:
+            stream, self._stream = self._stream, None
+        if stream is None and self.down_reason is not None:
+            # already down (e.g. the heartbeat closed the stream and the
+            # receiver died seeing it): the FIRST reason stands, and the
+            # breaker is not fed twice — a stale echo must not reopen a
+            # half-open probe
+            return
+        self.down_reason = reason
+        self.breaker.record_failure()
+        if stream is not None:
+            stream.close()
+        with self._plock:
+            pending, self._pending = self._pending, {}
+            self.inflight = 0
+        for p in pending.values():
+            p.resolve({"op": "error", "error": f"host {self.name} {reason}"})
+
+    def up(self) -> bool:
+        return self._stream is not None
+
+    def usable(self) -> bool:
+        """Routable: breaker not open.  Deliberately reads ``state``,
+        not ``allow()`` — listing candidates must not consume the one
+        half-open probe slot; ``ServeFabric`` gates the actual attempt
+        with ``allow()`` at launch time."""
+        return self.breaker.state != "open"
+
+    # -- requests -----------------------------------------------------------
+    def send_request(
+        self, req_id: int, msg: Dict[str, Any], deadline=None
+    ) -> _Pending:
+        """Register + send one request frame; raises on any failure
+        (chaos site ``fabric.send``, dead stream) — the caller fails
+        over."""
+        stream = self.ensure()
+        if stream is None:
+            raise PeerLost(f"host {self.name} unreachable")
+        pending = _Pending()
+        with self._plock:
+            self._pending[req_id] = pending
+            self.inflight += 1
+        try:
+            inject.fire("fabric.send", deadline=deadline)
+            stream.send(msg)
+        except BaseException:
+            with self._plock:
+                if self._pending.pop(req_id, None) is not None:
+                    self.inflight = max(0, self.inflight - 1)
+            raise
+        return pending
+
+    def heartbeat(self, timeout_s: float) -> None:
+        """One heartbeat tick: ping if connected; silence past
+        ``timeout_s`` marks the host down (failing its in-flight
+        tickets into re-routes)."""
+        stream = self._stream
+        if stream is None:
+            return
+        last = self.last_pong
+        if last is not None and time.monotonic() - last > timeout_s:
+            self.mark_down("heartbeat_silence")
+            return
+        try:
+            stream.send({"op": "ping"})
+        except PeerLost:
+            self.mark_down("disconnect")
+
+    def close(self) -> None:
+        with self._conn_lock:
+            stream, self._stream = self._stream, None
+        if stream is not None:
+            try:
+                stream.send({"op": "bye"})
+            except PeerLost:
+                pass
+            stream.close()
+
+
+class ServeFabric:
+    """The front-end: admission routing across a replica group.
+
+    ``hosts`` maps a host name to its ``(host, port)`` address (or a
+    ``"host:port"`` string); all workers share ``token``.  The serve
+    surface mirrors ``ServeScheduler`` — ``submit() -> ticket``,
+    ``serve()``/``__call__`` — so callers swap tiers without code
+    changes, and the failure contract is the ladder's: a response is
+    ALWAYS a ``ServeResult``, possibly flagged ``host_failover`` or
+    (fleet exhausted) empty ``replica_lost``, never an exception."""
+
+    def __init__(
+        self,
+        hosts: Dict[str, Any],
+        token: bytes,
+        name: Optional[str] = None,
+    ):
+        if not hosts:
+            raise ValueError("ServeFabric needs at least one host")
+        self.name = name or "fabric"
+        self._links: List[_HostLink] = []
+        for host_name, addr in hosts.items():
+            if isinstance(addr, str):
+                h, p = addr.rsplit(":", 1)
+            else:
+                h, p = addr
+            self._links.append(_HostLink(str(host_name), h, int(p), token))
+        self._req_ids = itertools.count(1)
+        self.stats: Dict[str, int] = {
+            "requests": 0,
+            "ok": 0,
+            "failover": 0,
+            "hedged": 0,
+            "lost": 0,
+        }
+        self._stats_lock = threading.Lock()
+        self._observe_id = observe.next_id()
+        observe.register_provider(self)
+        self._closed = False
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name=f"{self.name}-hb"
+        )
+        self._hb_thread.start()
+
+    # -- liveness ------------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._closed:
+            time.sleep(config.get("fabric.heartbeat_s"))
+            if self._closed:
+                return
+            timeout_s = config.get("fabric.heartbeat_timeout_s")
+            for link in self._links:
+                link.heartbeat(timeout_s)
+
+    def connect(self) -> int:
+        """Eagerly dial every host (optional — routing connects
+        lazily); returns how many are reachable."""
+        return sum(1 for link in self._links if link.ensure() is not None)
+
+    @property
+    def generation(self) -> int:
+        """The fleet's index generation as last reported by pongs (the
+        routing-affinity generation)."""
+        return max((link.generation for link in self._links), default=0)
+
+    # -- routing -------------------------------------------------------------
+    def _affinity(self, text: str) -> int:
+        key_text, gen = query_key(text, self.generation)
+        digest = hashlib.blake2b(
+            f"{gen}\x00{key_text}".encode(), digest_size=8
+        ).digest()
+        return int.from_bytes(digest, "big") % len(self._links)
+
+    def _route(self, texts: Sequence[str], deadline=None) -> Tuple[List[int], bool]:
+        """Candidate host indices in preference order + whether routing
+        itself degraded (chaos site ``fabric.route``: affinity is an
+        optimization, so a route fault falls back to pure least-loaded,
+        flagged)."""
+        degraded = False
+        aff: Optional[int] = None
+        try:
+            inject.fire("fabric.route", deadline=deadline)
+            if texts:
+                aff = self._affinity(str(texts[0]))
+        except Exception as exc:
+            degraded = True
+            log_once(
+                f"fabric.route:{type(exc).__name__}",
+                "fabric routing degraded (%r); using least-loaded host",
+                exc,
+            )
+        usable = [i for i, link in enumerate(self._links) if link.usable()]
+        order: List[int] = []
+        if aff is not None and aff in usable:
+            slack = config.get("fabric.affinity_slack")
+            min_inflight = min(self._links[i].inflight for i in usable)
+            if self._links[aff].inflight <= min_inflight + slack:
+                order.append(aff)
+        order.extend(
+            sorted(
+                (i for i in usable if i not in order),
+                key=lambda i: (self._links[i].inflight, i),
+            )
+        )
+        return order, degraded
+
+    # -- serve surface -------------------------------------------------------
+    def submit(
+        self,
+        texts: Sequence[str],
+        k: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
+        priority: Optional[str] = None,
+    ):
+        """Admit one request; returns a zero-arg-callable ticket
+        (``result(timeout)`` honored for API parity) resolving to the
+        ``ServeResult``.  Routing, send, hedge, and failover all run on
+        the WAITER's thread — an in-flight ticket whose host dies is
+        re-routed right there, inside the same call."""
+        texts = list(texts)
+        box: List[Any] = [None]
+
+        def run() -> ServeResult:
+            if box[0] is None:
+                box[0] = self._serve_once(texts, k, deadline, priority)
+            return box[0]
+
+        class _FabricTicket:
+            __slots__ = ()
+
+            def __call__(self) -> ServeResult:
+                return run()
+
+            def result(self, timeout: Optional[float] = None) -> ServeResult:
+                return run()
+
+        return _FabricTicket()
+
+    def serve(
+        self,
+        texts: Sequence[str],
+        k: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
+        priority: Optional[str] = None,
+    ) -> ServeResult:
+        return self._serve_once(list(texts), k, deadline, priority)
+
+    __call__ = serve
+
+    def _serve_once(
+        self,
+        texts: List[str],
+        k: Optional[int],
+        deadline: Optional[Deadline],
+        priority: Optional[str],
+    ) -> ServeResult:
+        with self._stats_lock:
+            self.stats["requests"] += 1
+        order, route_degraded = self._route(texts, deadline=deadline)
+        failover = route_degraded
+        hedged = False
+        hedge_s = config.get("fabric.hedge_ms") * 1e-3
+        base_msg = {
+            "op": "serve",
+            "texts": texts,
+            "k": k,
+            "priority": priority,
+            "deadline_ms": (
+                max(0.0, deadline.remaining_s() * 1e3)
+                if deadline is not None
+                else None
+            ),
+        }
+        attempts: List[Tuple[_HostLink, _Pending]] = []
+
+        def launch(idx: int) -> bool:
+            link = self._links[idx]
+            if not link.breaker.allow():
+                return False  # opened since routing, or probe slot taken
+            req_id = next(self._req_ids)
+            try:
+                pending = link.send_request(
+                    req_id, {**base_msg, "req_id": req_id}, deadline=deadline
+                )
+            except BaseException as exc:  # noqa: BLE001 - failover, never raise
+                link.breaker.record_failure()
+                log_once(
+                    f"fabric.send:{link.name}:{type(exc).__name__}",
+                    "fabric send to host %s failed (%r); failing over",
+                    link.name,
+                    exc,
+                )
+                return False
+            attempts.append((link, pending))
+            return True
+
+        queue = list(order)
+        while queue and not attempts:
+            if not launch(queue.pop(0)):
+                failover = True
+        if not attempts:
+            return self._lost(texts, route_degraded)
+
+        # wait for the first reply, hedging to the next host when the
+        # primary is slow; a failed attempt (host died mid-flight, recv
+        # chaos) re-routes to the next candidate — all on this thread
+        timeout_s = config.get("fabric.request_timeout_s")
+        t_end = time.monotonic() + timeout_s
+        if deadline is not None:
+            t_end = min(t_end, time.monotonic() + max(0.0, deadline.remaining_s()))
+        hedge_at = (
+            time.monotonic() + hedge_s if hedge_s > 0 and queue else None
+        )
+        try:
+            inject.fire("fabric.recv", deadline=deadline)
+        except BaseException as exc:  # noqa: BLE001 - recv chaos = failover
+            failover = True
+            for link, pending in attempts:
+                link.breaker.record_failure()
+            log_once(
+                f"fabric.recv:{type(exc).__name__}",
+                "fabric recv degraded (%r); failing over",
+                exc,
+            )
+            attempts.clear()
+            while queue and not attempts:
+                if not launch(queue.pop(0)):
+                    pass
+            if not attempts:
+                return self._lost(texts, True)
+        while True:
+            now = time.monotonic()
+            for link, pending in list(attempts):
+                if not pending.event.is_set():
+                    continue
+                reply = pending.reply or {}
+                if reply.get("op") == "result":
+                    link.breaker.record_success()
+                    return self._finish(
+                        reply, link, failover, hedged, route_degraded
+                    )
+                # error reply (host down / worker bug): drop this
+                # attempt, feed the breaker, re-route
+                attempts.remove((link, pending))
+                failover = True
+                if reply.get("req_id") is not None:
+                    # the WORKER answered with an error (its scheduler
+                    # raised — a stopped replica or a worker bug): that
+                    # host is sick even though its socket is healthy,
+                    # so its breaker must open.  Synthetic errors from
+                    # mark_down() carry no req_id and already fed the
+                    # breaker exactly once there.
+                    link.breaker.record_failure()
+                log_once(
+                    f"fabric.error:{link.name}",
+                    "fabric host %s failed a request (%s); failing over",
+                    link.name,
+                    reply.get("error", "?"),
+                )
+            if not attempts:
+                launched = False
+                while queue and not launched:
+                    launched = launch(queue.pop(0))
+                if not launched:
+                    return self._lost(texts, route_degraded)
+                continue
+            if hedge_at is not None and now >= hedge_at:
+                hedge_at = None
+                launched = False
+                while queue and not launched:
+                    launched = launch(queue.pop(0))
+                if launched:
+                    hedged = True
+                    with self._stats_lock:
+                        self.stats["hedged"] += 1
+            if now >= t_end:
+                # the fleet is slow past the budget: feed every slow
+                # host's breaker and degrade — never an exception
+                for link, _p in attempts:
+                    link.breaker.record_failure()
+                return self._lost(texts, route_degraded, timeout=True)
+            wait_s = min(0.01, max(0.0, t_end - now))
+            if hedge_at is not None:
+                wait_s = min(wait_s, max(0.0, hedge_at - now))
+            attempts[0][1].event.wait(wait_s)
+
+    def _finish(
+        self,
+        reply: Dict[str, Any],
+        link: _HostLink,
+        failover: bool,
+        hedged: bool,
+        route_degraded: bool,
+    ) -> ServeResult:
+        result = ServeResult(
+            reply.get("rows", []),
+            degraded=reply.get("degraded", ()),
+            meta=reply.get("meta", {}),
+        )
+        extra_meta: Dict[str, Any] = {"fabric_host": link.name}
+        extra_flags: Tuple[str, ...] = ()
+        if failover:
+            extra_flags = (HOST_FAILOVER,)
+            record_degraded(HOST_FAILOVER)
+            with self._stats_lock:
+                self.stats["failover"] += 1
+        else:
+            with self._stats_lock:
+                self.stats["ok"] += 1
+        if hedged:
+            extra_meta["hedged"] = True
+        if route_degraded:
+            extra_meta["route_degraded"] = True
+        return result.with_flags(extra_flags, extra_meta)
+
+    def _lost(
+        self,
+        texts: List[str],
+        route_degraded: bool,
+        timeout: bool = False,
+    ) -> ServeResult:
+        """No healthy host: the fleet, not the request, is the outage —
+        an empty FLAGGED result (counted), never an exception."""
+        record_degraded(REPLICA_LOST)
+        with self._stats_lock:
+            self.stats["lost"] += 1
+        meta: Dict[str, Any] = {"fabric": "no_healthy_host"}
+        if timeout:
+            meta["fabric"] = "fleet_timeout"
+        if route_degraded:
+            meta["route_degraded"] = True
+        return ServeResult(
+            [[] for _ in texts], degraded=(REPLICA_LOST,), meta=meta
+        )
+
+    # -- flight recorder ------------------------------------------------------
+    def observe_metrics(self):
+        base = {"fabric": self.name, "id": str(self._observe_id)}
+        for outcome in ("ok", "failover", "hedged", "lost"):
+            yield (
+                "counter",
+                "pathway_fabric_requests_total",
+                {**base, "outcome": outcome},
+                self.stats[outcome],
+            )
+        for link in self._links:
+            labels = {**base, "host": link.name}
+            yield ("gauge", "pathway_fabric_host_up", labels, int(link.up()))
+            yield (
+                "gauge", "pathway_fabric_inflight", labels, link.inflight
+            )
+
+    def stop(self) -> None:
+        """Close every link (bye frames, best-effort).  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for link in self._links:
+            link.close()

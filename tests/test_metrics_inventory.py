@@ -199,6 +199,47 @@ def rendered_families():
     assert ingest_runner.flush(timeout=30.0)
     ingest_runner.stop()
 
+    # serve fabric + durable warm state (ISSUE 19): one fabric-routed
+    # serve renders the pathway_fabric_* provider families; a snapshot,
+    # a warm restore, a corrupt-blob restore failure, and a degraded
+    # control-plane pair render every warm-state / dist family
+    from pathway_tpu.parallel import distributed as dist
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.serve import (
+        FabricWorker,
+        ServeFabric,
+        WarmStateManager,
+        fabric_token,
+    )
+
+    fab_sched = ServeScheduler(pipe, window_us=0, result_cache=None)
+    fab_tok = fabric_token()
+    fab_worker = FabricWorker(fab_sched, token=fab_tok, name="inv-host")
+    fabric = ServeFabric(
+        {"inv-host": fab_worker.address}, fab_tok, name="inventory"
+    )
+    assert fabric.connect() == 1
+    assert fabric.serve([QUERIES[0]])[0]
+
+    ws_rc = ResultCache()
+    ws_rc.put_row("inventory warm", 0, 3, [(1, 0.5)])
+    ws_backend = MemoryBackend()
+    ws = WarmStateManager(
+        ws_backend, name="inventory", components={"rc": ws_rc}
+    )
+    assert ws.snapshot() is not None
+    assert ws.restore().restored  # outcome=warm
+    ws_key = f"{ws._snap_prefix(ws._list_seqs()[-1])}/rc"
+    ws_blob = bytearray(ws_backend.get(ws_key))
+    ws_blob[len(ws_blob) // 2] ^= 0xFF
+    ws_backend.put(ws_key, bytes(ws_blob))
+    assert not ws.restore().restored  # outcome=cold + failure kind=crc
+    with inject.armed("dist.barrier", "raise", times=1):
+        assert dist.barrier("inventory-bar") is False
+    with inject.armed("dist.broadcast", "raise", times=1):
+        assert dist.broadcast_obj(1, name="inventory-bc") == 1
+    inject.disarm()
+
     # continuous decode + prefix KV cache (generator + prefill families)
     gen = TextGenerator(
         dimension=32, n_layers=1, n_heads=4, max_length=64,
@@ -315,6 +356,9 @@ def rendered_families():
         server.stop()
         for p in planes:
             p.close()
+        fabric.stop()
+        fab_worker.stop()
+        fab_sched.stop()
         trace.set_sample(sample0)
 
     assert slo_doc["slos"], "live /slo document is empty"

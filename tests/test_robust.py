@@ -1652,3 +1652,262 @@ def test_ingest_stage_chaos_triple_drops_batch_serve_bit_identical(
             conn.commit()
             assert runner.flush(timeout=10.0)
             assert runner.stats["docs"] == 1
+
+
+# -- chaos: dist control plane / warm state / serve fabric (ISSUE 19) --------
+
+
+def _dist_degraded(site: str) -> int:
+    return observe.counter("pathway_dist_degraded_total", site=site).value
+
+
+def test_dist_barrier_chaos_triple_degrades_to_local():
+    """``dist.barrier`` armed raise, delay, and hang-under-a-spent-
+    deadline: a faulted control-plane sync costs AGREEMENT (False,
+    counted) — never a hung serve tier."""
+    from pathway_tpu.parallel import distributed as dist
+
+    before = _dist_degraded("barrier")
+    with inject.armed("dist.barrier", "raise", times=1):
+        assert dist.barrier("chaos-raise") is False
+    assert _dist_degraded("barrier") == before + 1
+    with inject.armed("dist.barrier", "delay", delay_s=0.02):
+        assert dist.barrier("chaos-delay") is True  # slow, still agreed
+    t0 = time.monotonic()
+    with inject.armed("dist.barrier", "hang", hang_s=30.0):
+        assert dist.barrier("chaos-hang", deadline=Deadline(0.0)) is False
+    assert time.monotonic() - t0 < 2.0, "spent deadline must release the hang"
+    assert _dist_degraded("barrier") == before + 2
+
+
+def test_dist_broadcast_chaos_triple_serves_local_value():
+    """``dist.broadcast`` faulted: every process proceeds on its LOCAL
+    value (the coordinator's own object here), counted — consumers
+    treat it as flagged agreement, never a hung bring-up."""
+    from pathway_tpu.parallel import distributed as dist
+
+    before = _dist_degraded("broadcast")
+    with inject.armed("dist.broadcast", "raise", times=1):
+        assert dist.broadcast_obj(42, name="chaos-bc-raise") == 42
+    assert _dist_degraded("broadcast") == before + 1
+    with inject.armed("dist.broadcast", "delay", delay_s=0.02):
+        assert dist.broadcast_obj(43, name="chaos-bc-delay") == 43
+    t0 = time.monotonic()
+    with inject.armed("dist.broadcast", "hang", hang_s=30.0):
+        assert (
+            dist.broadcast_obj(
+                44, name="chaos-bc-hang", deadline=Deadline(0.0)
+            )
+            == 44
+        )
+    assert time.monotonic() - t0 < 2.0
+    assert _dist_degraded("broadcast") == before + 2
+
+
+class _WarmComp:
+    """Minimal warm-state component for chaos drills."""
+
+    def __init__(self):
+        self.state = {"kind": "chaos", "generation": 1, "payload": [1, 2, 3]}
+
+    def warm_state(self):
+        return dict(self.state)
+
+    def load_warm_state(self, state):
+        self.state = dict(state)
+
+
+def test_warmstate_snapshot_chaos_triple_skips_never_fails():
+    """``warmstate.snapshot`` armed raise, delay, and hang-under-a-
+    spent-deadline: a faulted snapshot is a SKIPPED cadence (None,
+    counted on ``pathway_warmstate_snapshot_skipped_total``) — the
+    serve tier never pays for its own durability."""
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.serve.warmstate import WarmStateManager
+
+    mgr = WarmStateManager(
+        MemoryBackend(), name="chaos-snap", components={"c": _WarmComp()}
+    )
+    skipped = observe.counter("pathway_warmstate_snapshot_skipped_total")
+    before = skipped.value
+    with inject.armed("warmstate.snapshot", "raise", times=1):
+        assert mgr.snapshot() is None
+    assert skipped.value == before + 1
+    with inject.armed("warmstate.snapshot", "delay", delay_s=0.02):
+        assert mgr.snapshot() is not None  # slow, still durable
+    t0 = time.monotonic()
+    with inject.armed("warmstate.snapshot", "hang", hang_s=30.0):
+        assert mgr.snapshot(deadline=Deadline(0.0)) is None
+    assert time.monotonic() - t0 < 2.0
+    assert skipped.value == before + 2
+    assert mgr.snapshot() is not None  # disarmed: the next cadence lands
+
+
+def test_warmstate_restore_chaos_triple_degrades_to_cold_start():
+    """``warmstate.restore`` faulted: bring-up degrades to a FLAGGED
+    cold start (counted, ``warm_restore_failed`` reason) — a wrong or
+    half-restored index is never served, and the component is left
+    untouched for the caller's re-ingest."""
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.serve.warmstate import WarmStateManager
+
+    writer = _WarmComp()
+    backend = MemoryBackend()
+    WarmStateManager(
+        backend, name="chaos-rest", components={"c": writer}
+    ).snapshot()
+    injected = observe.counter(
+        "pathway_warmstate_restore_failures_total", kind="injected"
+    )
+    before = injected.value
+    replica = _WarmComp()
+    replica.state = {"kind": "chaos", "generation": 0, "payload": []}
+    mgr = WarmStateManager(
+        backend, name="chaos-rest", components={"c": replica}
+    )
+    with inject.armed("warmstate.restore", "raise", times=1):
+        report = mgr.restore()
+    assert not report.restored
+    assert report.reasons == ("warm_restore_failed",)
+    assert injected.value == before + 1
+    assert replica.state["generation"] == 0, "cold start must not install"
+    t0 = time.monotonic()
+    with inject.armed("warmstate.restore", "hang", hang_s=30.0):
+        report = mgr.restore(deadline=Deadline(0.0))
+    assert time.monotonic() - t0 < 2.0 and not report.restored
+    assert injected.value == before + 2
+    with inject.armed("warmstate.restore", "delay", delay_s=0.02):
+        report = mgr.restore()  # slow, still warm
+    assert report.restored and replica.state["generation"] == 1
+
+
+def _mini_fleet(stack, n=2, tag=""):
+    """A tiny serve fabric over the shared fused target: n workers, each
+    its own scheduler, FRESH host names (fabric breakers are process-
+    wide, keyed by host name)."""
+    import itertools as _it
+
+    from pathway_tpu.serve import (
+        FabricWorker,
+        ServeFabric,
+        ServeScheduler,
+        fabric_token,
+    )
+
+    if not hasattr(_mini_fleet, "_seq"):
+        _mini_fleet._seq = _it.count()
+    enc, ce, index = stack
+    fused = FusedEncodeSearch(enc, index, k=8)
+    token = fabric_token()
+    names = [f"rb{tag}{next(_mini_fleet._seq)}-{i}" for i in range(n)]
+    scheds = [
+        ServeScheduler(fused, window_us=0, result_cache=None)
+        for _ in range(n)
+    ]
+    workers = [
+        FabricWorker(scheds[i], token=token, name=names[i]) for i in range(n)
+    ]
+    fabric = ServeFabric(
+        {w.name: w.address for w in workers}, token, name=f"rbfab{names[0]}"
+    )
+    assert fabric.connect() == n
+
+    def stop():
+        fabric.stop()
+        for w in workers:
+            w.stop()
+        for s in scheds:
+            s.stop()
+
+    return fabric, names, stop
+
+
+def test_fabric_route_chaos_triple_falls_back_to_least_loaded(stack):
+    """``fabric.route`` faulted: affinity is an optimization — routing
+    falls back to pure least-loaded, flagged ``host_failover``, rows
+    intact; a hang under a spent deadline releases immediately."""
+    from pathway_tpu.robust import HOST_FAILOVER, ServeResult
+
+    fabric, _names, stop = _mini_fleet(stack, tag="rt")
+    try:
+        clean = fabric.serve([QUERIES[0]])
+        assert clean.degraded == () and clean[0]
+        with inject.armed("fabric.route", "raise", times=1):
+            got = fabric.serve([QUERIES[0]])
+        assert got[0] and list(got) == list(clean)
+        assert HOST_FAILOVER in got.degraded
+        assert got.meta.get("route_degraded") is True
+        with inject.armed("fabric.route", "delay", delay_s=0.02):
+            got = fabric.serve([QUERIES[0]])
+        assert got.degraded == () and list(got) == list(clean)
+        t0 = time.monotonic()
+        with inject.armed("fabric.route", "hang", hang_s=30.0):
+            got = fabric.serve([QUERIES[0]], deadline=Deadline(0.0))
+        assert time.monotonic() - t0 < 5.0
+        assert isinstance(got, ServeResult)  # degraded, never an exception
+    finally:
+        stop()
+
+
+def test_fabric_send_chaos_triple_fails_over_then_degrades(stack):
+    """``fabric.send`` faulted once: the launch fails over to a
+    survivor (rows land, flagged); faulted everywhere: the fleet is
+    exhausted — an empty ``replica_lost`` result, never a raise."""
+    from pathway_tpu import robust as _robust
+    from pathway_tpu.robust import HOST_FAILOVER, REPLICA_LOST
+
+    fabric, names, stop = _mini_fleet(stack, tag="sd")
+    try:
+        with inject.armed("fabric.send", "raise", times=1):
+            got = fabric.serve([QUERIES[0]])
+        assert got[0], "one faulted send must not cost the request"
+        assert HOST_FAILOVER in got.degraded
+        for name in names:
+            _robust.breaker(f"fabric:{name}").reset()
+        with inject.armed("fabric.send", "raise"):
+            got = fabric.serve([QUERIES[0]])
+        assert list(got) == [[]]
+        assert got.degraded == (REPLICA_LOST,)
+        for name in names:
+            _robust.breaker(f"fabric:{name}").reset()
+        t0 = time.monotonic()
+        with inject.armed("fabric.send", "hang", hang_s=30.0):
+            got = fabric.serve([QUERIES[0]], deadline=Deadline(0.0))
+        assert time.monotonic() - t0 < 5.0
+        assert got.degraded == (REPLICA_LOST,)
+    finally:
+        stop()
+
+
+def test_fabric_recv_chaos_triple_reroutes_in_flight(stack):
+    """``fabric.recv`` faulted: the in-flight attempt is abandoned
+    (breaker fed) and the SAME call re-routes to a survivor — rows
+    land flagged ``host_failover``; a hang under a spent deadline
+    degrades fast instead of wedging the waiter."""
+    from pathway_tpu import robust as _robust
+    from pathway_tpu.robust import HOST_FAILOVER, ServeResult
+
+    fabric, names, stop = _mini_fleet(stack, tag="rc")
+    try:
+        with inject.armed("fabric.recv", "raise", times=1):
+            got = fabric.serve([QUERIES[0]])
+        assert got[0], "recv chaos must re-route, not fail the request"
+        assert HOST_FAILOVER in got.degraded
+        # exactly one host took the fall; the survivor answered
+        open_breakers = [
+            n for n in names
+            if _robust.breaker(f"fabric:{n}").state == "open"
+        ]
+        assert len(open_breakers) == 1
+        for name in names:
+            _robust.breaker(f"fabric:{name}").reset()
+        with inject.armed("fabric.recv", "delay", delay_s=0.02):
+            got = fabric.serve([QUERIES[0]])
+        assert got.degraded == () and got[0]
+        t0 = time.monotonic()
+        with inject.armed("fabric.recv", "hang", hang_s=30.0):
+            got = fabric.serve([QUERIES[0]], deadline=Deadline(0.0))
+        assert time.monotonic() - t0 < 5.0
+        assert isinstance(got, ServeResult)
+    finally:
+        stop()

@@ -498,3 +498,66 @@ def test_queue_metrics_reach_the_scrape_surface(stack):
         assert "pathway_serve_queue_wait_seconds" in hist_names
     lines = "\n".join(observe.render_prometheus())
     assert "pathway_serve_queue_depth" in lines
+
+
+# -- replica slot accounting (ISSUE 19 regression) ---------------------------
+
+
+def test_replica_handle_releases_exactly_once():
+    """The in-flight slot drains exactly once whether the batch handle
+    completes, raises, or is (wrongly) called twice."""
+    from pathway_tpu.serve.scheduler import _ReplicaHandle
+
+    released = []
+
+    def boom():
+        raise RuntimeError("batch died")
+
+    h = _ReplicaHandle(boom, lambda: released.append("boom"))
+    with pytest.raises(RuntimeError):
+        h()
+    with pytest.raises(RuntimeError):
+        h()
+    assert released == ["boom"]
+
+    ok = _ReplicaHandle(lambda: "rows", lambda: released.append("ok"))
+    assert ok() == "rows"
+    assert ok() == "rows"
+    assert released == ["boom", "ok"]
+
+
+def test_replica_submit_raise_releases_slot_exactly_once(stack):
+    """A replica whose ``submit`` RAISES after placement must release
+    its in-flight slot exactly once: the depth signal drains (no leak
+    starving the dead replica's future share), riders degrade instead
+    of raising, and the healthy replica keeps serving."""
+    pipe = _pipeline(stack)
+
+    class _Exploding:
+        calls = 0
+
+        def submit(self, texts, k=None, deadline=None, n_requests=1):
+            type(self).calls += 1
+            raise RuntimeError("replica died at submit")
+
+    with ServeScheduler(
+        pipe, window_us=2_000, replicas=[_Exploding()], result_cache=None
+    ) as sched:
+        releases = []
+        orig_release = sched._release_replica
+
+        def counted_release(r):
+            releases.append(r)
+            orig_release(r)
+
+        sched._release_replica = counted_release
+        for i in range(6):
+            got = sched.serve([QUERIES[i % len(QUERIES)]])
+            assert isinstance(got, list)  # degrade, never raise
+        assert _Exploding.calls > 0, "placement never reached the dead replica"
+        # exactly one release per placement — no leak, no double-release
+        assert len(releases) == sum(sched._placed), (releases, sched._placed)
+        assert sched._inflight == [0, 0], sched._inflight
+        # the fleet still serves: the healthy replica answers
+        clean = sched.serve([QUERIES[0]])
+        assert clean and clean[0]

@@ -1,0 +1,314 @@
+"""Durable warm-state snapshots (ISSUE 19, serve/warmstate.py).
+
+The integrity bar: a corrupt or truncated snapshot chunk fails the CRC
+scan and bring-up FALLS BACK (next-older snapshot, then a flagged cold
+start counted on ``pathway_warmstate_restore_failures_total{kind}``) —
+a wrong index is NEVER installed.  The bit-identity bar: a warm-restored
+component serves bit-identically to the snapshot writer at the writer's
+index generation, so cache/dedup keys agree across a replica group.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu import observe
+from pathway_tpu.cache import EmbeddingCache, ResultCache
+from pathway_tpu.index.forward import ForwardIndex
+from pathway_tpu.models.encoder import SentenceEncoder
+from pathway_tpu.ops.ivf import IvfKnnIndex
+from pathway_tpu.ops.serving import FusedEncodeSearch
+from pathway_tpu.persistence.backends import MemoryBackend
+from pathway_tpu.serve.warmstate import WarmStateManager
+
+DOCS = {
+    i: f"warm doc {i} about {topic} case {i % 5}"
+    for i, topic in enumerate(
+        [
+            "snapshot replay", "vector indexes", "rolling restarts",
+            "replica groups", "commit ticks", "stream joins",
+            "crc framing", "manifest commit", "cold ingest",
+            "bit identity", "cache tiers", "forward rows",
+        ]
+        * 3
+    )
+}
+QUERIES = ["rolling replica restart", "crc framed manifest", "cold ingest"]
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return SentenceEncoder(
+        dimension=32, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=512, dtype=jnp.float32,
+    )
+
+
+def _ivf(enc, n=None):
+    index = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+    )
+    keys = sorted(DOCS)[: n or len(DOCS)]
+    index.add(keys, enc.encode([DOCS[i] for i in keys]))
+    return index
+
+
+def _restore_failures(kind: str) -> int:
+    return observe.counter(
+        "pathway_warmstate_restore_failures_total", kind=kind
+    ).value
+
+
+def _restores(outcome: str) -> int:
+    return observe.counter(
+        "pathway_warmstate_restores_total", outcome=outcome
+    ).value
+
+
+# -- round-trip bit-identity -------------------------------------------------
+
+
+def test_ivf_snapshot_restore_is_bit_identical(enc):
+    """A replacement replica restoring the writer's snapshot serves the
+    SAME rows at the SAME generation — warm bring-up, no re-ingest."""
+    writer = _ivf(enc)
+    q = enc.encode(QUERIES)
+    want_gen = writer.generation  # capture BEFORE search (absorb can bump)
+    want = [writer.search(q, k=5) for _ in range(2)][-1]
+    want_gen_after = writer.generation
+
+    mgr = WarmStateManager(
+        MemoryBackend(), name="ivf-rt", components={"ivf": writer}
+    )
+    prefix = mgr.snapshot()
+    assert prefix is not None
+
+    replica = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+    )
+    report = WarmStateManager(
+        mgr.backend, name="ivf-rt", components={"ivf": replica}
+    ).restore()
+    assert report.restored and report.snapshot == prefix
+    assert replica.generation == writer.generation
+    assert report.generations["ivf"] == writer.generation
+    got = replica.search(q, k=5)
+    np.testing.assert_array_equal(np.asarray(want[0]), np.asarray(got[0]))
+    np.testing.assert_array_equal(np.asarray(want[1]), np.asarray(got[1]))
+    assert (want_gen, want_gen_after) == (want_gen, want_gen_after)
+
+
+def test_forward_index_snapshot_restore_is_bit_identical(enc):
+    fwd = ForwardIndex(enc, tokens_per_doc=8, initial_capacity=64)
+    keys = sorted(DOCS)
+    assert fwd.add(keys, [DOCS[i] for i in keys]) == len(keys)
+    qtok, qmask, _ = enc.encode_token_states(QUERIES[:1])
+    cand = keys[:12]
+    done, _missing = fwd.gather_submit(qtok, qmask, [cand], k_out=8)
+    want_scores, want_perm = done()
+
+    backend = MemoryBackend()
+    WarmStateManager(
+        backend, name="fwd-rt", components={"forward": fwd}
+    ).snapshot()
+    replica = ForwardIndex(enc, tokens_per_doc=8, initial_capacity=64)
+    report = WarmStateManager(
+        backend, name="fwd-rt", components={"forward": replica}
+    ).restore()
+    assert report.restored
+    assert len(replica) == len(fwd)
+    assert replica.generation == fwd.generation
+    done, _missing = replica.gather_submit(qtok, qmask, [cand], k_out=8)
+    got_scores, got_perm = done()
+    np.testing.assert_array_equal(np.asarray(want_scores), np.asarray(got_scores))
+    np.testing.assert_array_equal(np.asarray(want_perm), np.asarray(got_perm))
+
+
+def test_cache_tiers_snapshot_restore_round_trip(enc):
+    rc = ResultCache()
+    rows = [[(1, 0.5), (2, 0.25)]]
+    assert rc.put_row("warm q", 3, 5, rows[0])
+    emb = EmbeddingCache()
+    key = b"space\x00row"
+    row = jnp.asarray(np.arange(32, dtype=np.float32))
+    assert emb.put_row(key, row)
+
+    backend = MemoryBackend()
+    WarmStateManager(
+        backend, name="caches",
+        components={"result_cache": rc, "embedding_cache": emb},
+    ).snapshot()
+    rc2, emb2 = ResultCache(), EmbeddingCache()
+    report = WarmStateManager(
+        backend, name="caches",
+        components={"result_cache": rc2, "embedding_cache": emb2},
+    ).restore()
+    assert report.restored
+    assert rc2.get_rows([("warm q", 3)], 5) == rows
+    got = emb2._tier.get(key)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(row))
+
+
+def test_warm_restored_serve_stack_is_bit_identical(enc):
+    """End-to-end: the writer's fused serve vs a replica brought up from
+    its snapshot — same scores, same keys, same generation (the fabric's
+    warm-bring-up contract)."""
+    writer = _ivf(enc)
+    backend = MemoryBackend()
+    WarmStateManager(
+        backend, name="stack", components={"ivf": writer}
+    ).snapshot()
+    want = FusedEncodeSearch(enc, writer, k=5)(QUERIES)
+
+    replica = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+    )
+    assert WarmStateManager(
+        backend, name="stack", components={"ivf": replica}
+    ).restore().restored
+    got = FusedEncodeSearch(enc, replica, k=5)(QUERIES)
+    assert [list(r) for r in want] == [list(r) for r in got]
+
+
+# -- integrity: corrupt / truncated snapshots --------------------------------
+
+
+def _section_key(mgr: WarmStateManager, section: str) -> str:
+    seqs = mgr._list_seqs()
+    return f"{mgr._snap_prefix(seqs[-1])}/{section}"
+
+
+def test_corrupt_chunk_fails_crc_and_falls_back_to_older(enc):
+    writer = _ivf(enc)
+    backend = MemoryBackend()
+    mgr = WarmStateManager(
+        backend, name="crc", components={"ivf": writer}, keep=4
+    )
+    older = mgr.snapshot()
+    newer = mgr.snapshot()
+    assert older != newer
+    key = _section_key(mgr, "ivf")
+    blob = bytearray(backend.get(key))
+    blob[len(blob) // 2] ^= 0xFF  # bit rot inside a framed chunk
+    backend.put(key, bytes(blob))
+
+    replica = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+    )
+    crc0 = _restore_failures("crc")
+    report = WarmStateManager(
+        backend, name="crc", components={"ivf": replica}
+    ).restore()
+    assert _restore_failures("crc") == crc0 + 1
+    assert report.restored and report.snapshot == older
+    assert replica.generation == writer.generation
+
+
+def test_truncated_blob_is_detected_and_counted(enc):
+    writer = _ivf(enc)
+    backend = MemoryBackend()
+    mgr = WarmStateManager(backend, name="trunc", components={"ivf": writer})
+    mgr.snapshot()
+    key = _section_key(mgr, "ivf")
+    blob = backend.get(key)
+    backend.put(key, blob[: len(blob) - 7])  # torn write: tail lost
+
+    replica = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+    )
+    before = _restore_failures("crc") + _restore_failures("truncated")
+    cold0 = _restores("cold")
+    report = WarmStateManager(
+        backend, name="trunc", components={"ivf": replica}
+    ).restore()
+    assert _restore_failures("crc") + _restore_failures("truncated") == before + 1
+    # the only snapshot is torn: bring-up degrades to a FLAGGED cold
+    # start — the caller re-ingests; the corrupt state is NOT installed
+    assert not report.restored
+    assert report.reasons == ("warm_restore_failed",)
+    assert _restores("cold") == cold0 + 1
+    assert len(replica) == 0, "torn snapshot must never install"
+
+
+def test_install_mismatch_is_counted_never_wrong(enc):
+    """A snapshot whose geometry disagrees with the component (wrong
+    dimension — an operator pointed a replica at the wrong fleet) fails
+    the INSTALL validation: counted, cold start, component untouched."""
+    writer = _ivf(enc)
+    backend = MemoryBackend()
+    WarmStateManager(
+        backend, name="geom", components={"ivf": writer}
+    ).snapshot()
+    wrong = IvfKnnIndex(
+        dimension=16, metric="cos", n_clusters=4, n_probe=4,
+    )
+    inst0 = _restore_failures("install")
+    report = WarmStateManager(
+        backend, name="geom", components={"ivf": wrong}
+    ).restore()
+    assert not report.restored
+    assert _restore_failures("install") == inst0 + 1
+    assert report.reasons == ("warm_restore_failed",)
+    assert len(wrong) == 0
+
+
+def test_missing_manifest_means_snapshot_invisible(enc):
+    """Manifest-last commit: deleting the manifest (= a crash before the
+    commit marker landed) makes the snapshot invisible — restore is a
+    CLEAN cold start, not a failure."""
+    writer = _ivf(enc)
+    backend = MemoryBackend()
+    mgr = WarmStateManager(backend, name="mf", components={"ivf": writer})
+    prefix = mgr.snapshot()
+    backend.delete(f"{prefix}/MANIFEST")
+    replica = IvfKnnIndex(
+        dimension=32, metric="cos", n_clusters=4, n_probe=4,
+    )
+    report = WarmStateManager(
+        backend, name="mf", components={"ivf": replica}
+    ).restore()
+    assert not report.restored
+    assert report.reasons == ()  # first boot, nothing counted
+
+
+def test_empty_backend_is_clean_cold_start(enc):
+    replica = _ivf(enc, n=4)
+    report = WarmStateManager(
+        MemoryBackend(), name="empty", components={"ivf": replica}
+    ).restore()
+    assert not report.restored and report.reasons == ()
+
+
+def test_prune_keeps_newest_snapshots(enc):
+    writer = _ivf(enc, n=4)
+    mgr = WarmStateManager(
+        MemoryBackend(), name="prune", components={"ivf": writer}, keep=2
+    )
+    prefixes = [mgr.snapshot() for _ in range(4)]
+    seqs = mgr._list_seqs()
+    assert len(seqs) == 2
+    assert mgr._snap_prefix(seqs[-1]) == prefixes[-1]
+    assert mgr._snap_prefix(seqs[0]) == prefixes[-2]
+    # pruned snapshots left no orphan keys behind
+    live = set(mgr.backend.list_keys(mgr._root() + "/"))
+    assert all(
+        any(k.startswith(mgr._snap_prefix(s)) for s in seqs) for k in live
+    )
+
+
+def test_maybe_snapshot_honors_manual_interval(enc):
+    writer = _ivf(enc, n=4)
+    mgr = WarmStateManager(
+        MemoryBackend(), name="cad", components={"ivf": writer},
+        interval_s=0,
+    )
+    assert mgr.maybe_snapshot() is None  # 0 = manual only
+    assert mgr.snapshot() is not None
+
+
+def test_agree_generation_single_process(enc):
+    mgr = WarmStateManager(MemoryBackend(), name="agree")
+    gen, agreed = mgr.agree_generation(7, tag="t0")
+    assert (gen, agreed) == (7, True)
