@@ -3628,6 +3628,285 @@ def phase_serve_fabric(backend: str, extras: dict) -> float:
     return round(bounce_p99_ms, 3)
 
 
+def phase_partitioned_fabric(backend: str, extras: dict) -> float:
+    """Cross-host index sharding (ISSUE 20: ``FleetPartitionMap`` +
+    ``ServeFabric(partitions=H)``): H partition hosts each own the
+    ``doc_key % H`` slice of one corpus and the front serves by
+    scatter-gather.  Measures the POINT of partitioning — per-host HBM
+    at H=3 vs H=1 (the 0.45× acceptance bar), owner-routed absorb
+    throughput ×H A/B, scatter-gather p50/p99 at c16 for both fleet
+    sizes, the 1-logical + H-physical scatter booking next to the 2+2
+    per-host budget, and a KILL-ONE-PARTITION burst (affected requests
+    flagged ``partition_lost`` with the survivors' rows, recall lost on
+    the dead partition's keys ONLY, zero exceptions).  The phase value
+    is the H=3 scatter-gather p99 in ms."""
+    jax = _init_jax(backend)
+    import jax.numpy as jnp
+
+    from pathway_tpu import robust
+    from pathway_tpu.models.encoder import SentenceEncoder
+    from pathway_tpu.ops import dispatch_counter
+    from pathway_tpu.ops.ivf import IvfKnnIndex
+    from pathway_tpu.ops.serving import FusedEncodeSearch
+    from pathway_tpu.parallel import FleetPartitionMap
+    from pathway_tpu.robust import PARTITION_LOST
+    from pathway_tpu.serve import (
+        FabricWorker,
+        LiveIngestRunner,
+        ServeFabric,
+        ServeScheduler,
+        fabric_token,
+    )
+
+    backend = jax.default_backend()
+    extras["backend"] = backend
+    on_tpu = backend == "tpu"
+    dim = 384 if on_tpu else 64
+    n_docs = int(os.environ.get("BENCH_PF_DOCS", "12000" if on_tpu else "900"))
+    k = 10
+    conc = 16
+    n_req = int(os.environ.get("BENCH_PF_REQUESTS", str(conc * 6)))
+    hb_s, hb_timeout_s = 0.1, 0.5
+    env_prev = {
+        kk: os.environ.get(kk)
+        for kk in ("PATHWAY_FABRIC_HEARTBEAT", "PATHWAY_FABRIC_HEARTBEAT_TIMEOUT")
+    }
+    os.environ["PATHWAY_FABRIC_HEARTBEAT"] = str(hb_s)
+    os.environ["PATHWAY_FABRIC_HEARTBEAT_TIMEOUT"] = str(hb_timeout_s)
+
+    enc = SentenceEncoder(
+        dimension=dim, n_layers=2, n_heads=4, max_length=32,
+        vocab_size=2048, dtype=jnp.float32,
+    )
+    docs = dict(enumerate(_corpus_texts(n_docs)))
+    pool = [
+        " ".join(docs[(i * 9973) % n_docs].split()[:8]) for i in range(32)
+    ]
+
+    class _Fleet:
+        """H partition hosts (owned IVF slice → fused search →
+        scheduler → worker + ingest runner) + the partitioned front."""
+
+        def __init__(self, n_parts: int, tag: str):
+            self.names = [f"bench-pf{tag}-{i}" for i in range(n_parts)]
+            self.token = fabric_token()
+            pmap = FleetPartitionMap(n_parts)
+            self.indexes, self.scheds = [], []
+            self.runners, self.workers = [], []
+            for i in range(n_parts):
+                owned = [kk for kk in range(n_docs) if pmap.owner_of(kk) == i]
+                # cluster count scales with the owned slice so the slab
+                # capacity (max cluster size, padded) shrinks with it —
+                # that shrink IS the per-host HBM win being measured
+                nc = max(8, len(owned) // 48)
+                idx = IvfKnnIndex(
+                    dimension=dim, metric="cos", n_clusters=nc, n_probe=nc
+                )
+                idx.add(owned, enc.encode([docs[kk] for kk in owned]))
+                idx.build()
+                self.indexes.append(idx)
+                sched = ServeScheduler(
+                    FusedEncodeSearch(enc, idx, k=k),
+                    window_us=0, result_cache=None,
+                    name=f"{self.names[i]}-s",
+                )
+                self.scheds.append(sched)
+                runner = LiveIngestRunner(enc, idx, name=f"{self.names[i]}-ing")
+                self.runners.append(runner)
+                self.workers.append(
+                    FabricWorker(
+                        sched, token=self.token, name=self.names[i],
+                        ingest=runner,
+                    )
+                )
+            self.fabric = ServeFabric(
+                {w.name: w.address for w in self.workers},
+                self.token,
+                name=f"bench-pfab{tag}",
+                partitions=n_parts,
+            )
+
+        def per_host_hbm(self) -> int:
+            return max(
+                sum(idx.hbm_bytes().values()) for idx in self.indexes
+            )
+
+        def stop(self) -> None:
+            self.fabric.stop()
+            for w in self.workers:
+                w.stop()
+            for r in self.runners:
+                r.stop()
+            for s in self.scheds:
+                s.stop()
+
+    def drive(fabric, n: int):
+        """c16 barrier drive; (latency ms, degraded flags, rows, errors)."""
+        reqs = [pool[(i * 7) % len(pool)] for i in range(n)]
+        lats: list = [None] * n
+        flags: list = [()] * n
+        rows: list = [None] * n
+        errs: list = []
+        barrier = threading.Barrier(conc)
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=60)
+                for i in range(t, n, conc):
+                    t0 = time.perf_counter()
+                    res = fabric.serve([reqs[i]], k)
+                    lats[i] = (time.perf_counter() - t0) * 1e3
+                    flags[i] = tuple(res.degraded)
+                    rows[i] = list(res[0]) if res else []
+            except Exception as exc:  # the contract: NEVER an exception
+                errs.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(conc)
+        ]
+        t_all = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats, flags, rows, errs, time.perf_counter() - t_all
+
+    def absorb_rate(fleet) -> float:
+        """Commit a fresh batch through the owner-routed path, flush
+        every owner, confirm every partition's generation bumped;
+        docs/s from commit to fleet-wide retrievability."""
+        before = fleet.fabric.poll_generations()
+        n_fresh = int(os.environ.get("BENCH_PF_ABSORB", "120"))
+        t_ns = time.time_ns()
+        batch = [
+            (n_docs + j, f"absorbed fleet doc {n_docs + j} fresh", t_ns)
+            for j in range(n_fresh)
+        ]
+        t0 = time.perf_counter()
+        accepted = fleet.fabric.absorb(batch)
+        for r in fleet.runners:
+            assert r.flush(timeout=60), "ingest flush wedged"
+        elapsed = time.perf_counter() - t0
+        assert accepted == n_fresh, (accepted, n_fresh)
+        t_end = time.monotonic() + 30
+        gens = fleet.fabric.poll_generations()
+        while time.monotonic() < t_end and not all(
+            g > b for g, b in zip(gens, before)
+        ):
+            time.sleep(0.05)
+            gens = fleet.fabric.poll_generations()
+        assert all(g > b for g, b in zip(gens, before)), (before, gens)
+        return n_fresh / elapsed
+
+    p99_h3 = 0.0
+    fleet1 = _Fleet(1, "a")
+    fleet3 = _Fleet(3, "b")
+    try:
+        # -- per-host HBM: the point of partitioning (measured before
+        # any serve so no exact-tail upload cache inflates either side) --
+        hbm1 = fleet1.per_host_hbm()
+        hbm3 = fleet3.per_host_hbm()
+        extras["partition_hbm_per_host_h1_mb"] = round(hbm1 / 2**20, 3)
+        extras["partition_hbm_per_host_h3_mb"] = round(hbm3 / 2**20, 3)
+        hbm_ratio = hbm3 / max(hbm1, 1)
+        extras["partition_hbm_h3_vs_h1_x"] = round(hbm_ratio, 3)
+        assert hbm_ratio <= 0.45, (
+            f"per-host HBM at H=3 is {hbm_ratio:.2f}x H=1 — the "
+            "partitioned fleet must shed ~1/H per host (bar: 0.45x)"
+        )
+
+        assert fleet1.fabric.connect() == 1
+        assert fleet3.fabric.connect() == 3
+        for q in pool:  # warm every per-host compile shape
+            fleet1.fabric.serve([q], k)
+            fleet3.fabric.serve([q], k)
+
+        # -- scatter-gather latency at c16, both fleet sizes --
+        lats, flags, _rows, errs, elapsed = drive(fleet1.fabric, n_req)
+        assert errs == [] and not any(flags), (errs[:3], flags[:3])
+        done = np.asarray([l for l in lats if l is not None])
+        extras["partition_p50_h1_c16_ms"] = round(float(np.percentile(done, 50)), 3)
+        extras["partition_p99_h1_c16_ms"] = round(float(np.percentile(done, 99)), 3)
+        extras["partition_qps_h1_c16"] = round(n_req / elapsed, 2)
+        lats, flags, _rows, errs, elapsed = drive(fleet3.fabric, n_req)
+        assert errs == [] and not any(flags), (errs[:3], flags[:3])
+        done = np.asarray([l for l in lats if l is not None])
+        p99_h3 = float(np.percentile(done, 99))
+        extras["partition_p50_h3_c16_ms"] = round(float(np.percentile(done, 50)), 3)
+        extras["partition_p99_h3_c16_ms"] = round(p99_h3, 3)
+        extras["partition_qps_h3_c16"] = round(n_req / elapsed, 2)
+
+        # -- the scatter booking: 1 logical + H physical, hosts at 2+2 --
+        with dispatch_counter.DispatchCounter() as counter:
+            res = fleet3.fabric.serve([pool[0]], k)
+        assert res and res[0] and not res.degraded
+        disp = [t for kind, t in counter.events if kind == "dispatch"]
+        fet = [t for kind, t in counter.events if kind == "fetch"]
+        assert disp.count("fabric.scatter") == 1, counter.events
+        assert fet.count("fabric.gather") == 1, counter.events
+        host_disp = [t for t in disp if t != "fabric.scatter"]
+        host_fet = [t for t in fet if t != "fabric.gather"]
+        assert len(host_disp) <= 3 * 2, counter.events
+        assert len(host_fet) <= 3 * 2, counter.events
+        extras["partition_scatter_logical_dispatches"] = disp.count("fabric.scatter")
+        extras["partition_host_dispatches_per_serve"] = len(host_disp)
+
+        # -- owner-routed absorb throughput: H=1 vs H=3 on the same
+        # fresh batch (each H=3 owner ingests 1/3 of the stream) --
+        rate1 = absorb_rate(fleet1)
+        rate3 = absorb_rate(fleet3)
+        absorb_x = rate3 / max(rate1, 1e-9)
+        extras["partition_absorb_docs_per_s_h1"] = round(rate1, 2)
+        extras["partition_absorb_docs_per_s_h3"] = round(rate3, 2)
+        extras["partition_absorb_h3_vs_h1_x"] = round(absorb_x, 2)
+        # owners ingest concurrently; CPU thread contention bounds the
+        # win well short of 3x, but partitioning must never SERIALIZE
+        # the fleet below the single host
+        assert absorb_x > 0.9, (rate1, rate3)
+
+        # -- kill-one-partition burst: crash partition 0 mid-flight --
+        killed = threading.Event()
+
+        def killer():
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30:
+                if fleet3.fabric._links[0].inflight > 0:
+                    break
+                time.sleep(0.002)
+            fleet3.workers[0].kill()
+            fleet3.scheds[0].stop()
+            killed.set()
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        lats, flags, rows, errs, _elapsed = drive(fleet3.fabric, n_req)
+        kt.join()
+        assert killed.is_set()
+        assert errs == [], errs[:3]
+        lost = [i for i in range(n_req) if PARTITION_LOST in flags[i]]
+        assert lost, "the kill burst never caught a scatter in flight"
+        for i in lost:
+            # survivors still serve rows; recall is lost ONLY on the
+            # dead partition's keys
+            assert rows[i], f"request {i} lost its survivors' merge"
+            assert all(int(kk) % 3 != 0 for kk, _s in rows[i]), rows[i]
+        extras["partition_kill_lost_requests"] = len(lost)
+        extras["partition_kill_requests"] = n_req
+        breaker0 = robust.breaker(f"fabric:{fleet3.names[0]}")
+        extras["partition_breaker_after_kill"] = breaker0.state
+        assert breaker0.state != "closed", breaker0.state
+    finally:
+        fleet3.stop()
+        fleet1.stop()
+        for kk, vv in env_prev.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+
+    return round(p99_h3, 3)
+
+
 def phase_wordcount(backend: str, extras: dict) -> float:
     """Relational engine throughput: rows/sec through groupby-count."""
     _init_jax("cpu")  # host-side engine bench; never needs the device
@@ -3969,6 +4248,7 @@ _PHASES = {
     "ingest": (phase_ingest, 900),
     "live_ingest": (phase_live_ingest, 600),
     "serve_fabric": (phase_serve_fabric, 600),
+    "partitioned_fabric": (phase_partitioned_fabric, 600),
     "wordcount": (phase_wordcount, 450),
     "scaling": (phase_scaling, 900),
     "exchange": (phase_exchange, 450),
@@ -4204,6 +4484,7 @@ def main() -> None:
         ("ingest", lambda: device_phase("ingest")),
         ("live_ingest", lambda: device_phase("live_ingest")),
         ("serve_fabric", lambda: device_phase("serve_fabric")),
+        ("partitioned_fabric", lambda: device_phase("partitioned_fabric")),
         ("wordcount", lambda: run_phase("wordcount", backend, extras, errors)),
         # host BSP plane microbench + offline answer-quality eval (cpu)
         ("exchange", lambda: run_phase("exchange", "cpu", extras, errors)),

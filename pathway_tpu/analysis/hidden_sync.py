@@ -34,6 +34,16 @@ Checks, per function scope:
   so the budget checks skip wrapper scopes.  A cache lookup guarding a
   dispatch is not a hidden sync; the blocking dispatch+sync check and
   every lock-discipline check still apply inside wrappers.
+- **fan-out width** (only in budget modules): a scope that fans stream
+  I/O out in a loop — the partitioned fabric's scatter-gather
+  (serve/fabric.py ``fabric.scatter``/``fabric.gather``), same shape as
+  the sharded index's per-shard launches — and books dispatches must
+  declare the physical width on the booking
+  (``record_dispatch(tag, shards=N)``, 1 logical + N physical).
+  Booking an H-way scatter without ``shards=`` records one physical
+  send and the runtime shard-dispatch counters silently under-count by
+  H−1.  See ``registry.is_dispatch_booking`` /
+  ``registry.booking_declares_fanout`` for the convention.
 """
 
 from __future__ import annotations
@@ -43,11 +53,13 @@ from typing import List, Optional, Set, Tuple
 
 from .core import ModuleContext, Rule
 from .registry import (
+    booking_declares_fanout,
     dotted_name,
     is_cache_wrapper,
     is_device_value_arg,
     is_device_value_base,
     is_jit_call,
+    is_stream_io,
     scope_jit_and_device_vars,
     walk_scope,
 )
@@ -102,6 +114,7 @@ class HiddenSyncRule(Rule):
         cache_wrapper = is_cache_wrapper(scope.name)
         dispatches: List[ast.Call] = []
         syncs: List[Tuple[ast.Call, str]] = []
+        bookings: List[ast.Call] = []
         has_record_dispatch = False
         has_record_fetch = False
         for node in walk_scope(scope):
@@ -111,8 +124,10 @@ class HiddenSyncRule(Rule):
             leaf = callee.rsplit(".", 1)[-1] if callee else ""
             if leaf == "record_dispatch":
                 has_record_dispatch = True
+                bookings.append(node)
             elif leaf == "record_fetch":
                 has_record_fetch = True
+                bookings.append(node)
             elif is_jit_call(node, jit_fns):
                 dispatches.append(node)
             elif leaf == "block_until_ready":
@@ -152,6 +167,21 @@ class HiddenSyncRule(Rule):
                 )
         if cache_wrapper:
             return
+        # fan-out width: a booked scope whose stream I/O fans out in a
+        # loop (the scatter-gather shape) must declare the physical
+        # width on the booking — record_dispatch(tag, shards=N)
+        if self._budget_module and bookings and not any(
+            booking_declares_fanout(b) for b in bookings
+        ):
+            fanned = self._loop_stream_io(scope)
+            if fanned is not None:
+                ctx.report(
+                    self.name, bookings[0],
+                    f"stream fan-out (`{fanned}` inside a loop) booked "
+                    "without its physical width — book the scatter as "
+                    "record_dispatch(tag, shards=N) / record_fetch(tag, "
+                    "shards=N) so the budget stays 1 logical + N physical",
+                )
         if self._budget_module and dispatches and not has_record_dispatch:
             for node in dispatches:
                 ctx.report(
@@ -159,3 +189,17 @@ class HiddenSyncRule(Rule):
                     "jitted dispatch without record_dispatch in scope — "
                     "the serving dispatch budget under-counts this launch",
                 )
+
+    @staticmethod
+    def _loop_stream_io(scope) -> Optional[str]:
+        """The dotted spelling of the first stream I/O call lexically
+        inside a loop of this scope (nested defs excluded), or None."""
+        for node in walk_scope(scope):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for inner in walk_scope(node):
+                if isinstance(inner, ast.Call):
+                    spelled = is_stream_io(inner)
+                    if spelled:
+                        return spelled
+        return None

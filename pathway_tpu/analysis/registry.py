@@ -37,12 +37,14 @@ import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 __all__ = [
+    "booking_declares_fanout",
     "collect_donating_jits",
     "collect_jit_names",
     "dotted_name",
     "is_cache_access",
     "is_cache_wrapper",
     "is_device_producer_call",
+    "is_dispatch_booking",
     "is_handle_fetch",
     "is_lock_context",
     "is_observability_callback",
@@ -146,6 +148,22 @@ _STREAM_IO_METHOD_RE = re.compile(r"^(send|recv|send_request)$")
 _STREAM_RECEIVER_RE = re.compile(
     r"(^|_)(stream|link|peer|conn)s?$", re.IGNORECASE
 )
+
+# the scatter-gather fan-out booking convention (ops/dispatch_counter.py):
+# a serve path that fans ONE logical dispatch out to N physical targets —
+# the sharded index's per-shard device launches, the partitioned fabric's
+# per-partition stream sends (serve/fabric.py ``fabric.scatter`` /
+# ``fabric.gather``) — books it as ``record_dispatch(tag, shards=N)`` /
+# ``record_fetch(tag, shards=N)``: 1 logical + N physical on the runtime
+# counters, so the 2+2 per-batch budget stays a statement about LOGICAL
+# round trips while the physical width remains visible
+# (``pathway_serve_shard_dispatches_total``).  ``is_dispatch_booking``
+# recognizes any record_dispatch/record_fetch call;
+# ``booking_declares_fanout`` whether it carries the ``shards=`` width —
+# the hidden-sync rule requires the width on scopes that visibly fan out
+# (stream I/O inside a loop), or the budget would book an H-way scatter
+# as one physical send.
+_BOOKING_LEAVES = {"record_dispatch", "record_fetch"}
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:
@@ -451,6 +469,23 @@ def is_stream_io(call: ast.Call) -> Optional[str]:
     if _STREAM_RECEIVER_RE.search(receiver.rsplit(".", 1)[-1]):
         return f"{receiver}.{func.attr}"
     return None
+
+
+def is_dispatch_booking(call: ast.Call) -> Optional[str]:
+    """A runtime dispatch-budget booking: a bare or attribute call whose
+    leaf is ``record_dispatch`` / ``record_fetch`` (ops/dispatch_counter).
+    Returns the leaf name, or None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf if leaf in _BOOKING_LEAVES else None
+
+
+def booking_declares_fanout(call: ast.Call) -> bool:
+    """Whether a dispatch booking carries the ``shards=`` keyword — the
+    scatter-gather fan-out convention (1 logical + N physical)."""
+    return any(kw.arg == "shards" for kw in call.keywords)
 
 
 def is_cache_wrapper(scope_name: str) -> bool:

@@ -45,7 +45,13 @@ Env knobs: ``PATHWAY_CACHE`` (global kill switch),
 """
 
 from .embedding import EmbeddingCache, embedding_cache_from_env
-from .keys import block_chain_keys, query_key, result_key, token_ids_key
+from .keys import (
+    block_chain_keys,
+    normalize_generation,
+    query_key,
+    result_key,
+    token_ids_key,
+)
 from .prefix import PrefixKVCache, prefix_kv_cache_from_env
 from .result import ResultCache, result_cache_from_env
 from .store import CacheTier, cache_enabled
@@ -58,6 +64,7 @@ __all__ = [
     "block_chain_keys",
     "cache_enabled",
     "embedding_cache_from_env",
+    "normalize_generation",
     "prefix_kv_cache_from_env",
     "query_key",
     "result_cache_from_env",

@@ -34,22 +34,36 @@ import numpy as np
 
 __all__ = [
     "block_chain_keys",
+    "normalize_generation",
     "query_key",
     "result_key",
     "token_ids_key",
 ]
 
 
-def query_key(text: Any, generation: int) -> Tuple[str, int]:
+def normalize_generation(generation: Any):
+    """Canonical hashable spelling of an index generation — a plain
+    ``int`` for a single index, a tuple of ints for a PARTITIONED fleet
+    (one generation per partition, in partition order).  The vector form
+    exists so a partition absorb on host B changes the whole fleet's
+    key: caching on any single host's scalar would let host A keep
+    serving rows that host B's absorb just invalidated."""
+    if isinstance(generation, (list, tuple)):
+        return tuple(int(g) for g in generation)
+    return int(generation)
+
+
+def query_key(text: Any, generation: Any) -> Tuple[str, Any]:
     """``(text, index generation)`` — the scheduler's in-window dedup
     item AND the result-cache key prefix.  Everything downstream treats
-    it as opaque; only this function spells it."""
-    return (str(text), int(generation))
+    it as opaque; only this function spells it.  ``generation`` may be a
+    scalar or a fleet generation vector (see ``normalize_generation``)."""
+    return (str(text), normalize_generation(generation))
 
 
 def result_key(
-    text: Any, generation: int, k: int
-) -> Tuple[str, int, int]:
+    text: Any, generation: Any, k: int
+) -> Tuple[str, Any, int]:
     """Cross-window serve-result cache key: the dedup key plus the
     requested ``k`` (the serve config that shapes the response rows).
     Keyed on the SAME ``query_key`` fields so the two can never drift."""

@@ -209,6 +209,22 @@ _knob("fabric.request_timeout_s", "PATHWAY_FABRIC_REQUEST_TIMEOUT",
       "float", 30.0, "fallback per-request response timeout in seconds "
       "for requests that carry no deadline", lo=0.05, hi=86_400.0)
 
+# partitioned serve fabric (serve/fabric.py scatter-gather)
+_knob("fabric.partitions", "PATHWAY_FABRIC_PARTITIONS", "int", 0,
+      "index partitions across the fabric fleet (0 = replica mode, "
+      "every host holds the full index; N > 0 = each host owns "
+      "doc_key % N of the corpus and serves scatter-gather)",
+      lo=0, hi=4096)
+_knob("partition.gather_timeout_s", "PATHWAY_PARTITION_GATHER_TIMEOUT",
+      "float", 10.0, "scatter-gather straggler bound in seconds: a "
+      "partition unanswered past it is flagged partition_lost and the "
+      "surviving partitions' merge is served", lo=0.05, hi=86_400.0,
+      mutability=DYNAMIC)
+_knob("partition.absorb_timeout_s", "PATHWAY_PARTITION_ABSORB_TIMEOUT",
+      "float", 30.0, "owner-routed absorb ack timeout in seconds before "
+      "the routed batch is counted dropped on its owner partition",
+      lo=0.05, hi=86_400.0)
+
 # durable warm state (serve/warmstate.py)
 _knob("warmstate.interval_s", "PATHWAY_WARMSTATE_INTERVAL_S", "float",
       60.0, "warm-state snapshot cadence in seconds (0 = manual only)",

@@ -10,7 +10,7 @@ ICI (SURVEY.md §5.8).
 
 from . import distributed
 from .exchange import ExchangePlane, gather_table_rows, get_plane
-from .shards import ShardGroup, serve_shards
+from .shards import FleetPartitionMap, ShardGroup, serve_shards
 from .mesh import (
     current_mesh,
     data_axis_size,
@@ -27,6 +27,7 @@ from .mesh import (
 
 __all__ = [
     "distributed",
+    "FleetPartitionMap",
     "ShardGroup",
     "serve_shards",
     "ExchangePlane",

@@ -37,12 +37,53 @@ from .. import config, observe
 from ..observe import trace
 from ..robust import CircuitBreaker
 
-__all__ = ["ShardGroup", "serve_shards"]
+__all__ = ["FleetPartitionMap", "ShardGroup", "serve_shards"]
 
 
 def serve_shards(default: int = 0) -> int:
     """Shard count from ``serve.shards`` (0 = every local device)."""
     return config.get("serve.shards", fallback=default)
+
+
+class FleetPartitionMap:
+    """The ONE routing rule lifted to fleet scope: ``doc_key %
+    n_partitions`` names the fabric HOST that owns a document, exactly
+    as ``ShardGroup.owner_of`` names the device shard inside one host.
+    The two compose — a fleet of H hosts each running an S-way
+    ``ShardGroup`` places a document first by ``FleetPartitionMap``
+    (which host's IVF resident/tail slabs and forward-index row bucket
+    hold it) and then by the host's own ``ShardGroup`` (which local
+    device) — and because both levels spell the same stable modulo
+    rule, owner-routed absorb, scatter-gather serve, and per-partition
+    warm snapshots all agree on placement with zero coordination.
+
+    Deliberately device-free: the front-end process holds no
+    accelerators, only host links.
+    """
+
+    def __init__(self, n_partitions: int):
+        if int(n_partitions) < 1:
+            raise ValueError(
+                f"FleetPartitionMap needs >= 1 partition, got {n_partitions}"
+            )
+        self.n_partitions = int(n_partitions)
+
+    def __len__(self) -> int:
+        return self.n_partitions
+
+    def owner_of(self, key: int) -> int:
+        """Owning PARTITION (fabric host index) of a document key —
+        the fleet-level spelling of the one routing rule."""
+        return int(key) % self.n_partitions
+
+    def route(self, keys: Sequence[int]):
+        """Positions of ``keys`` grouped by owning partition (the same
+        bucket-loop contract as ``ShardGroup.route``; iterate
+        ``sorted(...)`` for deterministic per-partition batches)."""
+        buckets: dict = {}
+        for i, key in enumerate(keys):
+            buckets.setdefault(self.owner_of(int(key)), []).append(i)
+        return buckets
 
 
 class ShardGroup:

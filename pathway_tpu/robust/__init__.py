@@ -36,6 +36,7 @@ from .degrade import (
     HOST_FAILOVER,
     LATE_INTERACTION_SKIPPED,
     LOAD_SHED,
+    PARTITION_LOST,
     REPLICA_LOST,
     RERANK_SKIPPED,
     RETRIEVAL_FAILED,
@@ -66,6 +67,7 @@ __all__ = [
     "HOST_FAILOVER",
     "LATE_INTERACTION_SKIPPED",
     "LOAD_SHED",
+    "PARTITION_LOST",
     "REPLICA_LOST",
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",

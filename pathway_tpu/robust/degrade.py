@@ -33,6 +33,10 @@ slow (re-routed)       (re-routed or hedged)
 no healthy fabric      empty result set (the       ``replica_lost``
 host remains           fleet, not the request,
                        is the outage)
+index partition dead   the surviving partitions'   ``partition_lost``
+/ slow in a            merged candidates (recall
+partitioned fleet      lost on the dead
+                       partition's keys only)
 =====================  ==========================  ==========================
 
 ``ServeResult`` is a ``list`` subclass, so every existing caller that
@@ -58,6 +62,7 @@ __all__ = [
     "HOST_FAILOVER",
     "LATE_INTERACTION_SKIPPED",
     "LOAD_SHED",
+    "PARTITION_LOST",
     "REPLICA_LOST",
     "RERANK_SKIPPED",
     "RETRIEVAL_FAILED",
@@ -82,6 +87,13 @@ RETRIEVAL_FAILED = "retrieval_failed"
 # flag, never an exception out of a serve call
 HOST_FAILOVER = "host_failover"
 REPLICA_LOST = "replica_lost"
+# partitioned-fleet rung (serve/fabric.py scatter-gather): when the
+# index is PARTITIONED across hosts a dead/slow host is not a replica
+# to re-route around — its partition's candidates are simply absent.
+# The serve keeps every surviving partition's merged rows and flags
+# which partitions it lost; degraded results are never cached (a later
+# clean serve must be able to recover the full recall)
+PARTITION_LOST = "partition_lost"
 
 # pre-resolved per-reason counters (reasons are the small fixed rung set)
 _degraded_counters: Dict[str, observe.Counter] = {}

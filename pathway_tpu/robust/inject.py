@@ -49,6 +49,14 @@ affinity host to the least-loaded healthy one flagged
 ``host_failover``; a send/recv fault fails over to a surviving host
 (breaker fed, same rung) and only an exhausted fleet degrades to an
 empty ``replica_lost`` result — the request NEVER sees an exception),
+the partitioned-fabric triple ``fabric.scatter`` / ``fabric.gather`` /
+``partition.absorb`` (serve/fabric.py — a scatter fault loses THAT
+partition only, flagged ``partition_lost`` with the survivors' merge
+served; a gather fault stops the wait and serves whatever partitions
+already resolved, the stragglers flagged; an absorb fault drops only
+the routed batch, counted on
+``pathway_partition_absorb_dropped_total`` and re-committable — every
+site honors a spent deadline so an armed hang releases immediately),
 the warm-state pair ``warmstate.snapshot`` / ``warmstate.restore``
 (serve/warmstate.py — a faulted snapshot is a SKIPPED cadence counted
 on ``pathway_warmstate_snapshot_skipped_total``, never a torn blob; a

@@ -34,15 +34,46 @@ degradation ladder promises (robust/degrade.py):
   back to least-loaded, flagged), ``fabric.send`` / ``fabric.recv``
   (failover to a survivor, breaker fed) — each honors an
   already-spent deadline, so an armed hang releases immediately.
+- **Partitioned mode** (``fabric.partitions`` > 0 or ``partitions=``):
+  the hosts are no longer replicas — each owns ``doc_key % H`` of the
+  corpus per the fleet routing rule (``parallel/shards.py``
+  ``FleetPartitionMap``, the same modulo rule as the device-level
+  ``ShardGroup.owner_of``).  A serve SCATTERS the query batch to every
+  partition over the same framed streams (booked as 1 logical + H
+  physical dispatches, ``fabric.scatter``), each host answers with its
+  per-partition sorted top-K over ONLY owned candidates (rerank never
+  crosses partitions — a document's forward rows live with its
+  postings), and the front GATHERS + merges via
+  ``ops/topk.tree_merge_topk_host`` re-emitting the owners' exact
+  ``(doc, score)`` rows, so an H-way fleet is bit-identical to H=1 on
+  the clean path.  A dead/slow partition degrades to the
+  ``partition_lost`` rung — the survivors' merge is served, recall is
+  lost on the dead partition's keys ONLY, never an exception; the
+  straggler bound reuses ``fabric.hedge_ms`` once a first partition
+  has answered (plus the hard ``partition.gather_timeout_s``).
+  ``absorb()`` / ``connector()`` owner-route committed documents to
+  exactly their owning host's ``LiveIngestRunner`` (absorb throughput
+  ×H; the arrival stamp taken at connector commit rides the wire so
+  connector→retrievable freshness attribution is preserved), and
+  ``index_generation()`` reports the fleet generation VECTOR — one
+  entry per partition — so the front-side scheduler's dedup and
+  result-cache keys (``cache/keys.py``) change when ANY partition
+  absorbs.  Chaos sites ``fabric.scatter`` (that partition is lost),
+  ``fabric.gather`` (stop waiting: survivors served, stragglers
+  flagged), ``partition.absorb`` (that routed batch is dropped +
+  counted, re-committable).
 
 Bring-up pairs with ``serve/warmstate.py``: a replacement worker
 restores the writer's warm state (same index generation, same cache
-keys) before joining, so a rolling restart under load serves every
-request from a surviving host while each worker bounces — measured by
-the ``serve_fabric`` bench phase.
+keys) before joining — per-partition in partitioned mode, each host
+snapshotting only its owned slabs — so a rolling restart under load
+serves every request from a surviving host while each worker bounces —
+measured by the ``serve_fabric`` / ``partitioned_fabric`` bench phases.
 """
 
 from __future__ import annotations
+
+# pathway: serve-path  (hidden-sync lint applies: no implicit host round trips)
 
 import hashlib
 import itertools
@@ -52,14 +83,20 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from .. import config, observe
 from ..cache.keys import query_key
+from ..ops.dispatch_counter import record_dispatch, record_fetch
+from ..ops.topk import tree_merge_topk_host
 from ..parallel.exchange import FramedStream, PeerLost
+from ..parallel.shards import FleetPartitionMap
 from ..robust import breaker as robust_breaker
 from ..robust import inject, log_once
 from ..robust.deadline import Deadline
 from ..robust.degrade import (
     HOST_FAILOVER,
+    PARTITION_LOST,
     REPLICA_LOST,
     ServeResult,
     record_degraded,
@@ -104,7 +141,15 @@ class FabricWorker:
     exactly as it does in-process, so the fabric inherits the 2+2
     per-batch dispatch budget unchanged.  ``stop()`` drains cleanly:
     a ``bye`` frame on every live connection tells front-ends this
-    disconnect is a planned restart (re-route, don't panic)."""
+    disconnect is a planned restart (re-route, don't panic).
+
+    ``ingest`` (a ``LiveIngestRunner`` or anything with
+    ``ingest_routed(docs, connector=)``) enables the partitioned
+    fleet's owner-routed ``absorb`` frames: documents arrive with their
+    connector-commit arrival stamp and enter this host's OWN ingest
+    queue — the front routed them here because this host owns their
+    keys, so absorb work fans across the fleet instead of every host
+    re-ingesting the full corpus."""
 
     def __init__(
         self,
@@ -113,8 +158,10 @@ class FabricWorker:
         port: int = 0,
         token: Optional[bytes] = None,
         name: Optional[str] = None,
+        ingest=None,
     ):
         self.scheduler = scheduler
+        self.ingest = ingest
         self.token = token if token is not None else fabric_token()
         if len(self.token) != _TOKEN_LEN:
             raise ValueError(f"fabric token must be {_TOKEN_LEN} bytes")
@@ -128,7 +175,9 @@ class FabricWorker:
         self._streams: List[FramedStream] = []
         self._stopping = False
         self._inflight = 0
-        self.stats: Dict[str, int] = {"requests": 0, "pings": 0, "errors": 0}
+        self.stats: Dict[str, int] = {
+            "requests": 0, "pings": 0, "errors": 0, "absorbs": 0,
+        }
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=f"fabric-acc-{self.name}"
         )
@@ -184,6 +233,13 @@ class FabricWorker:
                         daemon=True,
                         name=f"fabric-req-{self.name}",
                     ).start()
+                elif op == "absorb":
+                    threading.Thread(
+                        target=self._handle_absorb,
+                        args=(stream, msg),
+                        daemon=True,
+                        name=f"fabric-abs-{self.name}",
+                    ).start()
                 elif op == "bye":
                     return  # client drained; the close below is clean
         except (PeerLost, Exception):  # noqa: BLE001 - reader dies quietly
@@ -229,6 +285,40 @@ class FabricWorker:
             stream.send(reply)
         except PeerLost:
             pass  # front-end gone; its failover already covered this request
+
+    def _handle_absorb(self, stream: FramedStream, msg: Dict[str, Any]) -> None:
+        """Owner-routed absorb frame: hand the routed documents — their
+        arrival stamps taken at the FLEET connector's commit — to this
+        host's ingest runner.  A raise becomes an ``error`` reply (the
+        front counts the batch dropped on this partition; the docs are
+        re-committable), never silence."""
+        req_id = msg.get("req_id")
+        try:
+            if self.ingest is None:
+                raise RuntimeError(
+                    f"fabric worker {self.name} has no ingest runner"
+                )
+            docs = [
+                (int(k), str(t), int(ns)) for k, t, ns in msg.get("docs", ())
+            ]
+            accepted = self.ingest.ingest_routed(
+                docs, connector=str(msg.get("connector", "fleet"))
+            )
+            with self._lock:
+                self.stats["absorbs"] += 1
+            reply: Dict[str, Any] = {
+                "op": "absorb_ack",
+                "req_id": req_id,
+                "accepted": int(accepted),
+            }
+        except Exception as exc:
+            with self._lock:
+                self.stats["errors"] += 1
+            reply = {"op": "error", "req_id": req_id, "error": repr(exc)}
+        try:
+            stream.send(reply)
+        except PeerLost:
+            pass  # front gone; its absorb timeout already counted the drop
 
     def _close_listener(self) -> None:
         # close() alone frees the fd NUMBER, but with the accept thread
@@ -359,7 +449,7 @@ class _HostLink:
                 if op == "pong":
                     self.last_pong = time.monotonic()
                     self.generation = int(msg.get("generation", 0))
-                elif op in ("result", "error"):
+                elif op in ("result", "error", "absorb_ack"):
                     self.last_pong = time.monotonic()
                     with self._plock:
                         pending = self._pending.pop(msg.get("req_id"), None)
@@ -434,6 +524,15 @@ class _HostLink:
             raise
         return pending
 
+    def cancel(self, req_id: int) -> None:
+        """Forget an in-flight request the caller stopped waiting for
+        (gather straggler / absorb timeout) — a late reply to a
+        cancelled id is dropped by the receiver instead of leaking a
+        pending slot forever."""
+        with self._plock:
+            if self._pending.pop(req_id, None) is not None:
+                self.inflight = max(0, self.inflight - 1)
+
     def heartbeat(self, timeout_s: float) -> None:
         """One heartbeat tick: ping if connected; silence past
         ``timeout_s`` marks the host down (failing its in-flight
@@ -470,13 +569,22 @@ class ServeFabric:
     ``serve()``/``__call__`` — so callers swap tiers without code
     changes, and the failure contract is the ladder's: a response is
     ALWAYS a ``ServeResult``, possibly flagged ``host_failover`` or
-    (fleet exhausted) empty ``replica_lost``, never an exception."""
+    (fleet exhausted) empty ``replica_lost``, never an exception.
+
+    ``partitions`` (default: the ``fabric.partitions`` knob; 0 keeps
+    replica mode) switches the hosts from replicas to PARTITIONS of one
+    index: partition ``i`` is the ``i``-th host in ``hosts`` insertion
+    order and owns ``doc_key % H`` per ``FleetPartitionMap``.  Serves
+    scatter-gather with the ``partition_lost`` ladder rung; ``absorb``
+    / ``connector`` owner-route ingest; ``index_generation()`` reports
+    the per-partition generation vector."""
 
     def __init__(
         self,
         hosts: Dict[str, Any],
         token: bytes,
         name: Optional[str] = None,
+        partitions: Optional[int] = None,
     ):
         if not hosts:
             raise ValueError("ServeFabric needs at least one host")
@@ -488,6 +596,20 @@ class ServeFabric:
             else:
                 h, p = addr
             self._links.append(_HostLink(str(host_name), h, int(p), token))
+        n_parts = (
+            int(partitions)
+            if partitions is not None
+            else config.get("fabric.partitions")
+        )
+        self.partition_map: Optional[FleetPartitionMap] = None
+        if n_parts:
+            if n_parts != len(self._links):
+                raise ValueError(
+                    f"fabric.partitions={n_parts} but {len(self._links)} "
+                    "hosts: in partitioned mode every host IS one "
+                    "partition (partition i = i-th host)"
+                )
+            self.partition_map = FleetPartitionMap(n_parts)
         self._req_ids = itertools.count(1)
         self.stats: Dict[str, int] = {
             "requests": 0,
@@ -495,7 +617,14 @@ class ServeFabric:
             "failover": 0,
             "hedged": 0,
             "lost": 0,
+            "partition_lost": 0,
         }
+        # per-partition accounting (partitioned mode): lost serves and
+        # owner-routed absorb outcomes, keyed by partition index
+        n_hosts = len(self._links)
+        self._part_lost: List[int] = [0] * n_hosts
+        self._absorb_docs: List[int] = [0] * n_hosts
+        self._absorb_dropped: List[int] = [0] * n_hosts
         self._stats_lock = threading.Lock()
         self._observe_id = observe.next_id()
         observe.register_provider(self)
@@ -525,6 +654,44 @@ class ServeFabric:
         """The fleet's index generation as last reported by pongs (the
         routing-affinity generation)."""
         return max((link.generation for link in self._links), default=0)
+
+    @property
+    def partitioned(self) -> bool:
+        return self.partition_map is not None
+
+    def index_generation(self):
+        """The generation a front-side scheduler keys dedup/cache on
+        (``cache/keys.py`` normalizes it): in partitioned mode the fleet
+        generation VECTOR — one entry per partition, so an absorb on ANY
+        partition changes the key and a result cached via host A can
+        never outlive host B's absorb — else the replica-mode scalar."""
+        if self.partition_map is not None:
+            return tuple(link.generation for link in self._links)
+        return self.generation
+
+    def poll_generations(self, timeout_s: float = 1.0):
+        """Ping every host and wait for fresh pongs, then return
+        ``index_generation()`` — the tests/bench helper that observes an
+        absorb's generation bump without waiting out a heartbeat tick."""
+        marks = []
+        for link in self._links:
+            marks.append(link.last_pong)
+            stream = link.ensure()
+            if stream is None:
+                continue
+            try:
+                stream.send({"op": "ping"})
+            except PeerLost:
+                link.mark_down("disconnect")
+        t_end = time.monotonic() + timeout_s
+        while time.monotonic() < t_end:
+            if all(
+                not link.up() or link.last_pong != mark
+                for link, mark in zip(self._links, marks)
+            ):
+                break
+            time.sleep(0.002)
+        return self.index_generation()
 
     # -- routing -------------------------------------------------------------
     def _affinity(self, text: str) -> int:
@@ -617,6 +784,8 @@ class ServeFabric:
         deadline: Optional[Deadline],
         priority: Optional[str],
     ) -> ServeResult:
+        if self.partition_map is not None:
+            return self._serve_scatter(texts, k, deadline, priority)
         with self._stats_lock:
             self.stats["requests"] += 1
         order, route_degraded = self._route(texts, deadline=deadline)
@@ -796,6 +965,335 @@ class ServeFabric:
             [[] for _ in texts], degraded=(REPLICA_LOST,), meta=meta
         )
 
+    # -- partitioned scatter-gather -------------------------------------------
+    def _serve_scatter(
+        self,
+        texts: List[str],
+        k: Optional[int],
+        deadline: Optional[Deadline],
+        priority: Optional[str],
+    ) -> ServeResult:
+        """Partitioned serve: fan the batch to every partition (ONE
+        logical dispatch fanning H physical sends), gather each
+        partition's sorted top-K over its owned candidates, merge
+        front-side.  A partition that cannot be reached, answers with an
+        error, or straggles past the hedge/gather budget is LOST — the
+        survivors' merge is served flagged ``partition_lost`` (recall
+        lost on that partition's keys only), never an exception."""
+        with self._stats_lock:
+            self.stats["requests"] += 1
+        n_parts = len(self._links)
+        base_msg = {
+            "op": "serve",
+            "texts": texts,
+            "k": k,
+            "priority": priority,
+            "deadline_ms": (
+                max(0.0, deadline.remaining_s() * 1e3)
+                if deadline is not None
+                else None
+            ),
+        }
+        # the same booking rule the sharded index uses for per-shard
+        # device dispatches: 1 logical + H physical
+        record_dispatch("fabric.scatter", shards=n_parts)
+        pending_by_part: Dict[int, Tuple[int, _Pending]] = {}
+        lost: Dict[int, str] = {}
+        for part, link in enumerate(self._links):
+            if not link.breaker.allow():
+                lost[part] = "breaker_open"
+                continue
+            req_id = next(self._req_ids)
+            try:
+                inject.fire("fabric.scatter", deadline=deadline)
+                pending_by_part[part] = (
+                    req_id,
+                    link.send_request(
+                        req_id,
+                        {**base_msg, "req_id": req_id},
+                        deadline=deadline,
+                    ),
+                )
+            except BaseException as exc:  # noqa: BLE001 - lost, never raise
+                link.breaker.record_failure()
+                log_once(
+                    f"fabric.scatter:{link.name}:{type(exc).__name__}",
+                    "fabric scatter to partition %s failed (%r); serving "
+                    "without it",
+                    link.name,
+                    exc,
+                )
+                lost[part] = "send"
+        replies: Dict[int, Dict[str, Any]] = {}
+        gather_fault = False
+        try:
+            inject.fire("fabric.gather", deadline=deadline)
+        except BaseException as exc:  # noqa: BLE001 - stop waiting, serve
+            gather_fault = True
+            log_once(
+                f"fabric.gather:{type(exc).__name__}",
+                "fabric gather degraded (%r); serving resolved partitions",
+                exc,
+            )
+        if not gather_fault and pending_by_part:
+            hedge_s = config.get("fabric.hedge_ms") * 1e-3
+            timeout_s = min(
+                config.get("fabric.request_timeout_s"),
+                config.get("partition.gather_timeout_s"),
+            )
+            t_end = time.monotonic() + timeout_s
+            if deadline is not None:
+                t_end = min(
+                    t_end,
+                    time.monotonic() + max(0.0, deadline.remaining_s()),
+                )
+            first_t: Optional[float] = None
+            while pending_by_part:
+                for part, (req_id, pending) in list(pending_by_part.items()):
+                    if not pending.event.is_set():
+                        continue
+                    replies[part] = pending.reply or {}
+                    del pending_by_part[part]
+                    if first_t is None:
+                        first_t = time.monotonic()
+                if not pending_by_part:
+                    break
+                now = time.monotonic()
+                if now >= t_end:
+                    # hard straggler budget (partition.gather_timeout_s
+                    # / the request deadline): a host slow past the
+                    # fleet's patience is sick — feed its breaker so
+                    # the next serve skips it immediately
+                    for part, (req_id, _p) in pending_by_part.items():
+                        self._links[part].breaker.record_failure()
+                        self._links[part].cancel(req_id)
+                        lost[part] = "timeout"
+                    pending_by_part.clear()
+                    break
+                if (
+                    hedge_s > 0
+                    and first_t is not None
+                    and now >= first_t + hedge_s
+                ):
+                    # soft straggler bound reusing fabric.hedge_ms: one
+                    # partition has answered and the hedge budget is
+                    # spent — serve without the stragglers (breakers
+                    # NOT fed; slow-once is not sick)
+                    for part, (req_id, _p) in pending_by_part.items():
+                        self._links[part].cancel(req_id)
+                        lost[part] = "straggler"
+                    pending_by_part.clear()
+                    break
+                wait_s = min(0.01, max(0.0, t_end - now))
+                if hedge_s > 0 and first_t is not None:
+                    wait_s = min(wait_s, max(0.0005, first_t + hedge_s - now))
+                next(iter(pending_by_part.values()))[1].event.wait(wait_s)
+        # a gather fault stops the wait: partitions already resolved
+        # survive, the rest are lost — their hosts are NOT sick (the
+        # front's collect path was), so their breakers are not fed
+        for part, (req_id, pending) in list(pending_by_part.items()):
+            if pending.event.is_set():
+                replies[part] = pending.reply or {}
+            else:
+                self._links[part].cancel(req_id)
+                lost[part] = "gather"
+        pending_by_part.clear()
+        part_rows: Dict[int, List[Any]] = {}
+        gen_vector: List[int] = [link.generation for link in self._links]
+        degraded: List[str] = []
+        for part in sorted(replies):
+            reply = replies[part]
+            if reply.get("op") == "result":
+                self._links[part].breaker.record_success()
+                part_rows[part] = reply.get("rows", [])
+                degraded.extend(reply.get("degraded", ()))
+                rmeta = reply.get("meta", {})
+                if rmeta.get("index_generation") is not None:
+                    # dispatch-time generation from the owner itself —
+                    # fresher than the last pong's
+                    gen_vector[part] = int(rmeta["index_generation"])
+            else:
+                if reply.get("req_id") is not None:
+                    # the WORKER answered with an error: that partition
+                    # host is sick even though its socket is healthy
+                    self._links[part].breaker.record_failure()
+                log_once(
+                    f"fabric.partition:{self._links[part].name}",
+                    "partition %s failed a scatter request (%s); serving "
+                    "without it",
+                    self._links[part].name,
+                    reply.get("error", "?"),
+                )
+                lost[part] = "error"
+        record_fetch("fabric.gather", shards=max(1, len(part_rows)))
+        rows = self._merge_partitions(texts, part_rows, k)
+        meta: Dict[str, Any] = {
+            "fabric_partitions": n_parts,
+            "index_generation": tuple(gen_vector),
+        }
+        if lost:
+            record_degraded(PARTITION_LOST, len(lost))
+            degraded.append(PARTITION_LOST)
+            meta["partitions_lost"] = {
+                self._links[p].name: why for p, why in sorted(lost.items())
+            }
+        with self._stats_lock:
+            if lost:
+                self.stats["partition_lost"] += 1
+                for part in lost:
+                    self._part_lost[part] += 1
+            if part_rows:
+                self.stats["ok"] += 1
+            else:
+                self.stats["lost"] += 1
+        return ServeResult(rows, degraded=degraded, meta=meta)
+
+    def _merge_partitions(
+        self,
+        texts: List[str],
+        part_rows: Dict[int, List[Any]],
+        k: Optional[int],
+    ) -> List[List[Any]]:
+        """Front-side merge of per-partition sorted top-K rows via the
+        SAME primitive the device shards use
+        (``ops/topk.tree_merge_topk_host``): scores order the merge,
+        then the owners' original ``(doc, score)`` pairs are re-emitted
+        — the merge only PICKS, never recomputes, which is what makes
+        an H-way fleet bit-identical to H=1 on the clean path."""
+        if not part_rows:
+            return [[] for _ in texts]
+        parts = sorted(part_rows)
+        b = len(texts)
+        k_cap = 0
+        for p in parts:
+            for row in part_rows[p]:
+                k_cap = max(k_cap, len(row))
+        k_out = int(k) if k else k_cap
+        if k_cap == 0 or k_out == 0:
+            return [[] for _ in texts]
+        s = len(parts)
+        # [S, B, K] merge inputs: scores order; (owner, position) name
+        # the original pair to re-emit; absent slots (a partition that
+        # returned fewer than K rows) mask to -inf and are filtered out
+        scores = np.full((s, b, k_cap), -np.inf, dtype=np.float64)
+        pos = np.zeros((s, b, k_cap), dtype=np.int64)
+        owner = np.zeros((s, b, k_cap), dtype=np.int64)
+        for si, p in enumerate(parts):
+            owner[si, :, :] = si
+            rows = part_rows[p]
+            for qi in range(b):
+                row = rows[qi] if qi < len(rows) else []
+                for j, pair in enumerate(row[:k_cap]):
+                    scores[si, qi, j] = float(pair[1])
+                    pos[si, qi, j] = j
+        m_scores, m_owner, m_pos = tree_merge_topk_host(
+            scores, owner, pos, k_out
+        )
+        out: List[List[Any]] = []
+        for qi in range(b):
+            merged_row: List[Any] = []
+            for j in range(m_scores.shape[1]):
+                if not np.isfinite(m_scores[qi, j]):
+                    continue
+                p = parts[int(m_owner[qi, j])]
+                merged_row.append(part_rows[p][qi][int(m_pos[qi, j])])
+            out.append(merged_row)
+        return out
+
+    # -- owner-routed absorb --------------------------------------------------
+    def connector(self, name: Optional[str] = None) -> "_FleetConnector":
+        """A fleet-side ingest connector (mirrors
+        ``serve/ingest.IngestConnector``): buffer keyed rows, stamp them
+        at ``commit()`` — the SAME arrival clock — then owner-route each
+        document to exactly its owning partition."""
+        if self.partition_map is None:
+            raise RuntimeError("connector() requires a partitioned fabric")
+        return _FleetConnector(self, name or f"{self.name}-connector")
+
+    def absorb(
+        self,
+        docs: Sequence[Tuple[int, str, int]],
+        deadline: Optional[Deadline] = None,
+        connector: str = "fleet",
+    ) -> int:
+        """Owner-routed absorb: route ``(key, text, t_arrival_ns)``
+        documents to their owning partitions ONLY (``FleetPartitionMap``
+        buckets — each host ingests 1/H of the stream, so fleet absorb
+        throughput scales ×H) and wait for the owners' acks.  A
+        partition that faults (chaos site ``partition.absorb``), is
+        unreachable, errors, or misses ``partition.absorb_timeout_s``
+        has its routed batch counted dropped — the documents are
+        re-committable, the commit never raises.  Returns accepted."""
+        if self.partition_map is None:
+            raise RuntimeError("absorb() requires a partitioned fabric")
+        docs = [(int(kk), str(t), int(ns)) for kk, t, ns in docs]
+        if not docs:
+            return 0
+        buckets = self.partition_map.route([d[0] for d in docs])
+        acks: List[Tuple[int, int, List[Tuple[int, str, int]], _Pending]] = []
+        for part in sorted(buckets):
+            batch = [docs[i] for i in buckets[part]]
+            link = self._links[part]
+            try:
+                inject.fire("partition.absorb", deadline=deadline)
+                if not link.breaker.allow():
+                    raise PeerLost(f"partition {link.name} breaker open")
+                req_id = next(self._req_ids)
+                pending = link.send_request(
+                    req_id,
+                    {
+                        "op": "absorb",
+                        "req_id": req_id,
+                        "docs": batch,
+                        "connector": connector,
+                    },
+                    deadline=deadline,
+                )
+            except BaseException as exc:  # noqa: BLE001 - dropped, never raise
+                with self._stats_lock:
+                    self._absorb_dropped[part] += len(batch)
+                log_once(
+                    f"partition.absorb:{link.name}:{type(exc).__name__}",
+                    "absorb route to partition %s failed (%r); batch "
+                    "dropped (re-committable)",
+                    link.name,
+                    exc,
+                )
+                continue
+            acks.append((part, req_id, batch, pending))
+        timeout_s = config.get("partition.absorb_timeout_s")
+        t_end = time.monotonic() + timeout_s
+        if deadline is not None:
+            t_end = min(
+                t_end, time.monotonic() + max(0.0, deadline.remaining_s())
+            )
+        accepted = 0
+        for part, req_id, batch, pending in acks:
+            pending.event.wait(max(0.0, t_end - time.monotonic()))
+            reply = pending.reply or {}
+            if pending.event.is_set() and reply.get("op") == "absorb_ack":
+                n = int(reply.get("accepted", len(batch)))
+                accepted += n
+                self._links[part].breaker.record_success()
+                with self._stats_lock:
+                    self._absorb_docs[part] += n
+            else:
+                self._links[part].cancel(req_id)
+                if pending.event.is_set() and reply.get("req_id") is not None:
+                    # the OWNER answered with an error (no runner / a
+                    # runner bug): that host is sick, feed its breaker
+                    self._links[part].breaker.record_failure()
+                with self._stats_lock:
+                    self._absorb_dropped[part] += len(batch)
+                log_once(
+                    f"partition.absorb_ack:{self._links[part].name}",
+                    "partition %s did not ack an absorb batch (%s); "
+                    "batch dropped (re-committable)",
+                    self._links[part].name,
+                    reply.get("error", "timeout"),
+                )
+        return accepted
+
     # -- flight recorder ------------------------------------------------------
     def observe_metrics(self):
         base = {"fabric": self.name, "id": str(self._observe_id)}
@@ -812,6 +1310,33 @@ class ServeFabric:
             yield (
                 "gauge", "pathway_fabric_inflight", labels, link.inflight
             )
+        if self.partition_map is not None:
+            yield (
+                "gauge",
+                "pathway_partition_count",
+                base,
+                self.partition_map.n_partitions,
+            )
+            for part, link in enumerate(self._links):
+                pl = {**base, "partition": str(part), "host": link.name}
+                yield (
+                    "counter",
+                    "pathway_partition_lost_total",
+                    pl,
+                    self._part_lost[part],
+                )
+                yield (
+                    "counter",
+                    "pathway_partition_absorb_docs_total",
+                    pl,
+                    self._absorb_docs[part],
+                )
+                yield (
+                    "counter",
+                    "pathway_partition_absorb_dropped_total",
+                    pl,
+                    self._absorb_dropped[part],
+                )
 
     def stop(self) -> None:
         """Close every link (bye frames, best-effort).  Idempotent."""
@@ -820,3 +1345,47 @@ class ServeFabric:
         self._closed = True
         for link in self._links:
             link.close()
+
+
+class _FleetConnector:
+    """Fleet-side twin of ``serve/ingest.IngestConnector``: the same
+    buffer/commit surface, but ``commit()`` owner-routes the batch over
+    the fabric instead of enqueueing locally.  The arrival stamp is
+    taken HERE — at connector commit, exactly where the single-host
+    connector takes it — and rides the wire, so the owner's freshness
+    histograms attribute the full connector→retrievable journey
+    including the routing hop."""
+
+    def __init__(self, fabric: ServeFabric, name: str):
+        self._fabric = fabric
+        self.name = str(name)
+        self._buf: List[Tuple[int, str]] = []
+        self._lock = threading.Lock()
+
+    def insert(self, key: int, text: str) -> None:
+        with self._lock:
+            self._buf.append((int(key), str(text)))
+
+    def insert_rows(self, rows) -> None:
+        rows = [(int(k), str(t)) for k, t in rows]
+        with self._lock:
+            self._buf.extend(rows)
+
+    def commit(self, deadline: Optional[Deadline] = None) -> int:
+        """Commit buffered rows to their owning partitions; returns how
+        many documents the owners accepted (a faulted/dead partition's
+        batch counts dropped on the fabric's absorb ledger and is
+        re-committable — commit itself never raises)."""
+        with self._lock:
+            rows, self._buf = self._buf, []
+        if not rows:
+            return 0
+        t = time.perf_counter_ns()
+        return self._fabric.absorb(
+            [(k, txt, t) for k, txt in rows],
+            deadline=deadline,
+            connector=self.name,
+        )
+
+    def close(self) -> None:
+        pass

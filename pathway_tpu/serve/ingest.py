@@ -221,6 +221,26 @@ class LiveIngestRunner:
         self._connectors.append(c)
         return c
 
+    def ingest_routed(
+        self,
+        docs: Sequence[Tuple[int, str, int]],
+        connector: str = "fleet",
+    ) -> int:
+        """Owner-routed absorb entry (``serve/fabric.py``): accept
+        ``(key, text, t_arrival_ns)`` documents whose arrival stamp was
+        taken at the FLEET connector's commit and enqueue them as if a
+        local connector had committed them — the freshness plane then
+        attributes the full connector→retrievable journey including the
+        routing hop, because the clock started at the real commit, not
+        at this host's receive."""
+        batch = [
+            _Doc(int(k), str(t), int(ns), str(connector))
+            for k, t, ns in docs
+        ]
+        if batch:
+            self._enqueue(batch)
+        return len(batch)
+
     def _enqueue(self, docs: Sequence[_Doc]) -> None:
         cap = config.get("ingest.queue_cap")
         with self._cv:

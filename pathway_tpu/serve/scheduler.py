@@ -72,7 +72,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import config, observe
-from ..cache import query_key, result_cache_from_env
+from ..cache import normalize_generation, query_key, result_cache_from_env
 from ..observe import slo as slo_mod
 from ..observe import trace
 from ..robust import (
@@ -814,13 +814,16 @@ class ServeScheduler(_CoalescerBase):
         gen = 0
         if self._generation is not None:
             try:
-                gen = int(self._generation())
+                gen = normalize_generation(self._generation())
             except Exception:
                 gen = 0
         # dedup item = (text, generation-at-admission): only duplicates
         # that observed the SAME index state may share a dispatched slot.
         # The SAME helper derives the result-cache key (cache/keys.py),
-        # so the two spellings can never drift.
+        # so the two spellings can never drift.  Against a PARTITIONED
+        # fabric ``gen`` is the fleet generation VECTOR — an absorb on
+        # ANY partition changes it, so a result cached via host A can
+        # never be served after host B's absorb.
         items = [query_key(t, gen) for t in texts]
         k_eff = k or self.k
         cache = self._result_cache
@@ -943,7 +946,10 @@ class ServeScheduler(_CoalescerBase):
             ctx = req.trace
             with trace.use(ctx) if ctx is not None else _NOOP_CM:
                 for (text, gen), row in zip(req.items, rows):
-                    if meta_gen is not None and int(meta_gen) != int(gen):
+                    if meta_gen is not None and (
+                        normalize_generation(meta_gen)
+                        != normalize_generation(gen)
+                    ):
                         continue
                     cache.put_row(text, gen, k, row, deadline=req.deadline)
         ctx = req.trace

@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import observe
 from .. import config
+from ..cache.keys import normalize_generation
 from ..parallel import distributed as dist
 from ..persistence.framing import frame, scan
 from ..robust import inject, log_once
@@ -397,20 +398,28 @@ class WarmStateManager:
 
     # -- cross-host agreement --------------------------------------------------
     def agree_generation(
-        self, local_gen: int, *, tag: str, deadline=None
-    ) -> Tuple[int, bool]:
+        self, local_gen, *, tag: str, deadline=None
+    ):
         """Replica-group index-generation agreement: the coordinator's
         generation broadcast to every host (``name`` is unique per
         bring-up ``tag``).  Returns ``(generation, agreed)`` —
         ``agreed`` False means the control plane DEGRADED (counted on
         ``pathway_dist_degraded_total{site="broadcast"}``) and this
         host proceeds on its local generation, flagged by the caller;
-        bring-up is never hung on the coordination service."""
+        bring-up is never hung on the coordination service.
+
+        ``local_gen`` may be a scalar (replica fleet) or a generation
+        VECTOR — one entry per partition (``cache/keys.py``
+        ``normalize_generation`` spells both) — so a partitioned fleet
+        agrees on every partition's generation at once and front-side
+        cache keys derived from the agreed vector stay sound fleet-wide."""
+        local = normalize_generation(local_gen)
         value = dist.broadcast_obj(
-            int(local_gen) if dist.is_coordinator() else None,
+            local if dist.is_coordinator() else None,
             name=f"warmstate/{self.name}/gen/{tag}",
             deadline=deadline,
         )
         if value is None:
-            return int(local_gen), False
-        return int(value), bool(int(value) == int(local_gen))
+            return local, False
+        value = normalize_generation(value)
+        return value, bool(value == local)

@@ -758,6 +758,56 @@ def test_hidden_sync_budget_clean_when_recorded():
     assert _live(analyze_source(good, "fixtures/serve.py"), "hidden-sync") == []
 
 
+def test_hidden_sync_fanout_booking_requires_shards_width():
+    """The partitioned fabric's scatter shape — stream I/O fanned out in
+    a loop, booked on the dispatch budget — must declare its physical
+    width (``record_dispatch(tag, shards=N)``: 1 logical + N physical,
+    ISSUE 20).  Without ``shards=`` the runtime shard counters book an
+    H-way scatter as ONE physical send."""
+    bad = _SERVE_HDR + textwrap.dedent("""
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+
+        def serve_scatter(links, msg):
+            record_dispatch("fabric.scatter")  # missing shards=
+            for link in links:
+                link.send_request(msg)
+            return links
+    """)
+    found = _live(analyze_source(bad, "fixtures/serve.py"), "hidden-sync")
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 1, messages
+    assert "shards=N" in messages
+    assert "send_request" in messages
+
+
+def test_hidden_sync_fanout_booking_clean_with_shards():
+    good = _SERVE_HDR + textwrap.dedent("""
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+
+        def serve_scatter(links, msg):
+            record_dispatch("fabric.scatter", shards=len(links))
+            for link in links:
+                link.send_request(msg)
+            record_fetch("fabric.gather", shards=len(links))
+            return links
+    """)
+    assert _live(analyze_source(good, "fixtures/serve.py"), "hidden-sync") == []
+
+
+def test_hidden_sync_fanout_check_ignores_unbooked_scopes():
+    """Owner-routed absorb loops over streams but books nothing — the
+    fan-out check constrains scopes that BOOK, not every loop-send."""
+    good = _SERVE_HDR + textwrap.dedent("""
+        from pathway_tpu.ops.dispatch_counter import record_dispatch, record_fetch
+
+        def absorb(links, docs):
+            for link in links:
+                link.send_request(docs)
+            return len(docs)
+    """)
+    assert _live(analyze_source(good, "fixtures/serve.py"), "hidden-sync") == []
+
+
 def test_hidden_sync_budget_crosscheck_sees_retry_wrapped_dispatch():
     """A retry-wrapped launch still needs its record_dispatch, and its
     result is a device value whose fetch needs record_fetch — the robust

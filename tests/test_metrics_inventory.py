@@ -215,8 +215,12 @@ def rendered_families():
     fab_sched = ServeScheduler(pipe, window_us=0, result_cache=None)
     fab_tok = fabric_token()
     fab_worker = FabricWorker(fab_sched, token=fab_tok, name="inv-host")
+    # partitions=1: the serve takes the scatter-gather path, so the
+    # pathway_partition_* families (ISSUE 20) render alongside the
+    # replica-mode pathway_fabric_* ones
     fabric = ServeFabric(
-        {"inv-host": fab_worker.address}, fab_tok, name="inventory"
+        {"inv-host": fab_worker.address}, fab_tok, name="inventory",
+        partitions=1,
     )
     assert fabric.connect() == 1
     assert fabric.serve([QUERIES[0]])[0]

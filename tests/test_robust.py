@@ -1911,3 +1911,219 @@ def test_fabric_recv_chaos_triple_reroutes_in_flight(stack):
         assert isinstance(got, ServeResult)
     finally:
         stop()
+
+def _mini_part_fleet(stack, n=3, tag="", with_ingest=False):
+    """Partitioned twin of ``_mini_fleet``: each host owns ``doc_key %
+    n`` of the corpus (its own exact index + scheduler), the front runs
+    scatter-gather; optional per-host live ingest runners for the
+    owner-routed absorb sites."""
+    import itertools as _it
+
+    from pathway_tpu.parallel import FleetPartitionMap
+    from pathway_tpu.serve import (
+        FabricWorker,
+        LiveIngestRunner,
+        ServeFabric,
+        ServeScheduler,
+        fabric_token,
+    )
+
+    if not hasattr(_mini_part_fleet, "_seq"):
+        _mini_part_fleet._seq = _it.count()
+    enc, _ce, _index = stack
+    token = fabric_token()
+    names = [f"pb{tag}{next(_mini_part_fleet._seq)}-{i}" for i in range(n)]
+    pmap = FleetPartitionMap(n)
+    keys = sorted(DOCS)
+    scheds, workers, runners = [], [], []
+    for i in range(n):
+        owned = [k for k in keys if pmap.owner_of(k) == i]
+        idx = DeviceKnnIndex(dimension=32, metric="cos", initial_capacity=64)
+        idx.add(owned, enc.encode([DOCS[k] for k in owned]))
+        sched = ServeScheduler(
+            FusedEncodeSearch(enc, idx, k=8), window_us=0, result_cache=None
+        )
+        runner = (
+            LiveIngestRunner(enc, idx, name=f"{names[i]}-ing")
+            if with_ingest
+            else None
+        )
+        scheds.append(sched)
+        runners.append(runner)
+        workers.append(
+            FabricWorker(sched, token=token, name=names[i], ingest=runner)
+        )
+    fabric = ServeFabric(
+        {w.name: w.address for w in workers},
+        token,
+        name=f"pbfab{names[0]}",
+        partitions=n,
+    )
+    assert fabric.connect() == n
+
+    def stop():
+        fabric.stop()
+        for w in workers:
+            w.stop()
+        for r in runners:
+            if r is not None:
+                r.stop()
+        for s in scheds:
+            s.stop()
+
+    return fabric, names, runners, stop
+
+
+def test_fabric_scatter_chaos_triple_loses_that_partition_only(stack):
+    """``fabric.scatter`` faulted on one partition: the survivors' merge
+    is served flagged ``partition_lost`` and counted — recall is lost on
+    the faulted partition's keys ONLY, the surviving hosts stay inside
+    their 2+2 per-batch budget, and a hang under a spent deadline
+    releases immediately instead of wedging the waiter."""
+    from pathway_tpu.robust import PARTITION_LOST
+
+    fabric, names, _runners, stop = _mini_part_fleet(stack, tag="sc")
+    try:
+        clean = fabric.serve([QUERIES[0]], k=5)
+        assert clean.degraded == () and clean[0]
+        lost0 = _degraded(PARTITION_LOST)
+        with dispatch_counter.DispatchCounter() as counter:
+            with inject.armed("fabric.scatter", "raise", times=1):
+                got = fabric.serve([QUERIES[0]], k=5)
+        assert isinstance(got, ServeResult)
+        assert PARTITION_LOST in got.degraded
+        assert got[0], "survivors must still serve rows"
+        assert _degraded(PARTITION_LOST) == lost0 + 1
+        assert fabric.stats["partition_lost"] == 1
+        assert len(got.meta["partitions_lost"]) == 1
+        lost_host = next(iter(got.meta["partitions_lost"]))
+        lost_part = names.index(lost_host)
+        # recall bound: no served row is owned by the lost partition, and
+        # every clean top-k row the survivors own leads the merge
+        assert all(int(k) % 3 != lost_part for k, _s in got[0])
+        kept = [(k, s) for k, s in clean[0] if int(k) % 3 != lost_part]
+        assert list(got[0][: len(kept)]) == kept
+        # the faulted send fed THAT partition's breaker only
+        assert robust.breaker(f"fabric:{lost_host}").state == "open"
+        # per-host budget under chaos: each SURVIVING host served one
+        # solo batch inside 2 dispatches + 2 fetches
+        host_disp = [
+            t for kind, t in counter.events
+            if kind == "dispatch" and t != "fabric.scatter"
+        ]
+        host_fet = [
+            t for kind, t in counter.events
+            if kind == "fetch" and t != "fabric.gather"
+        ]
+        assert len(host_disp) <= 2 * 2, counter.events
+        assert len(host_fet) <= 2 * 2, counter.events
+        for name in names:
+            robust.breaker(f"fabric:{name}").reset()
+        with inject.armed("fabric.scatter", "delay", delay_s=0.02):
+            got = fabric.serve([QUERIES[0]], k=5)
+        assert got.degraded == () and list(got) == list(clean)
+        t0 = time.monotonic()
+        with inject.armed("fabric.scatter", "hang", hang_s=30.0):
+            got = fabric.serve([QUERIES[0]], k=5, deadline=Deadline(0.0))
+        assert time.monotonic() - t0 < 5.0
+        assert isinstance(got, ServeResult)
+    finally:
+        stop()
+
+
+def test_fabric_gather_chaos_serves_survivors_and_never_caches(stack):
+    """``fabric.gather`` faulted: the front stops waiting — whatever
+    partitions already resolved are served flagged ``partition_lost``,
+    the result is NEVER admitted to the front scheduler's result cache
+    (the next serve recomputes clean), and the stragglers' breakers are
+    NOT fed (their hosts aren't sick, the front's collect path was)."""
+    from pathway_tpu.cache import ResultCache
+    from pathway_tpu.robust import PARTITION_LOST
+    from pathway_tpu.serve import ServeScheduler
+
+    fabric, names, _runners, stop = _mini_part_fleet(stack, tag="ga")
+    front = ServeScheduler(
+        fabric, window_us=0, result_cache=ResultCache(), name="ga-front"
+    )
+    try:
+        # the scheduler caches on the fleet generation VECTOR: wait for
+        # the pongs so admission and dispatch agree on it
+        t_end = time.monotonic() + 10
+        while (
+            fabric.poll_generations() != (1, 1, 1)
+            and time.monotonic() < t_end
+        ):
+            time.sleep(0.05)
+        clean = front.serve([QUERIES[1]], k=5)
+        assert clean.degraded == () and clean[0]
+        again = front.serve([QUERIES[1]], k=5)
+        assert front.stats["cache_hits"] == 1
+        assert list(again) == list(clean)
+        lost0 = _degraded(PARTITION_LOST)
+        with inject.armed("fabric.gather", "raise", times=1):
+            got = front.serve([QUERIES[2]], k=5)
+        assert isinstance(got, ServeResult)
+        assert PARTITION_LOST in got.degraded
+        assert _degraded(PARTITION_LOST) >= lost0 + 1
+        # a gather fault does NOT feed host breakers
+        assert all(
+            robust.breaker(f"fabric:{n}").state == "closed" for n in names
+        )
+        # the degraded result was never cached: the next serve is a
+        # recompute that lands clean and full
+        hits_before = front.stats["cache_hits"]
+        got2 = front.serve([QUERIES[2]], k=5)
+        assert got2.degraded == () and got2[0]
+        assert front.stats["cache_hits"] == hits_before
+        with inject.armed("fabric.gather", "delay", delay_s=0.02):
+            got = fabric.serve([QUERIES[1]], k=5)
+        assert got.degraded == ()
+        t0 = time.monotonic()
+        with inject.armed("fabric.gather", "hang", hang_s=30.0):
+            got = fabric.serve([QUERIES[1]], k=5, deadline=Deadline(0.0))
+        assert time.monotonic() - t0 < 5.0
+        assert isinstance(got, ServeResult)
+    finally:
+        front.stop()
+        stop()
+
+
+def test_partition_absorb_chaos_triple_drops_batch_recommittable(stack):
+    """``partition.absorb`` faulted: that routed batch is dropped and
+    counted on the owner's absorb ledger — the commit NEVER raises, the
+    owner's breaker is NOT fed (the route faulted, not the host), and
+    the same documents land on a plain re-commit."""
+    fabric, names, runners, stop = _mini_part_fleet(
+        stack, tag="ab", with_ingest=True
+    )
+    owner = 100 % 3
+    try:
+        with inject.armed("partition.absorb", "raise", times=1):
+            accepted = fabric.absorb(
+                [(100, "chaos absorb doc", time.perf_counter_ns())]
+            )
+        assert accepted == 0
+        assert fabric._absorb_dropped[owner] == 1
+        assert robust.breaker(f"fabric:{names[owner]}").state == "closed"
+        # re-committable: the identical docs land on the next commit
+        accepted = fabric.absorb(
+            [(100, "chaos absorb doc", time.perf_counter_ns())]
+        )
+        assert accepted == 1
+        assert runners[owner].flush(timeout=30.0)
+        assert fabric._absorb_docs[owner] == 1
+        with inject.armed("partition.absorb", "delay", delay_s=0.02):
+            assert (
+                fabric.absorb([(103, "late doc", time.perf_counter_ns())])
+                == 1
+            )
+        t0 = time.monotonic()
+        with inject.armed("partition.absorb", "hang", hang_s=30.0):
+            accepted = fabric.absorb(
+                [(106, "hang doc", time.perf_counter_ns())],
+                deadline=Deadline(0.0),
+            )
+        assert time.monotonic() - t0 < 5.0
+        assert accepted == 0
+    finally:
+        stop()
